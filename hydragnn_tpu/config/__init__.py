@@ -1,0 +1,3 @@
+from .config import (HeadConfig, ModelConfig, build_model_config,
+                     calculate_avg_deg, gather_deg, get_log_name_config,
+                     load_config, merge_config, save_config, update_config)

@@ -1,0 +1,130 @@
+"""LSMS text-format raw dataset (also the "unit_test" CI format).
+
+reference: hydragnn/preprocess/lsms_raw_dataset_loader.py:20-106 (per-file
+text layout: line 0 = graph features; subsequent lines = per-node rows with
+columns [feature..., x, y, z at cols 2-4, nodal outputs...]; charge density
+column adjusted by proton count) and utils/datasets/lsmsdataset.py:6.
+
+Feature min-max normalization mirrors AbstractRawDataset
+(reference: utils/datasets/abstractrawdataset.py:29 normalize step).
+"""
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..graphs.batch import GraphSample
+from ..preprocess.load_data import split_dataset
+from ..preprocess.transforms import (build_graph_sample,
+                                     normalize_edge_lengths)
+
+
+def parse_lsms_file(filepath: str, node_feature_dims: Sequence[int],
+                    node_feature_cols: Sequence[int],
+                    graph_feature_dims: Sequence[int],
+                    graph_feature_cols: Sequence[int],
+                    apply_charge_density: bool = True):
+    """One LSMS text file -> (node_feature_matrix, positions, graph_feats)."""
+    with open(filepath, encoding="utf-8") as f:
+        lines = f.readlines()
+    gtok = lines[0].split()
+    g_feature = []
+    for item, dim in enumerate(graph_feature_dims):
+        for icomp in range(dim):
+            g_feature.append(float(gtok[graph_feature_cols[item] + icomp]))
+    node_rows, pos_rows = [], []
+    for line in lines[1:]:
+        tok = line.split()
+        if not tok:
+            continue
+        pos_rows.append([float(tok[2]), float(tok[3]), float(tok[4])])
+        feats = []
+        for item, dim in enumerate(node_feature_dims):
+            for icomp in range(dim):
+                feats.append(float(tok[node_feature_cols[item] + icomp]))
+        node_rows.append(feats)
+    node_feats = np.asarray(node_rows, np.float32)
+    pos = np.asarray(pos_rows, np.float32)
+    if apply_charge_density and node_feats.shape[1] >= 2:
+        # charge density column = raw value minus proton count
+        # (reference: lsms_raw_dataset_loader.py:90-106)
+        node_feats[:, 1] = node_feats[:, 1] - node_feats[:, 0]
+    return node_feats, pos, np.asarray(g_feature, np.float32)
+
+
+def _minmax_normalize(arrs: List[np.ndarray]) -> Tuple[List[np.ndarray], np.ndarray]:
+    """Column-wise min-max over the whole dataset; returns minmax [2, C]."""
+    stacked = np.concatenate([a.reshape(-1, a.shape[-1]) for a in arrs], axis=0)
+    lo = stacked.min(axis=0)
+    hi = stacked.max(axis=0)
+    span = np.where(hi - lo > 1e-12, hi - lo, 1.0)
+    out = [((a - lo) / span).astype(np.float32) for a in arrs]
+    return out, np.stack([lo, hi])
+
+
+class LSMSDataset:
+    """Loads a directory of LSMS text files into GraphSamples with radius
+    graphs, normalized features, selected inputs/targets — the raw->graph
+    pipeline of AbstractRawDataset (reference: abstractrawdataset.py:29) for
+    the LSMS format."""
+
+    def __init__(self, config: Dict, dirpath: str):
+        ds = config["Dataset"]
+        nf = ds["node_features"]
+        gf = ds.get("graph_features", {"dim": [], "column_index": []})
+        files = sorted(glob.glob(os.path.join(dirpath, "*")))
+        files = [f for f in files if os.path.isfile(f)]
+        node_mats, poss, gfeats = [], [], []
+        for fp in files:
+            n, p, g = parse_lsms_file(
+                fp, nf["dim"], nf["column_index"], gf["dim"],
+                gf["column_index"],
+                apply_charge_density=ds.get("name", "").startswith("FePt"))
+            node_mats.append(n)
+            poss.append(p)
+            gfeats.append(g)
+        if not node_mats:
+            raise FileNotFoundError(f"no LSMS files found in {dirpath}")
+        # dataset-wide min-max normalization (reference: abstractrawdataset
+        # normalize; unit-test path keeps raw values in [0,1] already)
+        node_mats, self.minmax_node_feature = _minmax_normalize(node_mats)
+        if gfeats[0].size:
+            gfeats, self.minmax_graph_feature = _minmax_normalize(
+                [g[None, :] for g in gfeats])
+            gfeats = [g[0] for g in gfeats]
+        else:
+            self.minmax_graph_feature = None
+        self.samples = [
+            build_graph_sample(n, p, config, graph_feats=g)
+            for n, p, g in zip(node_mats, poss, gfeats)]
+        normalize_edge_lengths(self.samples)
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i) -> GraphSample:
+        return self.samples[i]
+
+    def __iter__(self):
+        return iter(self.samples)
+
+
+def load_lsms_splits(config: Dict):
+    """Config-driven LSMS/unit_test loading + split
+    (reference: dataset_loading_and_splitting total/train/val/test paths,
+    preprocess/load_data.py:206-222)."""
+    ds = config["Dataset"]
+    paths = ds["path"]
+    if "total" in paths:
+        total = LSMSDataset(config, paths["total"])
+        perc = config["NeuralNetwork"]["Training"].get("perc_train", 0.7)
+        return split_dataset(
+            list(total), perc,
+            ds.get("compositional_stratified_splitting", False))
+    out = []
+    for key in ("train", "validate", "test"):
+        out.append(list(LSMSDataset(config, paths[key])))
+    return tuple(out)

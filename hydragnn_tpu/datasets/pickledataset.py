@@ -1,0 +1,79 @@
+"""Simple pickle dataset: one file per sample + meta file.
+
+reference: hydragnn/utils/datasets/pickledataset.py:14-182
+(SimplePickleDataset/SimplePickleWriter — per-sample pkl, `-meta.pkl` with
+minmax/ntotal/subdir layout, optional 10k-file subdirs).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Sequence
+
+from ..graphs.batch import GraphSample
+
+
+class SimplePickleWriter:
+    """reference: pickledataset.py:103-182. `comm_rank/comm_size` shard the
+    write across processes (each process writes its own samples)."""
+
+    def __init__(self, samples: Sequence[GraphSample], basedir: str,
+                 label: str = "total", use_subdir: bool = False,
+                 nmax_per_subdir: int = 10_000, comm_rank: int = 0,
+                 comm_size: int = 1, attrs: Optional[dict] = None):
+        os.makedirs(basedir, exist_ok=True)
+        self.basedir = basedir
+        self.label = label
+        ntotal = len(samples)
+        meta = {"ntotal": ntotal, "use_subdir": use_subdir,
+                "nmax_per_subdir": nmax_per_subdir, "attrs": attrs or {}}
+        if comm_rank == 0:
+            with open(os.path.join(basedir, f"{label}-meta.pkl"), "wb") as f:
+                pickle.dump(meta, f)
+        for i, s in enumerate(samples):
+            if i % comm_size != comm_rank:
+                continue
+            d = basedir
+            if use_subdir:
+                d = os.path.join(basedir, str(i // nmax_per_subdir))
+                os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, f"{label}-{i}.pkl"), "wb") as f:
+                pickle.dump(_to_dict(s), f)
+
+
+class SimplePickleDataset:
+    """reference: pickledataset.py:14-101. Lazy per-sample reads."""
+
+    def __init__(self, basedir: str, label: str = "total"):
+        self.basedir = basedir
+        self.label = label
+        with open(os.path.join(basedir, f"{label}-meta.pkl"), "rb") as f:
+            meta = pickle.load(f)
+        self.ntotal = meta["ntotal"]
+        self.use_subdir = meta.get("use_subdir", False)
+        self.nmax_per_subdir = meta.get("nmax_per_subdir", 10_000)
+        self.attrs = meta.get("attrs", {})
+        for k, v in self.attrs.items():
+            setattr(self, k, v)
+
+    def __len__(self):
+        return self.ntotal
+
+    def __getitem__(self, i: int) -> GraphSample:
+        d = self.basedir
+        if self.use_subdir:
+            d = os.path.join(self.basedir, str(i // self.nmax_per_subdir))
+        with open(os.path.join(d, f"{self.label}-{i}.pkl"), "rb") as f:
+            return _from_dict(pickle.load(f))
+
+    def __iter__(self):
+        for i in range(self.ntotal):
+            yield self[i]
+
+
+def _to_dict(s: GraphSample) -> dict:
+    return {k: getattr(s, k) for k in GraphSample.__slots__ if k != "extras"}
+
+
+def _from_dict(d: dict) -> GraphSample:
+    return GraphSample(**d)

@@ -1,0 +1,2 @@
+from .batch import BucketSpec, GraphBatch, GraphSample, batch_shape_for_dataset, collate
+from .radius import radius_graph, radius_graph_pbc

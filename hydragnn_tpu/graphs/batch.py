@@ -1,0 +1,290 @@
+"""Static-shape padded graph batching for TPU/XLA.
+
+The reference (HydraGNN) relies on PyG's dynamic `Batch.from_data_list`
+(reference: hydragnn/preprocess/load_data.py:160) which produces ragged,
+shape-varying batches. XLA compiles one program per shape, so this module
+instead provides a jraph-style `GraphBatch` with explicit padding:
+
+* the **last graph slot** is the padding graph,
+* the **last node slot** is the padding node,
+* padding edges connect the padding node to itself,
+* boolean masks mark real vs padding entries.
+
+Bucketing (`BucketSpec`) rounds batch shapes up to a small set of sizes so
+recompilation is bounded while padding waste stays low.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class GraphBatch:
+    """A fixed-shape batch of graphs.
+
+    Shapes: N = padded node count, E = padded edge count, G = padded graph
+    count. All arrays are dense; `*_mask` distinguish real entries.
+
+    Label packing mirrors the reference's flat ``data.y`` + ``y_loc`` offset
+    table (reference: hydragnn/preprocess/graph_samples_checks_and_updates.py:237-278)
+    but with static per-head offsets: ``y_graph`` concatenates all graph-level
+    targets per graph, ``y_node`` concatenates all node-level targets per node.
+    """
+
+    x: jnp.ndarray            # [N, F] node input features
+    pos: jnp.ndarray          # [N, 3] positions
+    senders: jnp.ndarray      # [E] int32, edge source node index
+    receivers: jnp.ndarray    # [E] int32, edge destination node index
+    node_graph: jnp.ndarray   # [N] int32, graph id of each node
+    node_mask: jnp.ndarray    # [N] bool
+    edge_mask: jnp.ndarray    # [E] bool
+    graph_mask: jnp.ndarray   # [G] bool
+    y_graph: Optional[jnp.ndarray] = None   # [G, Dg] packed graph targets
+    y_node: Optional[jnp.ndarray] = None    # [N, Dn] packed node targets
+    edge_attr: Optional[jnp.ndarray] = None  # [E, Fe]
+    edge_shifts: Optional[jnp.ndarray] = None  # [E, 3] PBC displacement shifts
+    cell: Optional[jnp.ndarray] = None      # [G, 3, 3] lattice (PBC datasets)
+    energy: Optional[jnp.ndarray] = None    # [G, 1] reference energies (E-F training)
+    forces: Optional[jnp.ndarray] = None    # [N, 3] reference forces
+    # triplet indices for directional message passing (DimeNet) — computed on
+    # the host by graphs.triplets.add_triplets; indices into the edge arrays
+    idx_kj: Optional[jnp.ndarray] = None    # [T] edge index of (k->j)
+    idx_ji: Optional[jnp.ndarray] = None    # [T] edge index of (j->i)
+    triplet_mask: Optional[jnp.ndarray] = None  # [T] bool
+
+    @property
+    def num_nodes(self) -> int:
+        return self.x.shape[0]
+
+    @property
+    def num_edges(self) -> int:
+        return self.senders.shape[0]
+
+    @property
+    def num_graphs(self) -> int:
+        return self.graph_mask.shape[0]
+
+    def replace(self, **kw) -> "GraphBatch":  # convenience alias
+        return struct.dataclasses.replace(self, **kw)
+
+    def count_real_graphs(self) -> jnp.ndarray:
+        return jnp.sum(self.graph_mask.astype(jnp.int32))
+
+    def count_real_nodes(self) -> jnp.ndarray:
+        return jnp.sum(self.node_mask.astype(jnp.int32))
+
+
+class GraphSample:
+    """Host-side (numpy) single graph, pre-batching.
+
+    The analogue of a PyG ``Data`` object (torch_geometric.data.Data in the
+    reference), but a plain numpy container so the data pipeline never touches
+    jax until collation.
+    """
+
+    __slots__ = (
+        "x", "pos", "senders", "receivers", "edge_attr", "edge_shifts",
+        "y_graph", "y_node", "cell", "energy", "forces", "extras",
+    )
+
+    def __init__(
+        self,
+        x: np.ndarray,
+        pos: np.ndarray,
+        senders: np.ndarray,
+        receivers: np.ndarray,
+        edge_attr: Optional[np.ndarray] = None,
+        edge_shifts: Optional[np.ndarray] = None,
+        y_graph: Optional[np.ndarray] = None,
+        y_node: Optional[np.ndarray] = None,
+        cell: Optional[np.ndarray] = None,
+        energy: Optional[np.ndarray] = None,
+        forces: Optional[np.ndarray] = None,
+        **extras: Any,
+    ):
+        self.x = np.asarray(x, dtype=np.float32)
+        if self.x.ndim == 1:
+            self.x = self.x[:, None]
+        self.pos = np.asarray(pos, dtype=np.float32)
+        self.senders = np.asarray(senders, dtype=np.int32)
+        self.receivers = np.asarray(receivers, dtype=np.int32)
+        self.edge_attr = None if edge_attr is None else np.asarray(
+            edge_attr, dtype=np.float32)
+        if self.edge_attr is not None and self.edge_attr.ndim == 1:
+            self.edge_attr = self.edge_attr[:, None]
+        self.edge_shifts = None if edge_shifts is None else np.asarray(
+            edge_shifts, dtype=np.float32)
+        self.y_graph = None if y_graph is None else np.atleast_1d(
+            np.asarray(y_graph, dtype=np.float32)).reshape(-1)
+        self.y_node = None if y_node is None else np.asarray(
+            y_node, dtype=np.float32)
+        if self.y_node is not None and self.y_node.ndim == 1:
+            self.y_node = self.y_node[:, None]
+        self.cell = None if cell is None else np.asarray(cell, dtype=np.float32)
+        self.energy = None if energy is None else np.atleast_1d(
+            np.asarray(energy, dtype=np.float32)).reshape(-1)
+        self.forces = None if forces is None else np.asarray(
+            forces, dtype=np.float32).reshape(-1, 3)
+        self.extras = extras
+
+    @property
+    def num_nodes(self) -> int:
+        return self.x.shape[0]
+
+    @property
+    def num_edges(self) -> int:
+        return self.senders.shape[0]
+
+
+def _round_up(value: int, multiple: int) -> int:
+    return int(math.ceil(value / multiple) * multiple)
+
+
+class BucketSpec:
+    """Rounds (n_node, n_edge, n_graph) to a bounded set of shapes.
+
+    Node/edge budgets are rounded up to the next power-of-two-ish bucket
+    (1, 1.5, 2, 3, 4, 6, 8, ...) times ``multiple`` so that the number of
+    distinct compiled programs stays O(log(max_size)) while padding waste
+    stays under ~33%.
+    """
+
+    def __init__(self, multiple: int = 64):
+        self.multiple = multiple
+
+    def bucket(self, n: int) -> int:
+        n = max(n, 1)
+        m = self.multiple
+        target = _round_up(n, m)
+        # power-of-two with half-steps
+        p = m
+        while p < target:
+            if int(p * 1.5) >= target and (p * 3) % 2 == 0:
+                return int(p * 1.5)
+            p *= 2
+        return p
+
+    def shapes(self, n_node: int, n_edge: int, n_graph: int) -> Tuple[int, int, int]:
+        return (self.bucket(n_node + 1), self.bucket(n_edge + 1), n_graph + 1)
+
+
+def collate(
+    samples: Sequence[GraphSample],
+    n_node: Optional[int] = None,
+    n_edge: Optional[int] = None,
+    n_graph: Optional[int] = None,
+    bucket: Optional[BucketSpec] = None,
+    np_out: bool = False,
+) -> GraphBatch:
+    """Concatenate samples and pad to (n_node, n_edge, n_graph).
+
+    At least one padding graph and one padding node are always present
+    (jraph ``pad_with_graphs`` convention).
+    """
+    tot_n = sum(s.num_nodes for s in samples)
+    tot_e = sum(s.num_edges for s in samples)
+    ng = len(samples)
+    if bucket is None and (n_node is None or n_edge is None):
+        bucket = BucketSpec()
+    if n_node is None or n_edge is None or n_graph is None:
+        bn, be, bg = bucket.shapes(tot_n, tot_e, ng)
+        n_node = n_node or bn
+        n_edge = n_edge or be
+        n_graph = n_graph or bg
+    if tot_n >= n_node or ng >= n_graph or tot_e > n_edge:
+        raise ValueError(
+            f"batch ({tot_n} nodes, {tot_e} edges, {ng} graphs) does not fit "
+            f"padded shape ({n_node}, {n_edge}, {n_graph}); one padding "
+            f"node/graph slot is required")
+
+    fdim = samples[0].x.shape[1]
+    x = np.zeros((n_node, fdim), np.float32)
+    pos = np.zeros((n_node, 3), np.float32)
+    senders = np.full((n_edge,), n_node - 1, np.int32)
+    receivers = np.full((n_edge,), n_node - 1, np.int32)
+    node_graph = np.full((n_node,), n_graph - 1, np.int32)
+    node_mask = np.zeros((n_node,), bool)
+    edge_mask = np.zeros((n_edge,), bool)
+    graph_mask = np.zeros((n_graph,), bool)
+    graph_mask[:ng] = True
+
+    has_ea = samples[0].edge_attr is not None
+    edge_attr = (np.zeros((n_edge, samples[0].edge_attr.shape[1]), np.float32)
+                 if has_ea else None)
+    has_shift = samples[0].edge_shifts is not None
+    edge_shifts = np.zeros((n_edge, 3), np.float32) if has_shift else None
+    has_yg = samples[0].y_graph is not None
+    y_graph = (np.zeros((n_graph, samples[0].y_graph.shape[0]), np.float32)
+               if has_yg else None)
+    has_yn = samples[0].y_node is not None
+    y_node = (np.zeros((n_node, samples[0].y_node.shape[1]), np.float32)
+              if has_yn else None)
+    has_cell = samples[0].cell is not None
+    cell = np.zeros((n_graph, 3, 3), np.float32) if has_cell else None
+    has_en = samples[0].energy is not None
+    energy = np.zeros((n_graph, 1), np.float32) if has_en else None
+    has_f = samples[0].forces is not None
+    forces = np.zeros((n_node, 3), np.float32) if has_f else None
+
+    no, eo = 0, 0
+    for gi, s in enumerate(samples):
+        n, e = s.num_nodes, s.num_edges
+        x[no:no + n] = s.x
+        pos[no:no + n] = s.pos
+        senders[eo:eo + e] = s.senders + no
+        receivers[eo:eo + e] = s.receivers + no
+        node_graph[no:no + n] = gi
+        node_mask[no:no + n] = True
+        edge_mask[eo:eo + e] = True
+        if has_ea:
+            edge_attr[eo:eo + e] = s.edge_attr
+        if has_shift:
+            edge_shifts[eo:eo + e] = s.edge_shifts
+        if has_yg:
+            y_graph[gi] = s.y_graph
+        if has_yn:
+            y_node[no:no + n] = s.y_node
+        if has_cell:
+            cell[gi] = s.cell
+        if has_en:
+            energy[gi, 0] = s.energy[0]
+        if has_f:
+            forces[no:no + n] = s.forces
+        no += n
+        eo += e
+
+    conv = (lambda a: a) if np_out else jnp.asarray
+    opt = lambda a: None if a is None else conv(a)
+    return GraphBatch(
+        x=conv(x), pos=conv(pos), senders=conv(senders),
+        receivers=conv(receivers), node_graph=conv(node_graph),
+        node_mask=conv(node_mask), edge_mask=conv(edge_mask),
+        graph_mask=conv(graph_mask), y_graph=opt(y_graph), y_node=opt(y_node),
+        edge_attr=opt(edge_attr), edge_shifts=opt(edge_shifts), cell=opt(cell),
+        energy=opt(energy), forces=opt(forces),
+    )
+
+
+def batch_shape_for_dataset(
+    samples: Sequence[GraphSample], batch_size: int, bucket: Optional[BucketSpec] = None
+) -> Tuple[int, int, int]:
+    """Pick a single (n_node, n_edge, n_graph) that fits any `batch_size`
+    contiguous window of `samples` — one compiled program per dataset.
+
+    Replaces the reference's variable-graph-size handling
+    (hydragnn/preprocess/graph_samples_checks_and_updates.py:25-80) which just
+    *detects* variability; under XLA we instead bound it by padding.
+    """
+    bucket = bucket or BucketSpec()
+    max_n = max(s.num_nodes for s in samples)
+    max_e = max(s.num_edges for s in samples)
+    return (
+        bucket.bucket(max_n * batch_size + 1),
+        bucket.bucket(max_e * batch_size + 1),
+        batch_size + 1,
+    )

@@ -1,0 +1,2 @@
+from .base import BaseStack
+from .create import create_model, create_model_config, init_params, model_class

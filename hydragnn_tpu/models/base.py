@@ -1,0 +1,139 @@
+"""`BaseStack` — the shared encoder/multihead-decoder pattern of the zoo.
+
+Re-designs the reference's `Base` abstract stack
+(reference: hydragnn/models/Base.py:27-347) as a flax module:
+
+* encoder = `num_conv_layers` message-passing convs (subclass hook
+  `make_conv`), each followed by masked BatchNorm + activation
+  (reference: Base.py:122-128, 303-318),
+* decoder = one MLP shared across graph heads (`graph_shared`,
+  reference: Base.py:223-231) + per-head MLPs; node heads in `mlp`,
+  `mlp_per_node` or `conv` variants (reference: Base.py:262-290),
+* GaussianNLL variance widening `head_dim * (1 + var_output)`
+  (reference: Base.py:74-77, 255).
+
+Everything is static-shape over a padded `GraphBatch`; padding is masked in
+the BatchNorm statistics and the pooling, so outputs at padding slots are
+garbage-but-finite and ignored by the loss.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..config.config import HeadConfig, ModelConfig
+from ..graphs.batch import GraphBatch
+from ..ops.activations import activation_function_selection
+from ..ops.segment import global_mean_pool
+from .layers import MLP, MLPNode, MaskedBatchNorm, node_index_in_graph
+
+
+class BaseStack(nn.Module):
+    """Abstract conv stack + multihead decoder. Subclasses override
+    `make_conv` (and optionally `conv_args` / `initial_node_features` /
+    `use_batch_norm`)."""
+
+    cfg: ModelConfig
+    use_batch_norm: bool = True
+
+    # ------------------------------------------------------------- hooks --
+    def make_conv(self, in_dim: int, out_dim: int, idx: int,
+                  final: bool = False) -> nn.Module:
+        """`final` marks the last conv of a (sub)stack — GAT averages heads
+        there instead of concatenating (reference: GATStack.py:35-47)."""
+        raise NotImplementedError
+
+    def conv_args(self, batch: GraphBatch) -> Dict[str, Any]:
+        """Stack-specific precomputation (edge vectors, rbf, triplets...) —
+        reference: Base._conv_args overridden per stack (Base.py:130)."""
+        return {}
+
+    def initial_node_features(self, batch: GraphBatch, cargs) -> jnp.ndarray:
+        return batch.x
+
+    # ------------------------------------------------------------ forward --
+    @nn.compact
+    def __call__(self, batch: GraphBatch, train: bool = False):
+        cfg = self.cfg
+        act = activation_function_selection(cfg.activation)
+        cargs = self.conv_args(batch)
+        x, pos = self.encode(batch, cargs, act, train)
+        return self.decode(x, pos, batch, cargs, act, train)
+
+    def encode(self, batch: GraphBatch, cargs, act, train: bool):
+        """Conv-stack encoder (reference: Base.py:303-318). Subclasses with
+        extra threaded state (PAINN vector channel, MACE irreps) override."""
+        cfg = self.cfg
+        x = self.initial_node_features(batch, cargs)
+        pos = batch.pos
+        in_dim = x.shape[-1]
+        for i in range(cfg.num_conv_layers):
+            conv = self.make_conv(in_dim, cfg.hidden_dim, i,
+                                  final=(i == cfg.num_conv_layers - 1))
+            x, pos = conv(x, pos, batch, cargs)
+            if self.use_batch_norm:
+                x = MaskedBatchNorm(name=f"feature_norm_{i}")(
+                    x, batch.node_mask, use_running_average=not train)
+            x = act(x)
+            in_dim = cfg.hidden_dim
+        return x, pos
+
+    def decode(self, x, pos, batch: GraphBatch, cargs, act, train: bool):
+        """Multihead decoder (reference: Base.py:320-347)."""
+        cfg = self.cfg
+        num_graphs = batch.num_graphs
+        x_graph = global_mean_pool(x, batch.node_graph, num_graphs, batch.node_mask)
+
+        graph_heads = [h for h in cfg.heads if h.head_type == "graph"]
+        shared = None
+        if graph_heads:
+            g0 = graph_heads[0]
+            shared = MLP([g0.dim_sharedlayers] * g0.num_sharedlayers,
+                         activation=act, activate_final=True,
+                         name="graph_shared")(x_graph)
+
+        outputs: List[jnp.ndarray] = []
+        outputs_var: List[jnp.ndarray] = []
+        widen = 1 + cfg.var_output
+        for ih, head in enumerate(cfg.heads):
+            if head.head_type == "graph":
+                dims = list(head.dim_headlayers) + [head.output_dim * widen]
+                out = MLP(dims, activation=act, name=f"head_{ih}")(shared)
+            elif head.node_arch in ("mlp", "mlp_per_node"):
+                idx = None
+                if head.node_arch == "mlp_per_node":
+                    idx = node_index_in_graph(batch.node_graph, num_graphs)
+                out = MLPNode(
+                    hidden_dims=head.dim_headlayers,
+                    output_dim=head.output_dim * widen,
+                    num_nodes=max(cfg.num_nodes, 1),
+                    node_type=head.node_arch,
+                    activation=act,
+                    name=f"head_{ih}")(x, idx)
+            elif head.node_arch == "conv":
+                # conv-type node head: fresh convs of the same stack type
+                # (reference: Base.py:262-290 _init_node_conv + forward :334-341)
+                h, hpos = x, pos
+                hdims = list(head.dim_headlayers) + [head.output_dim * widen]
+                hin = h.shape[-1]
+                for li, hd in enumerate(hdims):
+                    conv = self.make_conv(hin, hd, cfg.num_conv_layers + 100 * ih + li,
+                                          final=(li == len(hdims) - 1))
+                    h, hpos = conv(h, hpos, batch, cargs)
+                    if self.use_batch_norm:
+                        h = MaskedBatchNorm(name=f"head_{ih}_norm_{li}")(
+                            h, batch.node_mask, use_running_average=not train)
+                    h = act(h)
+                    hin = hd
+                out = h
+            else:
+                raise ValueError(f"unknown node head type {head.node_arch}")
+            outputs.append(out[..., :head.output_dim])
+            if cfg.var_output:
+                outputs_var.append(out[..., head.output_dim:] ** 2)
+        if cfg.var_output:
+            return outputs, outputs_var
+        return outputs, None

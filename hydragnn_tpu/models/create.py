@@ -1,0 +1,93 @@
+"""Model factory — maps `model_type` strings to stack classes.
+
+reference: hydragnn/models/create.py:35-429 (create_model_config/create_model
+with per-architecture required-hyperparameter asserts :146-394).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..config.config import ModelConfig, build_model_config
+from .base import BaseStack
+from .egnn import EGCLStack
+from .schnet import SCFStack
+from .stacks import (CGCNNStack, GATStack, GINStack, MFCStack, PNAPlusStack,
+                     PNAStack, SAGEStack)
+
+
+def _require(cfg: ModelConfig, *fields: str):
+    for f in fields:
+        assert getattr(cfg, f) is not None, (
+            f"{cfg.model_type} requires architecture key '{f}'")
+
+
+def model_class(model_type: str):
+    from .dimenet import DIMEStack
+    from .mace import MACEStack
+    from .painn import PAINNStack
+    from .pnaeq import PNAEqStack
+    registry = {
+        "GIN": GINStack,
+        "SAGE": SAGEStack,
+        "GAT": GATStack,
+        "MFC": MFCStack,
+        "CGCNN": CGCNNStack,
+        "PNA": PNAStack,
+        "PNAPlus": PNAPlusStack,
+        "SchNet": SCFStack,
+        "EGNN": EGCLStack,
+        "DimeNet": DIMEStack,
+        "PAINN": PAINNStack,
+        "PNAEq": PNAEqStack,
+        "MACE": MACEStack,
+    }
+    if model_type not in registry:
+        raise ValueError(f"unknown model_type '{model_type}'; "
+                         f"known: {sorted(registry)}")
+    return registry[model_type]
+
+
+def create_model_config(config: Dict[str, Any]) -> BaseStack:
+    """Completed JSON config dict -> flax model (reference: create.py:35)."""
+    return create_model(build_model_config(config))
+
+
+def create_model(cfg: ModelConfig) -> BaseStack:
+    """Validate per-arch hyperparams and instantiate
+    (reference: create.py:82-429)."""
+    mt = cfg.model_type
+    if mt in ("PNA", "PNAPlus", "PNAEq"):
+        _require(cfg, "pna_deg")
+    if mt == "PNAPlus":
+        _require(cfg, "radius", "num_radial", "envelope_exponent")
+    if mt == "SchNet":
+        _require(cfg, "radius", "num_gaussians", "num_filters")
+    if mt == "MFC":
+        _require(cfg, "max_neighbours")
+    if mt == "DimeNet":
+        _require(cfg, "radius", "num_radial", "num_spherical", "int_emb_size",
+                 "basis_emb_size", "out_emb_size", "num_before_skip",
+                 "num_after_skip", "envelope_exponent")
+    if mt in ("PAINN", "PNAEq"):
+        _require(cfg, "radius")
+    if mt == "MACE":
+        _require(cfg, "radius", "max_ell", "node_max_ell", "avg_num_neighbors")
+    if mt == "CGCNN" and cfg.hidden_dim != cfg.input_dim:
+        # CGConv cannot change width (reference: CGCNNStack.py:25-31)
+        cfg = _replace(cfg, hidden_dim=cfg.input_dim)
+    return model_class(mt)(cfg=cfg)
+
+
+def _replace(cfg: ModelConfig, **kw) -> ModelConfig:
+    import dataclasses
+    return dataclasses.replace(cfg, **kw)
+
+
+def init_params(model: BaseStack, sample_batch, seed: int = 0):
+    """Initialize parameter pytree (reference seeds torch.manual_seed(0) at
+    create.py:123; we use an explicit PRNGKey)."""
+    variables = model.init(jax.random.PRNGKey(seed), sample_batch, train=False)
+    return variables

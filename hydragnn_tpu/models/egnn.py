@@ -1,0 +1,72 @@
+"""EGNN stack — E(n)-equivariant graph conv layers.
+
+reference: hydragnn/models/EGCLStack.py:21-245 (E_GCL: edge MLP over
+[h_i, h_j, r^2, edge_attr], node MLP over aggregated messages, optional
+coordinate model; tanh-bounded coordinate step with learnable range).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops import segment as seg
+from ..ops.geometry import edge_vectors
+from .base import BaseStack
+from .layers import MLP
+
+
+class EGCL(nn.Module):
+    """reference: EGCLStack.py:116-236."""
+    out_dim: int
+    hidden_dim: int
+    edge_dim: int = 0
+    equivariant: bool = False
+    tanh: bool = True
+    coords_weight: float = 1.0
+    recurrent: bool = False
+
+    @nn.compact
+    def __call__(self, x, pos, batch, cargs):
+        send, recv = batch.senders, batch.receivers
+        vec, length = edge_vectors(pos, send, recv, batch.edge_shifts)
+        radial = (length ** 2)[:, None]
+        # norm_diff=True (reference: EGCLStack.py:219-224)
+        coord_diff = vec / (length + 1.0)[:, None]
+
+        parts = [x[recv], x[send], radial]
+        if self.edge_dim and batch.edge_attr is not None:
+            parts.append(batch.edge_attr)
+        m = MLP([self.hidden_dim, self.hidden_dim], activation=jax.nn.relu,
+                activate_final=True, name="edge_mlp")(
+            jnp.concatenate(parts, axis=-1))
+
+        if self.equivariant:
+            phi = MLP([self.hidden_dim, 1], activation=jax.nn.relu,
+                      use_bias=True, name="coord_mlp")(m)
+            if self.tanh:
+                coords_range = self.param(
+                    "coords_range", nn.initializers.constant(3.0), (1,))
+                phi = jnp.tanh(phi) * coords_range
+            trans = jnp.clip(coord_diff * phi, -100.0, 100.0)
+            agg_pos = seg.segment_mean(trans, recv, pos.shape[0], batch.edge_mask)
+            pos = pos + agg_pos * self.coords_weight
+
+        agg = seg.segment_sum(m, recv, x.shape[0], batch.edge_mask)
+        h = MLP([self.hidden_dim, self.out_dim], activation=jax.nn.relu,
+                name="node_mlp")(jnp.concatenate([x, agg], axis=-1))
+        if self.recurrent and h.shape == x.shape:
+            h = x + h
+        return h, pos
+
+
+class EGCLStack(BaseStack):
+    """reference: hydragnn/models/EGCLStack.py:21 — feature layers are
+    identity (no BatchNorm, EGCLStack.py:41)."""
+    use_batch_norm: bool = False
+
+    def make_conv(self, in_dim, out_dim, idx, final=False):
+        return EGCL(out_dim=out_dim, hidden_dim=self.cfg.hidden_dim,
+                    edge_dim=int(self.cfg.edge_dim or 0),
+                    equivariant=self.cfg.equivariance,
+                    name=f"conv_{idx}")

@@ -1,0 +1,113 @@
+"""Shared building-block layers for the model zoo.
+
+TPU notes: every layer here is a dense matmul over [N, F] node arrays —
+MXU-friendly, no per-node Python loops. Masking replaces dynamic shapes.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class MLP(nn.Module):
+    """Plain MLP: hidden dims with activation between, optional final act.
+
+    Used for edge/node message MLPs and decoder heads (reference:
+    hydragnn/models/Base.py:219-297 Sequential(Linear, act, ...) pattern).
+    """
+    features: Sequence[int]
+    activation: Callable = jax.nn.relu
+    activate_final: bool = False
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, use_bias=self.use_bias, name=f"dense_{i}")(x)
+            if i < len(self.features) - 1 or self.activate_final:
+                x = self.activation(x)
+        return x
+
+
+class MaskedBatchNorm(nn.Module):
+    """BatchNorm over real (masked) nodes only.
+
+    Replaces torch BatchNorm1d feature layers (reference: Base.py:122-128).
+    Statistics are computed over unmasked entries; under pjit over a data
+    mesh the sums are global, so SyncBatchNorm semantics
+    (reference: distributed.py:282-283) come for free.
+    """
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, mask, use_running_average: bool = False):
+        feat = x.shape[-1]
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((feat,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((feat,), jnp.float32))
+        scale = self.param("scale", nn.initializers.ones, (feat,))
+        bias = self.param("bias", nn.initializers.zeros, (feat,))
+
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            m = mask.astype(x.dtype)[:, None]
+            count = jnp.maximum(jnp.sum(m), 1.0)
+            mean = jnp.sum(x * m, axis=0) / count
+            var = jnp.sum(m * (x - mean) ** 2, axis=0) / count
+            if not self.is_initializing():
+                ra_mean.value = self.momentum * ra_mean.value + (1 - self.momentum) * mean
+                ra_var.value = self.momentum * ra_var.value + (1 - self.momentum) * var
+        y = (x - mean) * jax.lax.rsqrt(var + self.epsilon)
+        return y * scale + bias
+
+
+class MLPNode(nn.Module):
+    """Node-level decoder head (reference: Base.py:467-527 `MLPNode`).
+
+    ``node_type`` "mlp": one MLP shared by all nodes. "mlp_per_node": a
+    separate parameter bank per node index within its graph (requires fixed
+    graph size, enforced in config completion — reference:
+    config_utils.py:193-199). The per-node variant is a batched einsum over a
+    [num_nodes, in, out] weight bank — one big MXU matmul, not a Python loop
+    over per-node MLPs like the reference.
+    """
+    hidden_dims: Sequence[int]
+    output_dim: int
+    num_nodes: int                 # bank size for mlp_per_node
+    node_type: str = "mlp"         # "mlp" | "mlp_per_node"
+    activation: Callable = jax.nn.relu
+
+    @nn.compact
+    def __call__(self, x, node_index_in_graph=None):
+        dims = list(self.hidden_dims) + [self.output_dim]
+        if self.node_type == "mlp":
+            return MLP(dims, activation=self.activation)(x)
+        assert node_index_in_graph is not None
+        idx = jnp.clip(node_index_in_graph, 0, self.num_nodes - 1)
+        h = x
+        in_dim = x.shape[-1]
+        for li, f in enumerate(dims):
+            w = self.param(f"w_{li}", nn.initializers.lecun_normal(),
+                           (self.num_nodes, in_dim, f))
+            b = self.param(f"b_{li}", nn.initializers.zeros, (self.num_nodes, f))
+            h = jnp.einsum("ni,nif->nf", h, w[idx]) + b[idx]
+            if li < len(dims) - 1:
+                h = self.activation(h)
+            in_dim = f
+        return h
+
+
+def node_index_in_graph(node_graph, num_graphs):
+    """Intra-graph node index for each node of a padded batch: the node's
+    position minus the first position of its graph. Used by mlp_per_node."""
+    n = node_graph.shape[0]
+    counts = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), node_graph, num_graphs)
+    starts = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                              jnp.cumsum(counts)[:-1].astype(jnp.int32)])
+    return jnp.arange(n, dtype=jnp.int32) - starts[node_graph]

@@ -1,0 +1,27 @@
+"""MACE stack — higher-body-order equivariant message passing.
+
+reference: hydragnn/models/MACEStack.py:70-741 + mace_utils/ (spherical
+harmonic edge attrs, Bessel/Chebyshev/Gaussian radial with polynomial cutoff
+and Agnesi/Soft transforms, RealAgnosticAttResidualInteractionBlock,
+EquivariantProductBasisBlock with Clebsch-Gordan symmetric contraction,
+per-layer multihead readouts summed across layers).
+
+Implementation in progress: irreps algebra and CG contractions are being
+built in ops/irreps.py without e3nn (sympy/scipy for coefficients, jnp for
+the contractions).
+"""
+from __future__ import annotations
+
+from .base import BaseStack
+
+
+class MACEStack(BaseStack):
+    def make_conv(self, in_dim, out_dim, idx, final=False):
+        raise NotImplementedError(
+            "MACE is not implemented yet in hydragnn_tpu; "
+            "its irreps/CG machinery (ops/irreps.py) is under construction")
+
+    def __post_init__(self):
+        super().__post_init__()
+        raise NotImplementedError(
+            "MACE is not implemented yet in hydragnn_tpu")

@@ -1,0 +1,95 @@
+"""Activation and loss registries.
+
+Mirrors the reference's registries key-for-key
+(reference: hydragnn/utils/model/model.py:29-60) so configs run unchanged.
+PReLU is expressed as leaky-relu with the torch default init slope 0.25 —
+a learnable slope would make activations stateful; configs that need a
+learnable slope can use a model-level flag later.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+ACTIVATIONS = {
+    "relu": jax.nn.relu,
+    "selu": jax.nn.selu,
+    "prelu": lambda x: jax.nn.leaky_relu(x, 0.25),
+    "elu": jax.nn.elu,
+    "lrelu_01": lambda x: jax.nn.leaky_relu(x, 0.1),
+    "lrelu_025": lambda x: jax.nn.leaky_relu(x, 0.25),
+    "lrelu_05": lambda x: jax.nn.leaky_relu(x, 0.5),
+    "sigmoid": jax.nn.sigmoid,
+    "silu": jax.nn.silu,
+    "tanh": jnp.tanh,
+    "gelu": jax.nn.gelu,
+}
+
+
+def activation_function_selection(name: str) -> Callable:
+    if name not in ACTIVATIONS:
+        raise ValueError(f"unknown activation '{name}'; known: {sorted(ACTIVATIONS)}")
+    return ACTIVATIONS[name]
+
+
+def _mse(pred, target):
+    return jnp.mean((pred - target) ** 2)
+
+
+def _mae(pred, target):
+    return jnp.mean(jnp.abs(pred - target))
+
+
+def _smooth_l1(pred, target, beta: float = 1.0):
+    d = jnp.abs(pred - target)
+    return jnp.mean(jnp.where(d < beta, 0.5 * d * d / beta, d - 0.5 * beta))
+
+
+def _rmse(pred, target):
+    return jnp.sqrt(_mse(pred, target))
+
+
+def _gaussian_nll(pred, target, var=None, eps: float = 1e-6):
+    var = jnp.maximum(var, eps)
+    return jnp.mean(0.5 * (jnp.log(var) + (pred - target) ** 2 / var))
+
+
+LOSSES = {
+    "mse": _mse,
+    "mae": _mae,
+    "smooth_l1": _smooth_l1,
+    "rmse": _rmse,
+    "GaussianNLLLoss": _gaussian_nll,
+}
+
+
+def loss_function_selection(name: str) -> Callable:
+    if name not in LOSSES:
+        raise ValueError(f"unknown loss '{name}'; known: {sorted(LOSSES)}")
+    return LOSSES[name]
+
+
+def masked_loss(name: str, pred, target, mask, var=None):
+    """Loss over masked (real) entries only — padding must not contribute.
+
+    The masked mean matches the reference's unpadded elementwise means.
+    """
+    mask_f = mask.reshape(mask.shape + (1,) * (pred.ndim - mask.ndim))
+    count = jnp.maximum(jnp.sum(mask_f * jnp.ones_like(pred)), 1.0)
+    if name == "mse":
+        return jnp.sum(mask_f * (pred - target) ** 2) / count
+    if name == "mae":
+        return jnp.sum(mask_f * jnp.abs(pred - target)) / count
+    if name == "rmse":
+        return jnp.sqrt(jnp.sum(mask_f * (pred - target) ** 2) / count)
+    if name == "smooth_l1":
+        d = jnp.abs(pred - target)
+        v = jnp.where(d < 1.0, 0.5 * d * d, d - 0.5)
+        return jnp.sum(mask_f * v) / count
+    if name == "GaussianNLLLoss":
+        v = jnp.maximum(var, 1e-6)
+        nll = 0.5 * (jnp.log(v) + (pred - target) ** 2 / v)
+        return jnp.sum(mask_f * nll) / count
+    raise ValueError(f"unknown loss '{name}'")

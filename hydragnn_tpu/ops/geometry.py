@@ -1,0 +1,21 @@
+"""Edge geometry helpers.
+
+Replaces reference's get_edge_vectors_and_lengths
+(reference: hydragnn/utils/model/operations.py:20) with PBC shift support.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def edge_vectors(pos, senders, receivers, edge_shifts=None, eps: float = 1e-9):
+    """Displacement sender->receiver view: vec_k = pos[send_k] + shift_k - pos[recv_k].
+
+    Returns (vec [E,3], length [E]). Padding edges (sender == receiver ==
+    padding node, zero shift) get length 0; callers mask at aggregation.
+    """
+    vec = pos[senders] - pos[receivers]
+    if edge_shifts is not None:
+        vec = vec + edge_shifts
+    length = jnp.sqrt(jnp.sum(vec * vec, axis=-1) + eps)
+    return vec, length

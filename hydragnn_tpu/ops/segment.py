@@ -1,0 +1,96 @@
+"""Masked segment ops — the TPU replacement for torch_scatter.
+
+The reference uses torch_scatter's scatter_add/scatter_mean
+(reference: hydragnn/models/Base.py:18,375; EGCLStack.py:239-245;
+utils/model/model.py:214-221). On TPU these lower to XLA scatter/gather which
+fuse well; padding entries are handled by masks rather than dynamic shapes.
+
+All functions take `num_segments` statically so XLA sees fixed shapes.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def segment_sum(data, segment_ids, num_segments, mask=None):
+    if mask is not None:
+        data = jnp.where(_bcast(mask, data), data, 0.0)
+    return jax.ops.segment_sum(data, segment_ids, num_segments)
+
+
+def segment_count(segment_ids, num_segments, mask=None):
+    ones = jnp.ones((segment_ids.shape[0],), jnp.float32)
+    if mask is not None:
+        ones = jnp.where(mask, ones, 0.0)
+    return jax.ops.segment_sum(ones, segment_ids, num_segments)
+
+
+def segment_mean(data, segment_ids, num_segments, mask=None):
+    total = segment_sum(data, segment_ids, num_segments, mask)
+    count = segment_count(segment_ids, num_segments, mask)
+    count = jnp.maximum(count, 1.0)
+    return total / count.reshape(count.shape + (1,) * (total.ndim - 1))
+
+
+def segment_max(data, segment_ids, num_segments, mask=None, neutral=-1e30):
+    if mask is not None:
+        data = jnp.where(_bcast(mask, data), data, neutral)
+    out = jax.ops.segment_max(data, segment_ids, num_segments)
+    # segments with no real entries produce `neutral` (or -inf); clamp to 0
+    return jnp.where(out <= neutral, 0.0, out)
+
+
+def segment_min(data, segment_ids, num_segments, mask=None, neutral=1e30):
+    if mask is not None:
+        data = jnp.where(_bcast(mask, data), data, neutral)
+    out = jax.ops.segment_min(data, segment_ids, num_segments)
+    return jnp.where(out >= neutral, 0.0, out)
+
+
+def segment_std(data, segment_ids, num_segments, mask=None, eps=1e-5):
+    """Per-segment standard deviation (PNA 'std' aggregator,
+    reference: torch_geometric PNAConv used at hydragnn/models/PNAStack.py:28-51)."""
+    mean = segment_mean(data, segment_ids, num_segments, mask)
+    sq_mean = segment_mean(data * data, segment_ids, num_segments, mask)
+    var = jnp.maximum(sq_mean - mean * mean, 0.0)
+    return jnp.sqrt(var + eps)
+
+
+def segment_softmax(logits, segment_ids, num_segments, mask=None):
+    """Numerically-stable softmax within segments (GAT attention,
+    reference: torch_geometric GATConv used at hydragnn/models/GATStack.py:29)."""
+    if mask is not None:
+        logits = jnp.where(_bcast(mask, logits), logits, -1e30)
+    seg_max = jax.ops.segment_max(logits, segment_ids, num_segments)
+    seg_max = jnp.where(seg_max <= -1e30, 0.0, seg_max)
+    shifted = logits - seg_max[segment_ids]
+    exp = jnp.exp(shifted)
+    if mask is not None:
+        exp = jnp.where(_bcast(mask, exp), exp, 0.0)
+    denom = jax.ops.segment_sum(exp, segment_ids, num_segments)
+    denom = jnp.maximum(denom, 1e-16)
+    return exp / denom[segment_ids]
+
+
+def global_mean_pool(node_feats, node_graph, num_graphs, node_mask):
+    """Masked graph-level mean pooling
+    (reference: torch_geometric global_mean_pool at hydragnn/models/Base.py:320-323)."""
+    return segment_mean(node_feats, node_graph, num_graphs, node_mask)
+
+
+def global_sum_pool(node_feats, node_graph, num_graphs, node_mask):
+    return segment_sum(node_feats, node_graph, num_graphs, node_mask)
+
+
+def degree(receivers, num_nodes, edge_mask=None):
+    """In-degree per node (reference: torch_geometric.utils.degree used by
+    hydragnn/utils/model/model.py:141-160 for PNA histograms)."""
+    return segment_count(receivers, num_nodes, edge_mask)
+
+
+def _bcast(mask, data):
+    """Broadcast a [K] mask against [K, ...] data."""
+    return mask.reshape(mask.shape + (1,) * (data.ndim - mask.ndim))

@@ -1,0 +1,86 @@
+"""Spherical Bessel / spherical-harmonic bases for DimeNet.
+
+reference: torch_geometric's BesselBasisLayer/SphericalBasisLayer used at
+hydragnn/models/DIMEStack.py:65-66. The reference relies on sympy codegen;
+here the basis is closed-form jnp: spherical Bessel j_l via upward
+recurrence, Legendre P_l via recurrence, zeros of j_l precomputed once with
+scipy at import time.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax.numpy as jnp
+
+from .basis import envelope
+
+
+@functools.lru_cache(maxsize=None)
+def spherical_bessel_zeros(num_l: int, num_n: int) -> np.ndarray:
+    """zeros[l, n] = (n+1)-th positive zero of j_l (host precompute)."""
+    from scipy import optimize, special
+    zeros = np.zeros((num_l, num_n))
+    # j_0 zeros are exactly k*pi; use them to bracket successive j_l zeros
+    grid = np.arange(1, num_n + num_l + 2) * np.pi
+    prev = grid  # zeros of j_0
+    zeros[0] = grid[:num_n]
+    for l in range(1, num_l):
+        f = lambda x: special.spherical_jn(l, x)
+        cur = []
+        # zeros of j_l interlace those of j_{l-1}
+        for a, b in zip(prev[:-1], prev[1:]):
+            cur.append(optimize.brentq(f, a + 1e-9, b - 1e-9))
+        prev = np.asarray(cur)
+        zeros[l] = prev[:num_n]
+    return zeros
+
+
+def spherical_jn(l_max: int, x):
+    """j_0..j_{l_max} at x via upward recurrence. Returns list of arrays."""
+    x_safe = jnp.where(jnp.abs(x) < 1e-7, 1e-7, x)
+    j0 = jnp.sin(x_safe) / x_safe
+    out = [j0]
+    if l_max >= 1:
+        j1 = jnp.sin(x_safe) / x_safe ** 2 - jnp.cos(x_safe) / x_safe
+        out.append(j1)
+    for l in range(2, l_max + 1):
+        out.append((2 * l - 1) / x_safe * out[-1] - out[-2])
+    return out
+
+
+def legendre(l_max: int, x):
+    """P_0..P_{l_max}(x) via recurrence. Returns list of arrays."""
+    out = [jnp.ones_like(x)]
+    if l_max >= 1:
+        out.append(x)
+    for l in range(2, l_max + 1):
+        out.append(((2 * l - 1) * x * out[-1] - (l - 1) * out[-2]) / l)
+    return out
+
+
+def spherical_basis(d, angle, cutoff: float, num_spherical: int,
+                    num_radial: int, envelope_exponent: int = 5):
+    """sbf[t, l*num_radial + n] = env(d/c) j_l(z_ln d/c) P~_l(cos angle).
+
+    `d` is the k->j edge length of each triplet, `angle` the (i,j,k) angle —
+    matching SphericalBasisLayer(dist[idx_kj], angle) in the reference stack.
+    """
+    from scipy import special
+    zeros = spherical_bessel_zeros(num_spherical, num_radial)
+    # normalizer 1/|j_{l+1}(z_ln)| (DimeNet appendix)
+    norm = np.zeros_like(zeros)
+    for l in range(num_spherical):
+        norm[l] = 1.0 / np.abs(special.spherical_jn(l + 1, zeros[l]))
+    x = d / cutoff
+    env = envelope(x, envelope_exponent)
+    cos_a = jnp.cos(angle)
+    pl = legendre(num_spherical - 1, cos_a)        # list of [T]
+    parts = []
+    for l in range(num_spherical):
+        z = jnp.asarray(zeros[l], d.dtype)          # [num_radial]
+        jl = spherical_jn(l, x[..., None] * z)[l]   # [T, num_radial]
+        yl = np.sqrt((2 * l + 1) / (4 * np.pi)) * pl[l]
+        parts.append(env[..., None] * jl * jnp.asarray(norm[l], d.dtype)
+                     * yl[..., None])
+    return jnp.concatenate(parts, axis=-1)          # [T, L*N]

@@ -1,0 +1,3 @@
+from .load_data import create_dataloaders, split_dataset, stratified_sampling
+from .transforms import (build_graph_sample, normalize_rotation,
+                         update_atom_features, update_predicted_values)

@@ -1,0 +1,96 @@
+"""Dataset splitting + dataloader creation.
+
+reference: hydragnn/preprocess/load_data.py:206-408
+(`dataset_loading_and_splitting`, `create_dataloaders`, `split_dataset`) and
+utils/datasets/compositional_data_splitting.py:117 (stratified-by-composition
+splits). The serialized/raw format pipeline lives in datasets/.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..datasets.loader import GraphDataLoader
+from ..graphs.batch import GraphSample
+
+
+def split_dataset(dataset: Sequence[GraphSample], perc_train: float,
+                  stratify_splitting: bool = False, seed: int = 0):
+    """Random or composition-stratified train/val/test split
+    (reference: load_data.py:299-319; val and test each get
+    (1-perc_train)/2)."""
+    n = len(dataset)
+    if not stratify_splitting:
+        rng = np.random.RandomState(seed)
+        order = rng.permutation(n)
+        return _split_by_order(dataset, order, perc_train)
+    # stratified by elemental composition (reference:
+    # compositional_data_splitting.py:117-155): category = multiset of node
+    # types (first input feature column, rounded)
+    cats: Dict[tuple, List[int]] = {}
+    for i, s in enumerate(dataset):
+        types = np.round(np.asarray(s.x[:, 0]), 6)
+        vals, counts = np.unique(types, return_counts=True)
+        key = tuple(zip(vals.tolist(), counts.tolist()))
+        cats.setdefault(key, []).append(i)
+    rng = np.random.RandomState(seed)
+    tr, va, te = [], [], []
+    for key in sorted(cats.keys()):
+        idx = np.asarray(cats[key])
+        rng.shuffle(idx)
+        ntr = int(round(len(idx) * perc_train))
+        nva = int(round(len(idx) * (1 - perc_train) / 2))
+        tr += idx[:ntr].tolist()
+        va += idx[ntr:ntr + nva].tolist()
+        te += idx[ntr + nva:].tolist()
+    return ([dataset[i] for i in tr], [dataset[i] for i in va],
+            [dataset[i] for i in te])
+
+
+def _split_by_order(dataset, order, perc_train):
+    n = len(order)
+    ntr = int(round(n * perc_train))
+    nva = int(round(n * (1 - perc_train) / 2))
+    tr = [dataset[i] for i in order[:ntr]]
+    va = [dataset[i] for i in order[ntr:ntr + nva]]
+    te = [dataset[i] for i in order[ntr + nva:]]
+    return tr, va, te
+
+
+def create_dataloaders(trainset, valset, testset, batch_size: int,
+                       num_shards: int = 1, seed: int = 0,
+                       n_node_per_shard: Optional[int] = None,
+                       n_edge_per_shard: Optional[int] = None):
+    """reference: load_data.py:225-296 — DataLoader + DistributedSampler;
+    here one static-shape loader per split, all sharing the max padded shape
+    so train/val/test reuse one compiled program."""
+    if n_node_per_shard is None or n_edge_per_shard is None:
+        all_samples = list(trainset) + list(valset) + list(testset)
+        g = max(batch_size // num_shards, 1)
+        from ..graphs.batch import BucketSpec
+        b = BucketSpec(multiple=64)
+        n_node_per_shard = b.bucket(max(s.num_nodes for s in all_samples) * g + 1)
+        n_edge_per_shard = b.bucket(max(s.num_edges for s in all_samples) * g + 1)
+    mk = lambda ds, shuffle: GraphDataLoader(
+        ds, batch_size, shuffle=shuffle, seed=seed, num_shards=num_shards,
+        n_node_per_shard=n_node_per_shard, n_edge_per_shard=n_edge_per_shard,
+        drop_last=shuffle)
+    return mk(trainset, True), mk(valset, False), mk(testset, False)
+
+
+def stratified_sampling(dataset: Sequence[GraphSample], perc: float,
+                        seed: int = 0) -> List[GraphSample]:
+    """Subsample keeping per-category (graph-size) proportions
+    (reference: preprocess/stratified_sampling.py:7-50)."""
+    cats: Dict[int, List[int]] = {}
+    for i, s in enumerate(dataset):
+        cats.setdefault(s.num_nodes, []).append(i)
+    rng = np.random.RandomState(seed)
+    keep = []
+    for key in sorted(cats.keys()):
+        idx = np.asarray(cats[key])
+        rng.shuffle(idx)
+        keep += idx[:max(1, int(round(len(idx) * perc)))].tolist()
+    return [dataset[i] for i in sorted(keep)]

@@ -1,0 +1,141 @@
+"""Sample-level transforms: feature/target selection, graph construction,
+rotation normalization.
+
+reference: hydragnn/preprocess/graph_samples_checks_and_updates.py:237-292
+(`update_predicted_values` packs selected targets into flat y + y_loc;
+`update_atom_features` selects input columns) and
+serialized_dataset_loader.py:123-171 (rotation normalization, radius graph,
+edge-length features).
+
+TPU difference: targets pack into dense per-graph (`y_graph`) / per-node
+(`y_node`) arrays with static offsets instead of a flat ragged `y`.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..graphs.batch import GraphSample
+from ..graphs.radius import radius_graph, radius_graph_pbc
+
+
+def update_predicted_values(types: Sequence[str], indices: Sequence[int],
+                            graph_feats: np.ndarray,
+                            node_feats: np.ndarray,
+                            graph_feature_dims: Sequence[int],
+                            node_feature_dims: Sequence[int],
+                            ) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+    """Select per-config targets (reference: :237-278). Returns
+    (y_graph [Dg], y_node [N, Dn])."""
+    g_parts, n_parts = [], []
+    g_offsets = np.concatenate([[0], np.cumsum(graph_feature_dims)]).astype(int)
+    n_offsets = np.concatenate([[0], np.cumsum(node_feature_dims)]).astype(int)
+    for t, i in zip(types, indices):
+        if t == "graph":
+            g_parts.append(np.atleast_1d(
+                graph_feats[g_offsets[i]:g_offsets[i + 1]]))
+        elif t == "node":
+            n_parts.append(node_feats[:, n_offsets[i]:n_offsets[i + 1]])
+        else:
+            raise ValueError(f"unknown output type {t}")
+    y_graph = np.concatenate(g_parts) if g_parts else None
+    y_node = np.concatenate(n_parts, axis=1) if n_parts else None
+    return y_graph, y_node
+
+
+def update_atom_features(input_indices: Sequence[int], node_feats: np.ndarray,
+                         node_feature_dims: Sequence[int]) -> np.ndarray:
+    """Select input feature columns (reference: :281-292)."""
+    offsets = np.concatenate([[0], np.cumsum(node_feature_dims)]).astype(int)
+    cols = [node_feats[:, offsets[i]:offsets[i + 1]] for i in input_indices]
+    return np.concatenate(cols, axis=1)
+
+
+def normalize_rotation(pos: np.ndarray) -> np.ndarray:
+    """Rotate to principal axes (reference: torch_geometric NormalizeRotation
+    used at serialized_dataset_loader.py:123-125): eigenbasis of the
+    covariance of centered positions, sign-fixed."""
+    centered = pos - pos.mean(axis=0, keepdims=True)
+    cov = centered.T @ centered
+    _, vecs = np.linalg.eigh(cov)
+    vecs = vecs[:, ::-1]  # descending eigenvalue order
+    # fix signs for determinism
+    for k in range(3):
+        col = vecs[:, k]
+        j = np.argmax(np.abs(col))
+        if col[j] < 0:
+            vecs[:, k] = -col
+    if np.linalg.det(vecs) < 0:
+        vecs[:, 2] = -vecs[:, 2]
+    return (centered @ vecs).astype(np.float32)
+
+
+def build_graph_sample(
+    node_feature_matrix: np.ndarray,
+    pos: np.ndarray,
+    config: Dict,
+    graph_feats: Optional[np.ndarray] = None,
+    cell: Optional[np.ndarray] = None,
+    forces: Optional[np.ndarray] = None,
+    energy: Optional[float] = None,
+) -> GraphSample:
+    """Full raw -> GraphSample path for one structure: rotation
+    normalization, radius graph (+PBC), input/target selection, optional
+    edge-length features (reference: SerializedDataLoader.load_serialized_data
+    serialized_dataset_loader.py:103-171)."""
+    ds = config["Dataset"]
+    nn = config["NeuralNetwork"]
+    arch = nn["Architecture"]
+    voi = nn["Variables_of_interest"]
+    node_dims = ds["node_features"]["dim"]
+    graph_dims = ds.get("graph_features", {}).get("dim", [])
+
+    if ds.get("rotational_invariance", False):
+        pos = normalize_rotation(pos)
+
+    radius = float(arch.get("radius") or 5.0)
+    max_nb = arch.get("max_neighbours")
+    shifts = None
+    if arch.get("periodic_boundary_conditions", False):
+        assert cell is not None, "PBC requires a cell"
+        send, recv, shifts = radius_graph_pbc(pos, cell, radius,
+                                              max_neighbours=max_nb)
+    else:
+        send, recv = radius_graph(pos, radius, max_neighbours=max_nb)
+
+    x = update_atom_features(voi["input_node_features"],
+                             node_feature_matrix, node_dims)
+    y_graph, y_node = update_predicted_values(
+        voi["type"], voi["output_index"],
+        graph_feats if graph_feats is not None else np.zeros(0, np.float32),
+        node_feature_matrix, graph_dims, node_dims)
+
+    edge_attr = None
+    if arch.get("edge_features"):
+        # edge length feature, globally normalized later
+        # (reference: serialized_dataset_loader.py:127-164 Distance transform)
+        vec = pos[send] - pos[recv]
+        if shifts is not None:
+            vec = vec + shifts
+        edge_attr = np.linalg.norm(vec, axis=1, keepdims=True).astype(np.float32)
+
+    return GraphSample(x=x, pos=pos, senders=send, receivers=recv,
+                       edge_attr=edge_attr, edge_shifts=shifts,
+                       y_graph=y_graph, y_node=y_node, cell=cell,
+                       energy=energy, forces=forces)
+
+
+def normalize_edge_lengths(samples: Sequence[GraphSample]) -> None:
+    """Divide edge-length features by the global max
+    (reference: serialized_dataset_loader.py:148-164; the allreduce there
+    becomes a host-side max since every process sees the same data or shards
+    deterministically)."""
+    gmax = 0.0
+    for s in samples:
+        if s.edge_attr is not None and s.edge_attr.size:
+            gmax = max(gmax, float(s.edge_attr[:, 0].max()))
+    if gmax > 0:
+        for s in samples:
+            if s.edge_attr is not None:
+                s.edge_attr = (s.edge_attr / gmax).astype(np.float32)

@@ -1,0 +1,75 @@
+"""Top-level inference driver.
+
+reference: hydragnn/run_prediction.py:34-107 — load model from a run dir,
+evaluate the test set, optionally denormalize outputs.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from .config import build_model_config, get_log_name_config, load_config, update_config
+from .graphs.batch import collate
+from .models.create import create_model, init_params
+from .postprocess.postprocess import output_denormalize
+from .preprocess.load_data import create_dataloaders
+from .train.loss import head_targets
+from .train.optimizer import select_optimizer
+from .train.train_step import TrainState, make_eval_step
+from .utils.checkpoint import load_existing_model
+
+
+def run_prediction(config_or_path, datasets: Optional[Tuple] = None,
+                   state: Optional[TrainState] = None, model=None):
+    """Returns (true_values, predicted_values) per head
+    (reference: run_prediction.py:48-107, test() gathering at
+    train_validate_test.py:709-737)."""
+    config = load_config(config_or_path)
+    if datasets is None:
+        from .run_training import _load_datasets_from_config
+        datasets = _load_datasets_from_config(config)
+    trainset, valset, testset = (list(d) for d in datasets)
+    config = update_config(config, trainset, valset, testset)
+    mcfg = build_model_config(config)
+
+    train_cfg = config["NeuralNetwork"]["Training"]
+    batch_size = int(train_cfg["batch_size"])
+    _, _, test_loader = create_dataloaders(trainset, valset, testset,
+                                           batch_size, num_shards=1)
+    if model is None:
+        model = create_model(mcfg)
+    if state is None:
+        init_batch = collate(
+            testset[:min(len(testset), test_loader.graphs_per_shard)],
+            n_node=test_loader.n_node, n_edge=test_loader.n_edge,
+            n_graph=test_loader.n_graph)
+        variables = init_params(model, init_batch)
+        tx = select_optimizer(train_cfg)
+        template = TrainState.create(variables, tx)
+        log_name = get_log_name_config(config)
+        state = load_existing_model(template, log_name)
+        assert state is not None, f"no checkpoint found for run '{log_name}'"
+
+    eval_step = make_eval_step(model, mcfg,
+                               train_cfg.get("loss_function_type", "mse"))
+
+    trues = [[] for _ in mcfg.heads]
+    preds = [[] for _ in mcfg.heads]
+    for batch in test_loader:
+        _, outputs = eval_step(state, batch)
+        targets = head_targets(mcfg, batch)
+        gm = np.asarray(batch.graph_mask)
+        nm = np.asarray(batch.node_mask)
+        for ih, head in enumerate(mcfg.heads):
+            mask = gm if head.head_type == "graph" else nm
+            trues[ih].append(np.asarray(targets[ih])[mask])
+            preds[ih].append(np.asarray(outputs[ih])[mask])
+    trues = [np.concatenate(t) for t in trues]
+    preds = [np.concatenate(p) for p in preds]
+
+    voi = config["NeuralNetwork"]["Variables_of_interest"]
+    if voi.get("denormalize_output"):
+        trues, preds = output_denormalize(voi["y_minmax"], trues, preds)
+    return trues, preds

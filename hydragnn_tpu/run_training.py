@@ -1,0 +1,135 @@
+"""Top-level training driver.
+
+reference: hydragnn/run_training.py:48-182 — config dispatch, distributed
+setup, data loading, config completion, model/optimizer construction, the
+epoch loop, final save + timer report.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+
+from .config import (build_model_config, get_log_name_config, load_config,
+                     save_config, update_config)
+from .datasets.loader import GraphDataLoader
+from .graphs.batch import GraphSample
+from .models.create import create_model, init_params
+from .parallel.mesh import init_distributed, make_mesh
+from .parallel.spmd import make_spmd_eval_step, make_spmd_train_step
+from .preprocess.load_data import create_dataloaders, split_dataset
+from .train.optimizer import select_optimizer
+from .train.train_step import TrainState, make_eval_step, make_train_step
+from .train.trainer import train_validate_test
+from .utils import profiling as tr
+from .utils.checkpoint import save_model
+from .utils.print_utils import setup_log
+
+
+def _load_datasets_from_config(config):
+    """Config-driven dataset loading (reference:
+    dataset_loading_and_splitting, preprocess/load_data.py:206-222)."""
+    ds = config["Dataset"]
+    fmt = ds.get("format", "pickle")
+    if fmt == "pickle":
+        from .datasets.pickledataset import SimplePickleDataset
+        if "total" in ds["path"]:
+            total = list(SimplePickleDataset(ds["path"]["total"]))
+            perc = config["NeuralNetwork"]["Training"].get("perc_train", 0.7)
+            return split_dataset(
+                total, perc,
+                ds.get("compositional_stratified_splitting", False))
+        return tuple(list(SimplePickleDataset(ds["path"][k]))
+                     for k in ("train", "validate", "test"))
+    if fmt in ("unit_test", "LSMS"):
+        from .datasets.lsmsdataset import load_lsms_splits
+        return load_lsms_splits(config)
+    if fmt == "adios":
+        from .datasets.gsdataset import GraphStoreDataset
+        return tuple(GraphStoreDataset(ds["path"][k])
+                     for k in ("train", "validate", "test"))
+    raise ValueError(f"unsupported Dataset.format '{fmt}'")
+
+
+def run_training(config_or_path, datasets: Optional[Tuple] = None,
+                 use_spmd: Optional[bool] = None, num_shards: Optional[int] = None):
+    """Train end-to-end from a JSON config (path or dict)
+    (reference: run_training.py:48-62 singledispatch on str/dict).
+
+    `datasets` optionally bypasses config-driven loading with in-memory
+    (train, val, test) GraphSample sequences — the examples' "preonly" path.
+    Returns (state, history, model, completed_config).
+    """
+    config = load_config(config_or_path)
+    verbosity = config.get("Verbosity", {}).get("level", 0)
+
+    init_distributed()
+    tr.initialize()
+
+    if datasets is None:
+        datasets = _load_datasets_from_config(config)
+    trainset, valset, testset = datasets
+    trainset = list(trainset)
+    valset = list(valset)
+    testset = list(testset)
+
+    config = update_config(config, trainset, valset, testset)
+    log_name = get_log_name_config(config)
+    setup_log(log_name)
+    save_config(config, log_name)
+
+    nn = config["NeuralNetwork"]
+    train_cfg = nn["Training"]
+    batch_size = int(train_cfg["batch_size"])
+
+    ndev = jax.device_count()
+    if num_shards is None:
+        num_shards = ndev if (use_spmd or (use_spmd is None and ndev > 1)) else 1
+    if batch_size % max(num_shards, 1) != 0:
+        num_shards = 1  # fall back to single-program
+
+    train_loader, val_loader, test_loader = create_dataloaders(
+        trainset, valset, testset, batch_size, num_shards=num_shards)
+
+    mcfg = build_model_config(config)
+    model = create_model(mcfg)
+
+    # init on one shard-shaped batch
+    from .graphs.batch import collate
+    init_batch = collate(trainset[:min(len(trainset), train_loader.graphs_per_shard)],
+                         n_node=train_loader.n_node, n_edge=train_loader.n_edge,
+                         n_graph=train_loader.n_graph)
+    variables = init_params(model, init_batch)
+    tx = select_optimizer(train_cfg)
+    state = TrainState.create(variables, tx)
+
+    loss_name = train_cfg.get("loss_function_type", "mse")
+    cge = bool(train_cfg.get("compute_grad_energy", False))
+    if num_shards > 1:
+        mesh = make_mesh((("data", num_shards),))
+        train_step = make_spmd_train_step(model, mcfg, tx, mesh, loss_name,
+                                          compute_grad_energy=cge)
+        eval_step = make_spmd_eval_step(model, mcfg, mesh, loss_name)
+    else:
+        train_step = make_train_step(model, mcfg, tx, loss_name,
+                                     compute_grad_energy=cge)
+        eval_step = make_eval_step(model, mcfg, loss_name,
+                                   compute_grad_energy=cge)
+
+    ckpt_fn = None
+    if train_cfg.get("Checkpoint", False):
+        ckpt_fn = lambda s, e, v: save_model(s, log_name)
+
+    state, history = train_validate_test(
+        train_step, eval_step, state, train_loader, val_loader, test_loader,
+        num_epochs=int(train_cfg["num_epoch"]), log_name=log_name,
+        patience=int(train_cfg.get("patience", 10)),
+        use_early_stopping=bool(train_cfg.get("EarlyStopping", False)),
+        checkpoint_warmup=int(train_cfg.get("checkpoint_warmup", 0)),
+        checkpoint_fn=ckpt_fn, verbosity=verbosity, tracer=tr.get())
+
+    if train_cfg.get("Checkpoint", False):
+        save_model(state, log_name)
+    tr.print_timers(os.path.join("./logs", log_name))
+    return state, history, model, config

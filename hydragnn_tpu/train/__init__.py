@@ -1,0 +1,2 @@
+from .loss import energy_force_loss, head_targets, multihead_loss
+from .train_step import TrainState, make_eval_step, make_train_step

@@ -1,0 +1,65 @@
+"""Optimizer selection — optax equivalents of the reference registry.
+
+reference: hydragnn/utils/optimizer/optimizer.py:12-113 (SGD/Adam/Adadelta/
+Adagrad/Adamax/AdamW/RMSprop/FusedLAMB, each with a ZeroRedundancy variant).
+Here ZeRO is not a different optimizer: optimizer-state sharding is a
+sharding spec on the opt-state pytree (parallel/mesh.py:param_sharding_zero),
+applied uniformly to any optax transform.
+
+`inject_hyperparams` makes learning_rate runtime-adjustable so the
+ReduceLROnPlateau schedule (reference: train_validate_test.py:195) can scale
+it without recompiling.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import optax
+
+_FACTORIES = {
+    "SGD": lambda lr, kw: optax.sgd(lr, momentum=kw.get("momentum", 0.9)),
+    "Adam": lambda lr, kw: optax.adam(lr),
+    "Adadelta": lambda lr, kw: optax.adadelta(lr),
+    "Adagrad": lambda lr, kw: optax.adagrad(lr),
+    "Adamax": lambda lr, kw: optax.adamax(lr),
+    "AdamW": lambda lr, kw: optax.adamw(lr, weight_decay=kw.get("weight_decay", 1e-2)),
+    "RMSprop": lambda lr, kw: optax.rmsprop(lr),
+    "FusedLAMB": lambda lr, kw: optax.lamb(lr),
+}
+
+
+def select_optimizer(train_config: Dict[str, Any]) -> optax.GradientTransformation:
+    """reference: select_optimizer (optimizer.py:104-113)."""
+    opt_cfg = train_config.get("Optimizer", {"type": "AdamW"})
+    name = opt_cfg.get("type", "AdamW")
+    lr = float(opt_cfg.get("learning_rate", 1e-3))
+    if name not in _FACTORIES:
+        raise ValueError(f"unknown optimizer '{name}'; known: {sorted(_FACTORIES)}")
+    factory = _FACTORIES[name]
+
+    @optax.inject_hyperparams
+    def make(learning_rate):
+        tx = factory(learning_rate, opt_cfg)
+        clip = train_config.get("grad_clip")
+        if clip:
+            tx = optax.chain(optax.clip_by_global_norm(float(clip)), tx)
+        return tx
+
+    return make(learning_rate=lr)
+
+
+def get_learning_rate(opt_state) -> float:
+    return float(opt_state.hyperparams["learning_rate"])
+
+
+def set_learning_rate(opt_state, lr: float):
+    import jax.numpy as jnp
+    old = opt_state.hyperparams["learning_rate"]
+    opt_state.hyperparams["learning_rate"] = jnp.asarray(
+        lr, dtype=getattr(old, "dtype", jnp.float32))
+    return opt_state
+
+
+def supports_lr_schedule(opt_state) -> bool:
+    return hasattr(opt_state, "hyperparams") and \
+        "learning_rate" in opt_state.hyperparams

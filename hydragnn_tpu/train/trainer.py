@@ -1,0 +1,211 @@
+"""Epoch-level training driver.
+
+reference: hydragnn/train/train_validate_test.py:52-311 `train_validate_test`
+— epoch loop with per-epoch shuffling, ReduceLROnPlateau on val loss (:195),
+TensorBoard scalars (:196-203), best-val-gated checkpointing with warmup
+(:237-244; utils/model/model.py:258-298), early stopping (:246-253), and a
+SLURM walltime guard (:255-262).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..utils.print_utils import iterate_tqdm, log, print_distributed
+from ..utils.profiling import Tracer
+from .optimizer import (get_learning_rate, set_learning_rate,
+                        supports_lr_schedule)
+
+
+class EarlyStopping:
+    """reference: utils/model/model.py:240-255."""
+
+    def __init__(self, patience: int = 10, min_delta: float = 0.0):
+        self.patience = patience
+        self.min_delta = min_delta
+        self.best = float("inf")
+        self.count = 0
+
+    def __call__(self, val_loss: float) -> bool:
+        if val_loss < self.best - self.min_delta:
+            self.best = val_loss
+            self.count = 0
+            return False
+        self.count += 1
+        return self.count >= self.patience
+
+
+class ReduceLROnPlateau:
+    """reference: torch.optim.lr_scheduler.ReduceLROnPlateau used at
+    train_validate_test.py:191-195 (factor 0.5, patience 5, min_lr 1e-6 per
+    run_training.py:101-104)."""
+
+    def __init__(self, factor: float = 0.5, patience: int = 5,
+                 min_lr: float = 1e-6):
+        self.factor = factor
+        self.patience = patience
+        self.min_lr = min_lr
+        self.best = float("inf")
+        self.count = 0
+
+    def step(self, val_loss: float, lr: float) -> float:
+        if val_loss < self.best:
+            self.best = val_loss
+            self.count = 0
+            return lr
+        self.count += 1
+        if self.count > self.patience:
+            self.count = 0
+            return max(lr * self.factor, self.min_lr)
+        return lr
+
+
+class CheckpointGate:
+    """Best-val-gated checkpoint with warmup epochs
+    (reference: utils/model/model.py:258-298)."""
+
+    def __init__(self, warmup: int = 0):
+        self.warmup = warmup
+        self.best = float("inf")
+
+    def should_save(self, epoch: int, val_loss: float) -> bool:
+        if epoch < self.warmup:
+            return False
+        if val_loss < self.best:
+            self.best = val_loss
+            return True
+        return False
+
+
+def _walltime_remaining_guard(deadline: Optional[float]) -> bool:
+    """reference: check_remaining (distributed.py:331-356) polls squeue; here
+    the driver passes an absolute deadline timestamp instead."""
+    if deadline is None:
+        return True
+    return time.time() < deadline
+
+
+def train_validate_test(
+    train_step: Callable,
+    eval_step: Callable,
+    state,
+    train_loader,
+    val_loader,
+    test_loader,
+    num_epochs: int,
+    log_name: str = "run",
+    log_dir: str = "./logs",
+    patience: int = 10,
+    use_early_stopping: bool = True,
+    checkpoint_warmup: int = 0,
+    checkpoint_fn: Optional[Callable] = None,
+    plateau: Optional[ReduceLROnPlateau] = None,
+    walltime_deadline: Optional[float] = None,
+    verbosity: int = 0,
+    tracer: Optional[Tracer] = None,
+    keep_best: bool = True,
+):
+    """Returns (final_state, history dict). With `keep_best` the returned
+    state is the best-validation one (mirrors the reference's best-val
+    checkpoint + reload flow, utils/model/model.py:258-298)."""
+    run_dir = os.path.join(log_dir, log_name)
+    os.makedirs(run_dir, exist_ok=True)
+    tb = _tensorboard_writer(run_dir)
+    early = EarlyStopping(patience) if use_early_stopping else None
+    gate = CheckpointGate(checkpoint_warmup)
+    plateau = plateau or ReduceLROnPlateau()
+    tr = tracer or Tracer()
+    history: Dict[str, List[float]] = {"train_loss": [], "val_loss": [],
+                                       "test_loss": [], "lr": []}
+    best_state, best_val = None, float("inf")
+
+    for epoch in range(num_epochs):
+        train_loader.set_epoch(epoch)
+        # ---- train pass (reference: train, :449-565) ----
+        tot, nb = 0.0, 0
+        with tr.timer("train_epoch"):
+            for batch in iterate_tqdm(train_loader, verbosity,
+                                      desc=f"epoch {epoch} train"):
+                with tr.timer("train_step"):
+                    state, metrics = train_step(state, batch)
+                tot += float(metrics["loss"])
+                nb += 1
+        train_loss = tot / max(nb, 1)
+
+        # ---- val/test passes ----
+        val_loss = _eval_epoch(eval_step, state, val_loader, tr, "validate")
+        test_loss = _eval_epoch(eval_step, state, test_loader, tr, "test")
+
+        if keep_best and val_loss == val_loss and val_loss < best_val:
+            best_val = val_loss
+            best_state = jax.device_get(state)
+
+        # ---- LR plateau schedule ----
+        if supports_lr_schedule(state.opt_state):
+            lr = get_learning_rate(state.opt_state)
+            new_lr = plateau.step(val_loss, lr)
+            if new_lr != lr:
+                set_learning_rate(state.opt_state, new_lr)
+                print_distributed(verbosity, 1,
+                                  f"reducing lr {lr:.2e} -> {new_lr:.2e}")
+            lr = new_lr
+        else:
+            lr = float("nan")
+
+        history["train_loss"].append(train_loss)
+        history["val_loss"].append(val_loss)
+        history["test_loss"].append(test_loss)
+        history["lr"].append(lr)
+        if tb is not None:
+            tb.add_scalar("train/loss", train_loss, epoch)
+            tb.add_scalar("val/loss", val_loss, epoch)
+            tb.add_scalar("test/loss", test_loss, epoch)
+        log(f"epoch {epoch}: train {train_loss:.5f} val {val_loss:.5f} "
+            f"test {test_loss:.5f} lr {lr:.2e}")
+
+        if checkpoint_fn is not None and gate.should_save(epoch, val_loss):
+            checkpoint_fn(state, epoch, val_loss)
+        if early is not None and early(val_loss):
+            print_distributed(verbosity, 1, f"early stop at epoch {epoch}")
+            break
+        if not _walltime_remaining_guard(walltime_deadline):
+            print_distributed(verbosity, 1, "walltime guard: stopping")
+            break
+
+    with open(os.path.join(run_dir, "history.json"), "w") as f:
+        json.dump(history, f)
+    if tb is not None:
+        tb.close()
+    if keep_best and best_state is not None:
+        state = best_state
+    return state, history
+
+
+def _eval_epoch(eval_step, state, loader, tr, name: str) -> float:
+    if loader is None:
+        return float("nan")
+    tot, nb = 0.0, 0
+    with tr.timer(name):
+        for batch in loader:
+            out = eval_step(state, batch)
+            metrics = out[0] if isinstance(out, tuple) else out
+            tot += float(metrics["loss"])
+            nb += 1
+    return tot / max(nb, 1)
+
+
+def _tensorboard_writer(run_dir: str):
+    """TensorBoard scalars via torch (CPU build is baked in) — parity with
+    reference SummaryWriter use (utils/model/model.py:82-88)."""
+    if os.getenv("HYDRAGNN_DISABLE_TB"):
+        return None
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+        return SummaryWriter(run_dir)
+    except Exception:
+        return None

@@ -1,0 +1,52 @@
+"""Checkpoint save/load via orbax.
+
+reference: hydragnn/utils/model/model.py:63-122 (`save_model`,
+`load_existing_model[_config]` — torch pickle of model+optimizer state with
+DDP "module." key fixup). TPU equivalent: orbax checkpoint of the
+(params, batch_stats, opt_state, step) pytree; no key fixup needed because
+SPMD has no module wrappers. Async-capable (SURVEY.md §5.3 suggestion).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from ..train.train_step import TrainState
+
+
+def _ckpt_dir(log_name: str, path: str = "./logs") -> str:
+    return os.path.abspath(os.path.join(path, log_name, "checkpoint"))
+
+
+def save_model(state: TrainState, log_name: str, path: str = "./logs") -> str:
+    """Rank-0-coordinated atomic save (reference: save_model,
+    utils/model/model.py:63-77)."""
+    d = _ckpt_dir(log_name, path)
+    ckptr = ocp.StandardCheckpointer()
+    target = os.path.join(d, f"step_{int(state.step)}")
+    ckptr.save(target, jax.device_get(state), force=True)
+    ckptr.wait_until_finished()
+    # mark latest
+    if jax.process_index() == 0:
+        with open(os.path.join(d, "LATEST"), "w") as f:
+            f.write(os.path.basename(target))
+    return target
+
+
+def load_existing_model(state_like: TrainState, log_name: str,
+                        path: str = "./logs") -> Optional[TrainState]:
+    """Restore the latest checkpoint onto a template state
+    (reference: load_existing_model, utils/model/model.py:101-122). Returns
+    None when no checkpoint exists (startfrom semantics,
+    run_training.py:114-116)."""
+    d = _ckpt_dir(log_name, path)
+    latest = os.path.join(d, "LATEST")
+    if not os.path.exists(latest):
+        return None
+    with open(latest) as f:
+        target = os.path.join(d, f.read().strip())
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(target, state_like)

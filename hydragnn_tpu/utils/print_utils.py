@@ -1,0 +1,72 @@
+"""Verbosity-leveled printing & logging.
+
+reference: hydragnn/utils/print/print_utils.py:20-111 (verbosity policy 0-4,
+rank-aware print, tqdm gating, file+console logger).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Iterable
+
+import jax
+
+_LOGGER = None
+
+
+def print_distributed(verbosity: int, level: int, *args):
+    """Print on process 0 when verbosity >= level
+    (reference: print_utils.py:20-54)."""
+    if verbosity >= level and jax.process_index() == 0:
+        print(*args, flush=True)
+
+
+def print_master(*args):
+    if jax.process_index() == 0:
+        print(*args, flush=True)
+
+
+def iterate_tqdm(iterable: Iterable, verbosity: int, level: int = 2, **kw):
+    """tqdm on rank 0 at sufficient verbosity (reference: print_utils.py:56-60)."""
+    if verbosity >= level and jax.process_index() == 0:
+        try:
+            from tqdm import tqdm
+            return tqdm(iterable, **kw)
+        except ImportError:
+            pass
+    return iterable
+
+
+def setup_log(name: str, log_dir: str = "./logs") -> logging.Logger:
+    """File + console logger per run dir (reference: print_utils.py:63-91)."""
+    global _LOGGER
+    run_dir = os.path.join(log_dir, name)
+    os.makedirs(run_dir, exist_ok=True)
+    logger = logging.getLogger("hydragnn_tpu")
+    logger.setLevel(logging.INFO)
+    logger.handlers.clear()
+    fh = logging.FileHandler(os.path.join(run_dir, "train.log"))
+    ch = logging.StreamHandler(sys.stdout)
+    fmt = logging.Formatter("%(asctime)s %(levelname)s %(message)s")
+    fh.setFormatter(fmt)
+    ch.setFormatter(fmt)
+    logger.addHandler(fh)
+    if jax.process_index() == 0:
+        logger.addHandler(ch)
+    _LOGGER = logger
+    return logger
+
+
+def log(*args):
+    """reference: print_utils.py:93-111 (log/log0)."""
+    msg = " ".join(str(a) for a in args)
+    if _LOGGER is not None:
+        _LOGGER.info(msg)
+    elif jax.process_index() == 0:
+        print(msg, flush=True)
+
+
+def log0(*args):
+    if jax.process_index() == 0:
+        log(*args)

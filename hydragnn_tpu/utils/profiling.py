@@ -1,0 +1,146 @@
+"""Region tracer + aggregate timers.
+
+reference: hydragnn/utils/profiling_and_tracing/tracer.py:14-167 (Tracer
+facade with GPTL/Score-P backends, @profile decorator, timer contextmanager)
+and time_utils.py:22-138 (class-level timer dicts, min/max/avg across ranks).
+
+TPU mapping: `jax.profiler.TraceAnnotation` replaces Score-P regions;
+`jax.block_until_ready` replaces cudasync for accurate walls
+(reference: tracer.py:107-112). GPTL-style per-rank text summaries are
+written by `print_timers`.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+
+class Tracer:
+    """Hierarchical region timer with optional device sync + jax profiler
+    annotations."""
+
+    def __init__(self, sync: bool = False, use_jax_annotations: bool = True):
+        self.sync = sync
+        self.use_jax_annotations = use_jax_annotations
+        self.times: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+        self._starts: Dict[str, float] = {}
+        self.enabled = True
+
+    def enable(self):
+        self.enabled = True
+
+    def disable(self):
+        self.enabled = False
+
+    def reset(self):
+        self.times.clear()
+        self.counts.clear()
+        self._starts.clear()
+
+    def start(self, name: str):
+        if not self.enabled:
+            return
+        self._starts[name] = time.perf_counter()
+
+    def stop(self, name: str, result: Any = None):
+        if not self.enabled or name not in self._starts:
+            return
+        if self.sync and result is not None:
+            jax.block_until_ready(result)
+        dt = time.perf_counter() - self._starts.pop(name)
+        self.times[name] = self.times.get(name, 0.0) + dt
+        self.counts[name] = self.counts.get(name, 0) + 1
+
+    @contextlib.contextmanager
+    def timer(self, name: str):
+        """reference: tracer.py:157-167 `tr.timer` contextmanager."""
+        if not self.enabled:
+            yield
+            return
+        ctx = (jax.profiler.TraceAnnotation(name)
+               if self.use_jax_annotations else contextlib.nullcontext())
+        with ctx:
+            self.start(name)
+            try:
+                yield
+            finally:
+                self.stop(name)
+
+    def profile(self, name: Optional[str] = None):
+        """reference: tracer.py:145-155 `@tr.profile` decorator."""
+        def deco(fn: Callable):
+            label = name or fn.__qualname__
+            @functools.wraps(fn)
+            def wrapped(*a, **kw):
+                with self.timer(label):
+                    return fn(*a, **kw)
+            return wrapped
+        return deco
+
+    def print_timers(self, path: Optional[str] = None):
+        """GPTL-style per-rank summary (reference: time_utils.py:95-138;
+        gp_timing.p{rank} artifacts)."""
+        lines = [f"{'region':<30}{'count':>8}{'total_s':>12}{'avg_ms':>12}"]
+        for name, tot in sorted(self.times.items()):
+            c = self.counts[name]
+            lines.append(f"{name:<30}{c:>8}{tot:>12.4f}{tot / c * 1e3:>12.3f}")
+        text = "\n".join(lines)
+        if path:
+            rank = jax.process_index()
+            with open(os.path.join(path, f"gp_timing.p{rank}"), "w") as f:
+                f.write(text + "\n")
+        return text
+
+
+_GLOBAL = Tracer()
+
+
+def initialize(sync: bool = False):
+    global _GLOBAL
+    _GLOBAL = Tracer(sync=sync)
+    return _GLOBAL
+
+
+def get() -> Tracer:
+    return _GLOBAL
+
+
+def start(name: str):
+    _GLOBAL.start(name)
+
+
+def stop(name: str, result: Any = None):
+    _GLOBAL.stop(name, result)
+
+
+def enable():
+    _GLOBAL.enable()
+
+
+def disable():
+    _GLOBAL.disable()
+
+
+def reset():
+    _GLOBAL.reset()
+
+
+def print_timers(path: Optional[str] = None):
+    return _GLOBAL.print_timers(path)
+
+
+@contextlib.contextmanager
+def device_profile(log_dir: str):
+    """Wrap a region in jax.profiler trace capture (TensorBoard-viewable) —
+    replaces the torch.profiler window (reference: profile.py:9-70)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

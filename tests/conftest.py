@@ -1,0 +1,20 @@
+"""Test config: force CPU backend with 8 virtual devices so SPMD/sharding
+tests run without TPU hardware (SURVEY.md §4: the reference CI runs 2-rank
+MPI on CPU; our analogue is an 8-device virtual CPU mesh).
+
+Note: the axon sitecustomize registers the TPU backend and sets
+jax_platforms programmatically, so the env var alone is not enough — we must
+override via jax.config before any backend initialization.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, jax.devices()

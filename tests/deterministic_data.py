@@ -1,0 +1,72 @@
+"""Deterministic synthetic dataset: BCC lattices with closed-form targets.
+
+Same strategy as the reference's test fixture
+(reference: tests/deterministic_graph_data.py:20-173): body-centered-cubic
+supercells; nodal feature = node_id mod num_types (normalized); nodal outputs
+x, x^2, x^3; graph output = sum over nodes of all three. Generated in-memory
+as GraphSample objects (the reference round-trips through LSMS text files;
+our format-dataset tests cover that path separately).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from hydragnn_tpu.graphs import GraphSample, radius_graph
+
+
+def bcc_positions(uc_x: int, uc_y: int, uc_z: int) -> np.ndarray:
+    pos = []
+    for x in range(uc_x):
+        for y in range(uc_y):
+            for z in range(uc_z):
+                pos.append([x, y, z])
+                pos.append([x + 0.5, y + 0.5, z + 0.5])
+    return np.asarray(pos, dtype=np.float32)
+
+
+def deterministic_graph_dataset(
+    num_configs: int = 200,
+    num_types: int = 3,
+    radius: float = 1.0,
+    max_neighbours: int = 100,
+    seed: int = 0,
+    heads=("graph",),
+) -> List[GraphSample]:
+    """`heads` selects the packed labels: "graph" -> y_graph =
+    [sum(x)+sum(x^2)+sum(x^3)], "node" -> y_node = [x] per node (mirrors
+    tests/inputs/ci.json vs ci_multihead.json target selections)."""
+    rng = np.random.RandomState(seed)
+    samples = []
+    for _ in range(num_configs):
+        ucx = rng.randint(1, 4)
+        ucy = rng.randint(1, 4)
+        ucz = rng.randint(1, 3)
+        pos = bcc_positions(ucx, ucy, ucz)
+        n = pos.shape[0]
+        node_ids = np.arange(n)
+        types = node_ids % num_types
+        x = (types.astype(np.float32) + 1.0) / num_types  # normalized feature
+        send, recv = radius_graph(pos, radius, max_neighbours)
+        y1, y2, y3 = x, x ** 2, x ** 3
+        y_graph = None
+        y_node = None
+        if "graph" in heads:
+            y_graph = np.asarray([y1.sum() + y2.sum() + y3.sum()], np.float32)
+        if "node" in heads:
+            y_node = np.stack([y1], axis=1).astype(np.float32)
+        if "node3" in heads:
+            y_node = np.stack([y1, y2, y3], axis=1).astype(np.float32)
+        samples.append(GraphSample(
+            x=x[:, None], pos=pos, senders=send, receivers=recv,
+            y_graph=y_graph, y_node=y_node))
+    # min-max normalize graph targets to [0, 1] — the reference raw loader
+    # does the same (hydragnn/utils/datasets/abstractrawdataset.py normalize)
+    if "graph" in heads:
+        vals = np.asarray([s.y_graph[0] for s in samples])
+        lo, hi = vals.min(), vals.max()
+        span = max(hi - lo, 1e-8)
+        for s in samples:
+            s.y_graph = ((s.y_graph - lo) / span).astype(np.float32)
+    return samples

@@ -1,0 +1,118 @@
+"""Model-zoo construction/forward tests (the shape/compile smoke layer;
+accuracy thresholds live in test_training.py, mirroring the reference's
+tests/test_graphs.py split)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hydragnn_tpu.models import create_model, init_params
+from hydragnn_tpu.config import build_model_config
+
+from tests.deterministic_data import deterministic_graph_dataset
+from tests.utils import prepare
+
+INVARIANT_MODELS = ["GIN", "SAGE", "GAT", "MFC", "CGCNN", "PNA", "PNAPlus",
+                    "SchNet", "EGNN"]
+
+
+@pytest.fixture(scope="module")
+def samples():
+    return deterministic_graph_dataset(num_configs=12, heads=("graph", "node"))
+
+
+@pytest.mark.parametrize("model_type", INVARIANT_MODELS)
+def test_forward_shapes_singlehead(model_type, samples):
+    cfg, mcfg, batch = prepare(model_type, samples)
+    model = create_model(mcfg)
+    variables = init_params(model, batch)
+    (outputs, outputs_var) = model.apply(variables, batch, train=False)
+    assert outputs_var is None
+    assert len(outputs) == 1
+    assert outputs[0].shape == (batch.num_graphs, 1)
+    assert np.all(np.isfinite(np.asarray(outputs[0])))
+
+
+@pytest.mark.parametrize("model_type", ["GIN", "PNA", "SchNet", "EGNN"])
+def test_forward_multihead(model_type, samples):
+    cfg, mcfg, batch = prepare(model_type, samples, heads=("graph", "node"))
+    model = create_model(mcfg)
+    variables = init_params(model, batch)
+    outputs, _ = model.apply(variables, batch, train=False)
+    assert outputs[0].shape == (batch.num_graphs, 1)
+    assert outputs[1].shape == (batch.num_nodes, 1)
+
+
+@pytest.mark.parametrize("model_type", ["GIN", "PNA"])
+def test_jit_and_grad(model_type, samples):
+    cfg, mcfg, batch = prepare(model_type, samples)
+    model = create_model(mcfg)
+    variables = init_params(model, batch)
+
+    @jax.jit
+    def loss(params):
+        out, _ = model.apply({"params": params,
+                              "batch_stats": variables["batch_stats"]},
+                             batch, train=False)
+        return jnp.sum(out[0] ** 2)
+
+    g = jax.grad(loss)(variables["params"])
+    flat = jax.tree_util.tree_leaves(g)
+    assert all(np.all(np.isfinite(np.asarray(x))) for x in flat)
+    assert any(float(jnp.max(jnp.abs(x))) > 0 for x in flat)
+
+
+def test_padding_invariance(samples):
+    """Outputs on real graphs must not depend on the padding amount —
+    the core correctness property of the static-shape design."""
+    from hydragnn_tpu.graphs import collate
+    cfg, mcfg, _ = prepare("GIN", samples)
+    model = create_model(mcfg)
+    b1 = collate(samples[:4], n_node=80, n_edge=1024, n_graph=5)
+    b2 = collate(samples[:4], n_node=160, n_edge=2048, n_graph=9)
+    variables = init_params(model, b1)
+    o1, _ = model.apply(variables, b1, train=False)
+    o2, _ = model.apply(variables, b2, train=False)
+    np.testing.assert_allclose(np.asarray(o1[0][:4]), np.asarray(o2[0][:4]),
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_gaussian_nll_var_output(samples):
+    cfg, mcfg, batch = prepare("GIN", samples)
+    import dataclasses
+    mcfg = dataclasses.replace(mcfg, var_output=1)
+    model = create_model(mcfg)
+    variables = init_params(model, batch)
+    outputs, outputs_var = model.apply(variables, batch, train=False)
+    assert outputs[0].shape == (batch.num_graphs, 1)
+    assert outputs_var[0].shape == (batch.num_graphs, 1)
+    assert np.all(np.asarray(outputs_var[0]) >= 0)
+
+
+def test_conv_node_head(samples):
+    """Node head of type 'conv' (reference: Base.py:262-290)."""
+    cfg, mcfg, batch = prepare("GIN", samples, heads=("node",))
+    import dataclasses
+    head = dataclasses.replace(mcfg.heads[0], node_arch="conv")
+    mcfg = dataclasses.replace(mcfg, heads=(head,))
+    model = create_model(mcfg)
+    variables = init_params(model, batch)
+    outputs, _ = model.apply(variables, batch, train=False)
+    assert outputs[0].shape == (batch.num_nodes, 1)
+
+
+def test_mlp_per_node_head():
+    samples = deterministic_graph_dataset(num_configs=8, heads=("node",))
+    # fix graph size: filter to the modal size
+    sizes = [s.num_nodes for s in samples]
+    modal = max(set(sizes), key=sizes.count)
+    fixed = [s for s in samples if s.num_nodes == modal]
+    cfg, mcfg, batch = prepare("GIN", fixed, heads=("node",))
+    import dataclasses
+    head = dataclasses.replace(mcfg.heads[0], node_arch="mlp_per_node")
+    mcfg = dataclasses.replace(mcfg, heads=(head,), num_nodes=modal)
+    model = create_model(mcfg)
+    variables = init_params(model, batch)
+    outputs, _ = model.apply(variables, batch, train=False)
+    assert outputs[0].shape == (batch.num_nodes, 1)

@@ -1,0 +1,92 @@
+"""Shared test helpers: config fixtures mirroring tests/inputs/ci*.json of
+the reference."""
+import copy
+
+from hydragnn_tpu.config import build_model_config, update_config
+from hydragnn_tpu.graphs import BucketSpec, collate
+
+BASE_CONFIG = {
+    "Verbosity": {"level": 0},
+    "Dataset": {
+        "name": "unit_test",
+        "format": "unit_test",
+        "node_features": {"name": ["x", "x2", "x3"], "dim": [1, 1, 1],
+                          "column_index": [0, 6, 7]},
+        "graph_features": {"name": ["sum_x_x2_x3"], "dim": [1],
+                           "column_index": [0]},
+    },
+    "NeuralNetwork": {
+        "Architecture": {
+            "model_type": "PNA",
+            "radius": 1.0,
+            "max_neighbours": 100,
+            "num_gaussians": 10,
+            "envelope_exponent": 5,
+            "int_emb_size": 8,
+            "basis_emb_size": 4,
+            "out_emb_size": 16,
+            "num_after_skip": 1,
+            "num_before_skip": 1,
+            "num_radial": 6,
+            "num_spherical": 7,
+            "num_filters": 16,
+            "max_ell": 1,
+            "node_max_ell": 1,
+            "hidden_dim": 8,
+            "num_conv_layers": 2,
+            "output_heads": {
+                "graph": {"num_sharedlayers": 2, "dim_sharedlayers": 4,
+                          "num_headlayers": 2, "dim_headlayers": [10, 10]},
+            },
+            "task_weights": [1.0],
+        },
+        "Variables_of_interest": {
+            "input_node_features": [0],
+            "output_names": ["sum_x_x2_x3"],
+            "output_index": [0],
+            "type": ["graph"],
+            "denormalize_output": False,
+        },
+        "Training": {
+            "num_epoch": 40,
+            "perc_train": 0.7,
+            "EarlyStopping": True,
+            "patience": 10,
+            "loss_function_type": "mse",
+            "batch_size": 32,
+            "Optimizer": {"type": "AdamW", "learning_rate": 0.005},
+        },
+    },
+}
+
+
+def make_config(model_type, heads=("graph",), equivariance=False, **arch_over):
+    cfg = copy.deepcopy(BASE_CONFIG)
+    arch = cfg["NeuralNetwork"]["Architecture"]
+    arch["model_type"] = model_type
+    arch["equivariance"] = equivariance
+    arch.update(arch_over)
+    voi = cfg["NeuralNetwork"]["Variables_of_interest"]
+    types, names, idx = [], [], []
+    for h in heads:
+        if h == "graph":
+            types.append("graph"); names.append("sum_x_x2_x3"); idx.append(0)
+        else:
+            types.append("node"); names.append("x"); idx.append(0)
+    voi["type"] = types
+    voi["output_names"] = names
+    voi["output_index"] = idx
+    if "node" in heads:
+        arch["output_heads"]["node"] = {
+            "num_headlayers": 2, "dim_headlayers": [4, 4], "type": "mlp"}
+    cfg["NeuralNetwork"]["Training"]["task_weights"] = [1.0] * len(heads)
+    return cfg
+
+
+def prepare(model_type, samples, heads=("graph",), **arch_over):
+    """update_config + model config + a first collated batch."""
+    cfg = make_config(model_type, heads=heads, **arch_over)
+    cfg = update_config(cfg, samples)
+    mcfg = build_model_config(cfg)
+    batch = collate(samples[:8], bucket=BucketSpec(multiple=64))
+    return cfg, mcfg, batch
