@@ -1,0 +1,127 @@
+"""Lennard-Jones energy/force training example CLI.
+
+reference: examples/LennardJones/LennardJones.py:56-331 — argparse driver
+that generates LJ data, builds pickle/adios datasets, trains with the
+energy-force loss (`compute_grad_energy`), and prints GPTL timers.
+
+Usage:
+    python examples/LennardJones/LennardJones.py --model_type SchNet \
+        --num_configs 200 --num_epoch 20 [--format graphstore] [--cpu]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model_type", default="SchNet",
+                   choices=["SchNet", "EGNN", "PAINN", "PNAEq", "MACE",
+                            "DimeNet"])
+    p.add_argument("--num_configs", type=int, default=200)
+    p.add_argument("--num_epoch", type=int, default=20)
+    p.add_argument("--batch_size", type=int, default=16)
+    p.add_argument("--hidden_dim", type=int, default=32)
+    p.add_argument("--num_conv_layers", type=int, default=2)
+    p.add_argument("--learning_rate", type=float, default=5e-3)
+    p.add_argument("--format", default="memory",
+                   choices=["memory", "graphstore", "pickle"])
+    p.add_argument("--preonly", action="store_true",
+                   help="only generate + persist the dataset, no training")
+    p.add_argument("--cpu", action="store_true",
+                   help="force CPU backend with 8 virtual devices")
+    p.add_argument("--num_shards", type=int, default=None)
+    args = p.parse_args()
+
+    if args.cpu:
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_force_host_platform_device_count=8").strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from examples.LennardJones.lj_data import generate_lj_dataset
+    from hydragnn_tpu.preprocess.load_data import split_dataset
+    from hydragnn_tpu.run_training import run_training
+    from hydragnn_tpu.utils import profiling as tr
+
+    samples = generate_lj_dataset(num_configs=args.num_configs)
+    datadir = os.path.join(os.path.dirname(__file__), "dataset")
+    if args.format == "graphstore":
+        from hydragnn_tpu.datasets.gsdataset import (GraphStoreDataset,
+                                                     GraphStoreWriter)
+        w = GraphStoreWriter(os.path.join(datadir, "lj_gs"))
+        w.add_all(samples)
+        w.save()
+        samples = list(GraphStoreDataset(os.path.join(datadir, "lj_gs")))
+    elif args.format == "pickle":
+        from hydragnn_tpu.datasets.pickledataset import (SimplePickleDataset,
+                                                         SimplePickleWriter)
+        SimplePickleWriter(samples, os.path.join(datadir, "lj_pkl"))
+        samples = list(SimplePickleDataset(os.path.join(datadir, "lj_pkl")))
+    if args.preonly:
+        print(f"wrote {len(samples)} samples to {datadir} ({args.format})")
+        return
+
+    splits = split_dataset(samples, 0.8)
+    config = {
+        "Verbosity": {"level": 1},
+        "NeuralNetwork": {
+            "Architecture": {
+                "model_type": args.model_type,
+                "radius": 2.0,
+                "max_neighbours": 64,
+                "num_gaussians": 32,
+                "num_filters": args.hidden_dim,
+                "num_radial": 8,
+                "envelope_exponent": 5,
+                "num_spherical": 4,
+                "int_emb_size": 16,
+                "basis_emb_size": 8,
+                "out_emb_size": 32,
+                "num_before_skip": 1,
+                "num_after_skip": 1,
+                "max_ell": 2,
+                "node_max_ell": 1,
+                "correlation": [2],
+                "equivariance": args.model_type in
+                    ("SchNet", "EGNN", "PAINN", "PNAEq", "MACE"),
+                "hidden_dim": args.hidden_dim,
+                "num_conv_layers": args.num_conv_layers,
+                "periodic_boundary_conditions": True,
+                "output_heads": {
+                    "node": {"num_headlayers": 2,
+                             "dim_headlayers": [args.hidden_dim,
+                                                args.hidden_dim],
+                             "type": "mlp"}},
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_index": [0],
+                "type": ["node"],
+                "output_dim": [1],
+                "output_names": ["node_energy"],
+            },
+            "Training": {
+                "num_epoch": args.num_epoch,
+                "batch_size": args.batch_size,
+                "perc_train": 0.8,
+                "loss_function_type": "mae",
+                "compute_grad_energy": True,
+                "Optimizer": {"type": "AdamW",
+                              "learning_rate": args.learning_rate},
+            },
+        },
+    }
+    state, history, model, completed = run_training(
+        config, datasets=splits, num_shards=args.num_shards)
+    print(json.dumps({"final_train_loss": history["train_loss"][-1],
+                      "final_val_loss": history["val_loss"][-1]}))
+    print(tr.print_timers())
+
+
+if __name__ == "__main__":
+    main()

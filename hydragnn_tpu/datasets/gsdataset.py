@@ -50,11 +50,18 @@ class GraphStoreWriter:
         self._n = 0
 
     def add(self, sample: GraphSample):
-        for key in _FIELDS:
-            val = getattr(sample, key)
-            if val is None:
-                continue
-            arr = np.atleast_1d(np.asarray(val))
+        present = tuple(k for k in _FIELDS if getattr(sample, k) is not None)
+        if self._n == 0:
+            self._present = present
+        elif present != self._present:
+            # count tables index by global sample id; a field present in
+            # only some samples would silently misalign every later read
+            raise ValueError(
+                f"sample {self._n} has fields {present} but the store was "
+                f"opened with {self._present}; optional fields must be "
+                "uniform across samples")
+        for key in present:
+            arr = np.atleast_1d(np.asarray(getattr(sample, key)))
             self._buffers.setdefault(key, []).append(arr)
             self._counts.setdefault(key, []).append(arr.shape[0])
         self._n += 1

@@ -78,7 +78,10 @@ class GraphDataLoader:
     def _collate_shard(self, samples: List[GraphSample]) -> GraphBatch:
         b = self._collate_shard_raw(samples)
         if self.batch_transform is not None:
-            b = self.batch_transform(b)
+            try:
+                b = self.batch_transform(b, samples)
+            except TypeError:
+                b = self.batch_transform(b)
         return b
 
     def _collate_shard_raw(self, samples: List[GraphSample]) -> GraphBatch:

@@ -1,0 +1,164 @@
+"""Minimal irreps algebra for equivariant models (MACE) — no e3nn.
+
+Features of angular momentum l are stored per-l as [N, mul, 2l+1] arrays
+(dict keyed by l). Real spherical harmonics use e3nn's "component"
+normalization (sum_m Y_lm^2 = 2l+1 on the unit sphere). Clebsch-Gordan
+tensors are derived at import time from sympy's complex CG coefficients via
+the complex->real change of basis, cached, and verified by the equivariance
+unit tests (tests/test_irreps.py).
+
+reference equivalents: e3nn o3.SphericalHarmonics / o3.Irreps used at
+hydragnn/models/MACEStack.py:131-135 and the U-matrix CG machinery at
+hydragnn/models/mace_utils/tools/cg.py:94 — re-derived here, not ported.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+LMAX_SUPPORTED = 3
+
+
+# --------------------------------------------------------------------------
+# Real spherical harmonics (component normalization), explicit closed forms
+# --------------------------------------------------------------------------
+
+def real_spherical_harmonics(vec, lmax: int, normalize: bool = True,
+                             eps: float = 1e-9) -> Dict[int, jnp.ndarray]:
+    """vec [..., 3] -> {l: [..., 2l+1]} for l = 0..lmax."""
+    assert lmax <= LMAX_SUPPORTED, f"lmax {lmax} > {LMAX_SUPPORTED}"
+    if normalize:
+        r = jnp.sqrt(jnp.sum(vec * vec, axis=-1, keepdims=True) + eps)
+        vec = vec / r
+    x, y, z = vec[..., 0], vec[..., 1], vec[..., 2]
+    out = {0: jnp.ones(x.shape + (1,), vec.dtype)}
+    if lmax >= 1:
+        s3 = np.sqrt(3.0)
+        out[1] = jnp.stack([s3 * y, s3 * z, s3 * x], axis=-1)
+    if lmax >= 2:
+        s15 = np.sqrt(15.0)
+        s5 = np.sqrt(5.0)
+        out[2] = jnp.stack([
+            s15 * x * y,
+            s15 * y * z,
+            0.5 * s5 * (3 * z * z - 1.0),
+            s15 * x * z,
+            0.5 * s15 * (x * x - y * y),
+        ], axis=-1)
+    if lmax >= 3:
+        c1 = np.sqrt(35.0 / 2.0) / 2.0   # sqrt(4pi)*1/4*sqrt(35/(2pi))
+        c2 = np.sqrt(105.0)              # sqrt(4pi)*1/2*sqrt(105/pi)
+        c3 = np.sqrt(21.0 / 2.0) / 2.0
+        c4 = np.sqrt(7.0) / 2.0
+        c5 = np.sqrt(105.0) / 2.0
+        out[3] = jnp.stack([
+            c1 * y * (3 * x * x - y * y),
+            c2 * x * y * z,
+            c3 * y * (5 * z * z - 1.0),
+            c4 * z * (5 * z * z - 3.0),
+            c3 * x * (5 * z * z - 1.0),
+            c5 * z * (x * x - y * y),
+            c1 * x * (x * x - 3 * y * y),
+        ], axis=-1)
+    return out
+
+
+# --------------------------------------------------------------------------
+# Real Clebsch-Gordan tensors (host precompute, sympy)
+# --------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _complex_to_real(l: int) -> np.ndarray:
+    """Unitary U with Y_real = U @ Y_complex, rows ordered m = -l..l.
+
+    Convention: m<0 rows combine +-|m| with i/sqrt2; m>0 with (-1)^m/sqrt2.
+    """
+    dim = 2 * l + 1
+    U = np.zeros((dim, dim), complex)
+    for m in range(-l, l + 1):
+        i = m + l
+        if m < 0:
+            U[i, -m + l] = 1j / np.sqrt(2) * (-1) ** m * -1
+            U[i, m + l] = 1j / np.sqrt(2)
+        elif m == 0:
+            U[i, l] = 1.0
+        else:
+            U[i, m + l] = (-1) ** m / np.sqrt(2)
+            U[i, -m + l] = 1 / np.sqrt(2)
+    return U
+
+
+@functools.lru_cache(maxsize=None)
+def clebsch_gordan(l1: int, l2: int, l3: int) -> np.ndarray:
+    """Real-basis CG tensor C[m1, m2, m3] with component normalization,
+    satisfying the intertwining property (verified in tests/test_irreps.py).
+    """
+    from sympy.physics.quantum.cg import CG
+    from sympy import S
+    d1, d2, d3 = 2 * l1 + 1, 2 * l2 + 1, 2 * l3 + 1
+    Cc = np.zeros((d1, d2, d3), complex)
+    for m1 in range(-l1, l1 + 1):
+        for m2 in range(-l2, l2 + 1):
+            m3 = m1 + m2
+            if abs(m3) > l3:
+                continue
+            c = CG(S(l1), S(m1), S(l2), S(m2), S(l3), S(m3)).doit()
+            Cc[m1 + l1, m2 + l2, m3 + l3] = float(c)
+    U1 = _complex_to_real(l1)
+    U2 = _complex_to_real(l2)
+    U3 = _complex_to_real(l3)
+    C = np.einsum("am,bn,co,mno->abc", U1.conj(), U2.conj(), U3, Cc)
+    # the real-basis tensor is purely real or purely imaginary
+    if np.abs(C.imag).max() > np.abs(C.real).max():
+        C = C.imag
+    else:
+        C = C.real
+    n = np.linalg.norm(C)
+    if n > 0:
+        C = C / n * np.sqrt(d3)  # component-normalization-friendly scale
+    return C.astype(np.float32)
+
+
+# --------------------------------------------------------------------------
+# Irreps feature containers and ops
+# --------------------------------------------------------------------------
+
+IrrepsDict = Dict[int, jnp.ndarray]  # {l: [..., mul, 2l+1]}
+
+
+def tensor_product(a: IrrepsDict, b: IrrepsDict, lmax_out: int,
+                   weights: Dict[Tuple[int, int, int], jnp.ndarray] = None
+                   ) -> IrrepsDict:
+    """Channel-wise (depthwise) tensor product: for every path (l1, l2 -> l3)
+    with |l1-l2| <= l3 <= min(l1+l2, lmax_out), contract with the real CG.
+    `weights[(l1,l2,l3)]` optionally scales per ([..., mul]) channel (e.g.
+    per-edge radial weights). Paths accumulate into the output l3 slot.
+    """
+    out: Dict[int, list] = {}
+    for l1, fa in a.items():
+        for l2, fb in b.items():
+            for l3 in range(abs(l1 - l2), min(l1 + l2, lmax_out) + 1):
+                Cnp = clebsch_gordan(l1, l2, l3)
+                if Cnp.size == 0 or np.abs(Cnp).max() == 0.0:
+                    continue
+                C = jnp.asarray(Cnp)
+                term = jnp.einsum("...ui,...uj,ijk->...uk", fa, fb, C)
+                if weights is not None and (l1, l2, l3) in weights:
+                    term = term * weights[(l1, l2, l3)][..., None]
+                out.setdefault(l3, []).append(term)
+    return {l: sum(v) for l, v in out.items()}
+
+
+def scalar_part(feats: IrrepsDict) -> jnp.ndarray:
+    """[..., mul] invariant channel (l=0)."""
+    return feats[0][..., 0]
+
+
+def norm_per_l(feats: IrrepsDict) -> jnp.ndarray:
+    """Concatenated invariant norms [..., mul * n_l] (for gates/readouts)."""
+    parts = [jnp.sqrt(jnp.sum(f * f, axis=-1) + 1e-12) for _, f in
+             sorted(feats.items())]
+    return jnp.concatenate(parts, axis=-1)

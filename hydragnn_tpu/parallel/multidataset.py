@@ -1,0 +1,111 @@
+"""Heterogeneous multi-dataset ("GFM") data parallelism.
+
+reference: examples/multidataset/train.py:188-328 — the world communicator
+is split into per-dataset groups sized proportionally to dataset size; each
+group trains on its own ADIOS file while gradients are still allreduced
+globally by DDP; PNA degree histograms are merged across datasets.
+
+TPU redesign: no communicator splits. The device-stacked batch layout
+(datasets/loader.py) already gives every device its own self-contained
+sub-batch, so "groups" become a static device->dataset assignment inside
+one data mesh; the single gradient pmean over the mesh IS the global
+allreduce. Each device slot runs its own shuffled epoch stream over its
+assigned dataset (proportional assignment, largest-remainder rounding).
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..graphs.batch import BucketSpec, GraphSample
+from ..datasets.loader import GraphDataLoader, _stack_batches
+
+
+def assign_shards_to_datasets(sizes: Sequence[int], num_shards: int) -> List[int]:
+    """Proportional device assignment with >=1 device per dataset
+    (reference: group sizing ∝ dataset size, examples/multidataset/train.py:
+    process-group construction)."""
+    n = len(sizes)
+    assert num_shards >= n, (
+        f"need at least one device shard per dataset ({n}), got {num_shards}")
+    total = float(sum(sizes))
+    raw = [s / total * num_shards for s in sizes]
+    counts = [max(1, int(math.floor(r))) for r in raw]
+    while sum(counts) > num_shards:
+        counts[int(np.argmax(counts))] -= 1
+    rema = [r - c for r, c in zip(raw, counts)]
+    while sum(counts) < num_shards:
+        i = int(np.argmax(rema))
+        counts[i] += 1
+        rema[i] = -1
+    out = []
+    for ds_idx, c in enumerate(counts):
+        out += [ds_idx] * c
+    return out
+
+
+def merge_pna_deg(histograms: Sequence[Sequence[int]]) -> List[int]:
+    """Merge per-dataset degree histograms into one
+    (reference merges via B-spline interpolation,
+    examples/multidataset/train.py:188-328; here histograms are exact counts
+    so zero-padding to the common max degree and summing is lossless)."""
+    maxlen = max(len(h) for h in histograms)
+    out = np.zeros(maxlen, np.int64)
+    for h in histograms:
+        out[:len(h)] += np.asarray(h, np.int64)
+    return out.tolist()
+
+
+class MultiDatasetLoader:
+    """Device-stacked batches where shard d draws from its assigned dataset.
+
+    All shards share one padded shape (the max over datasets) -> one
+    compiled program for the heterogeneous mix.
+    """
+
+    def __init__(self, datasets: Sequence[Sequence[GraphSample]],
+                 batch_size: int, num_shards: int, seed: int = 0,
+                 bucket: Optional[BucketSpec] = None):
+        assert batch_size % num_shards == 0
+        self.gps = batch_size // num_shards
+        self.assignment = assign_shards_to_datasets(
+            [len(d) for d in datasets], num_shards)
+        bucket = bucket or BucketSpec(multiple=64)
+        max_n = max(s.num_nodes for d in datasets for s in d)
+        max_e = max(s.num_edges for d in datasets for s in d)
+        n_node = bucket.bucket(max_n * self.gps + 1)
+        n_edge = bucket.bucket(max_e * self.gps + 1)
+        self.loaders = []
+        for shard, ds_idx in enumerate(self.assignment):
+            self.loaders.append(GraphDataLoader(
+                datasets[ds_idx], self.gps, shuffle=True,
+                seed=seed * 1000 + shard, num_shards=1,
+                n_node_per_shard=n_node, n_edge_per_shard=n_edge,
+                drop_last=True))
+        self.n_node, self.n_edge = n_node, n_edge
+        self.n_graph = self.gps + 1
+        self.graphs_per_shard = self.gps
+
+    def set_epoch(self, epoch: int):
+        for ld in self.loaders:
+            ld.set_epoch(epoch)
+
+    def __len__(self):
+        # one "epoch" = enough steps to cycle the largest shard stream once
+        return max(len(ld) for ld in self.loaders)
+
+    def __iter__(self):
+        iters = [iter(ld) for ld in self.loaders]
+        for _ in range(len(self)):
+            shards = []
+            for i, it in enumerate(iters):
+                try:
+                    shards.append(next(it))
+                except StopIteration:
+                    # smaller datasets cycle (fresh shuffled pass)
+                    self.loaders[i].set_epoch(self.loaders[i].epoch + 1)
+                    iters[i] = iter(self.loaders[i])
+                    shards.append(next(iters[i]))
+            yield _stack_batches(shards)

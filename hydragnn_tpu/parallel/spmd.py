@@ -100,16 +100,28 @@ def make_spmd_train_step(model, cfg: ModelConfig,
 
 
 def make_spmd_eval_step(model, cfg: ModelConfig, mesh: Mesh,
-                        loss_name: str = "mse"):
+                        loss_name: str = "mse",
+                        compute_grad_energy: bool = False,
+                        energy_weight: float = 1.0, force_weight: float = 1.0):
     def per_device(params, batch_stats, batch: GraphBatch):
         local = jax.tree_util.tree_map(
             lambda a: None if a is None else a[0], batch)
         variables = {"params": params, "batch_stats": batch_stats}
-        outputs, outputs_var = model.apply(variables, local, train=False)
-        total, tasks = multihead_loss(cfg, loss_name, outputs, outputs_var, local)
-        metrics = {"loss": total}
-        for i, t in enumerate(tasks):
-            metrics[f"task_{i}"] = t
+        if compute_grad_energy:
+            def apply_fn(v, b, train):
+                return model.apply(v, b, train=train)
+            total, aux = energy_force_loss(
+                apply_fn, variables, cfg, local, loss_name,
+                energy_weight, force_weight, train=False)
+            metrics = {"loss": total, "energy_loss": aux["energy_loss"],
+                       "force_loss": aux["force_loss"]}
+        else:
+            outputs, outputs_var = model.apply(variables, local, train=False)
+            total, tasks = multihead_loss(cfg, loss_name, outputs,
+                                          outputs_var, local)
+            metrics = {"loss": total}
+            for i, t in enumerate(tasks):
+                metrics[f"task_{i}"] = t
         # sample-weighted global mean: shards may hold unequal real-graph
         # counts (drop_last=False tail batches), so weight each shard's
         # masked mean by its real count before the cross-shard reduction

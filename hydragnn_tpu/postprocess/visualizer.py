@@ -1,0 +1,99 @@
+"""Visualizer — parity plots, error histograms, training history curves.
+
+reference: hydragnn/postprocess/visualizer.py:24-742 (Visualizer class:
+create_scatter_plots :692, plot_history :629, error histograms, per-node
+vector plots). Matplotlib is optional in this image; all methods degrade to
+writing the underlying data as .npz next to where the plot would go, so the
+artifacts exist either way.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def _plt():
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        return plt
+    except Exception:
+        return None
+
+
+class Visualizer:
+    """reference: Visualizer (postprocess/visualizer.py:24,66)."""
+
+    def __init__(self, model_with_config_name: str, node_feature: Optional[list] = None,
+                 num_heads: int = 1, head_dims: Optional[Sequence[int]] = None,
+                 plot_dir: str = "./logs"):
+        self.name = model_with_config_name
+        self.outdir = os.path.join(plot_dir, model_with_config_name,
+                                   "postprocess")
+        os.makedirs(self.outdir, exist_ok=True)
+        self.num_heads = num_heads
+        self.head_dims = head_dims or [1] * num_heads
+
+    def create_scatter_plots(self, trues: List[np.ndarray],
+                             preds: List[np.ndarray],
+                             output_names: Optional[Sequence[str]] = None):
+        """Parity scatter per head (reference: :692)."""
+        plt = _plt()
+        for ih, (t, p) in enumerate(zip(trues, preds)):
+            name = (output_names[ih] if output_names else f"head{ih}")
+            base = os.path.join(self.outdir, f"parity_{name}")
+            np.savez(base + ".npz", true=t, pred=p)
+            if plt is None:
+                continue
+            fig, ax = plt.subplots(figsize=(5, 5))
+            ax.scatter(t.reshape(-1), p.reshape(-1), s=4, alpha=0.5)
+            lo = min(t.min(), p.min())
+            hi = max(t.max(), p.max())
+            ax.plot([lo, hi], [lo, hi], "k--", lw=1)
+            rmse = float(np.sqrt(np.mean((t - p) ** 2)))
+            ax.set_title(f"{name} (RMSE {rmse:.4f})")
+            ax.set_xlabel("true")
+            ax.set_ylabel("predicted")
+            fig.tight_layout()
+            fig.savefig(base + ".png", dpi=120)
+            plt.close(fig)
+
+    def create_error_histograms(self, trues: List[np.ndarray],
+                                preds: List[np.ndarray],
+                                output_names: Optional[Sequence[str]] = None):
+        plt = _plt()
+        for ih, (t, p) in enumerate(zip(trues, preds)):
+            name = (output_names[ih] if output_names else f"head{ih}")
+            err = (p - t).reshape(-1)
+            base = os.path.join(self.outdir, f"errorhist_{name}")
+            np.savez(base + ".npz", err=err)
+            if plt is None:
+                continue
+            fig, ax = plt.subplots(figsize=(5, 4))
+            ax.hist(err, bins=50)
+            ax.set_xlabel("prediction error")
+            fig.tight_layout()
+            fig.savefig(base + ".png", dpi=120)
+            plt.close(fig)
+
+    def plot_history(self, history: Dict[str, List[float]]):
+        """Loss-history curves (reference: plot_history :629)."""
+        plt = _plt()
+        base = os.path.join(self.outdir, "history")
+        np.savez(base + ".npz", **{k: np.asarray(v) for k, v in history.items()})
+        if plt is None:
+            return
+        fig, ax = plt.subplots(figsize=(6, 4))
+        for key in ("train_loss", "val_loss", "test_loss"):
+            if key in history:
+                ax.plot(history[key], label=key)
+        ax.set_xlabel("epoch")
+        ax.set_ylabel("loss")
+        ax.set_yscale("log")
+        ax.legend()
+        fig.tight_layout()
+        fig.savefig(base + ".png", dpi=120)
+        plt.close(fig)

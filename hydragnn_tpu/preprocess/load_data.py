@@ -62,7 +62,8 @@ def _split_by_order(dataset, order, perc_train):
 def create_dataloaders(trainset, valset, testset, batch_size: int,
                        num_shards: int = 1, seed: int = 0,
                        n_node_per_shard: Optional[int] = None,
-                       n_edge_per_shard: Optional[int] = None):
+                       n_edge_per_shard: Optional[int] = None,
+                       batch_transform=None):
     """reference: load_data.py:225-296 — DataLoader + DistributedSampler;
     here one static-shape loader per split, all sharing the max padded shape
     so train/val/test reuse one compiled program."""
@@ -76,7 +77,7 @@ def create_dataloaders(trainset, valset, testset, batch_size: int,
     mk = lambda ds, shuffle: GraphDataLoader(
         ds, batch_size, shuffle=shuffle, seed=seed, num_shards=num_shards,
         n_node_per_shard=n_node_per_shard, n_edge_per_shard=n_edge_per_shard,
-        drop_last=shuffle)
+        drop_last=shuffle, batch_transform=batch_transform)
     return mk(trainset, True), mk(valset, False), mk(testset, False)
 
 

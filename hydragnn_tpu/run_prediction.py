@@ -36,15 +36,21 @@ def run_prediction(config_or_path, datasets: Optional[Tuple] = None,
 
     train_cfg = config["NeuralNetwork"]["Training"]
     batch_size = int(train_cfg["batch_size"])
+    from .graphs.triplets import maybe_triplet_transform
+    batch_transform = maybe_triplet_transform(
+        mcfg.model_type, trainset + valset + testset, batch_size)
     _, _, test_loader = create_dataloaders(trainset, valset, testset,
-                                           batch_size, num_shards=1)
+                                           batch_size, num_shards=1,
+                                           batch_transform=batch_transform)
     if model is None:
         model = create_model(mcfg)
     if state is None:
         init_batch = collate(
             testset[:min(len(testset), test_loader.graphs_per_shard)],
             n_node=test_loader.n_node, n_edge=test_loader.n_edge,
-            n_graph=test_loader.n_graph)
+            n_graph=test_loader.n_graph, np_out=True)
+        if batch_transform is not None:
+            init_batch = batch_transform(init_batch)
         variables = init_params(model, init_batch)
         tx = select_optimizer(train_cfg)
         template = TrainState.create(variables, tx)

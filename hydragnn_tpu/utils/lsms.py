@@ -1,0 +1,50 @@
+"""LSMS energy conversions: total energy -> formation enthalpy / Gibbs.
+
+reference: hydragnn/utils/lsms/convert_total_energy_to_formation_gibbs.py:30
+and compositional_histogram_cutoff.py:16.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from ..graphs.batch import GraphSample
+
+
+def convert_total_energy_to_formation_energy(
+        samples: Sequence[GraphSample], pure_energies: Dict[int, float],
+        type_column: int = 0) -> None:
+    """E_form = E_total - sum_i E_pure(type_i); in-place on y_graph[0]
+    (reference: convert_total_energy_to_formation_gibbs.py:30-120)."""
+    for s in samples:
+        types = np.round(s.x[:, type_column]).astype(int)
+        offset = sum(pure_energies.get(int(t), 0.0) for t in types)
+        s.y_graph = s.y_graph.copy()
+        s.y_graph[0] = s.y_graph[0] - offset
+
+
+def compositional_histogram_cutoff(
+        samples: Sequence[GraphSample], num_bins: int = 100,
+        cutoff_percentile: float = 95.0, type_column: int = 0,
+        reference_type: int = 0) -> List[GraphSample]:
+    """Drop samples from over-represented composition bins
+    (reference: compositional_histogram_cutoff.py:16-75): histogram the
+    concentration of `reference_type`, cap each bin at the
+    `cutoff_percentile` of bin counts."""
+    conc = np.asarray([
+        float(np.mean(np.round(s.x[:, type_column]).astype(int) ==
+                      reference_type))
+        for s in samples])
+    bins = np.linspace(0.0, 1.0, num_bins + 1)
+    which = np.clip(np.digitize(conc, bins) - 1, 0, num_bins - 1)
+    counts = np.bincount(which, minlength=num_bins)
+    cap = int(np.percentile(counts[counts > 0], cutoff_percentile))
+    kept: List[GraphSample] = []
+    used = np.zeros(num_bins, int)
+    for i, s in enumerate(samples):
+        b = which[i]
+        if used[b] < cap:
+            kept.append(s)
+            used[b] += 1
+    return kept

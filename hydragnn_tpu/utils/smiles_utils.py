@@ -1,0 +1,109 @@
+"""SMILES -> graph conversion.
+
+reference: hydragnn/utils/descriptors_and_embeddings/smiles_utils.py:35,49
+(rdkit molecule to PyG Data: atom one-hots + degree/aromaticity features,
+bond-order edges). rdkit is not in this image; when absent we fall back to
+a built-in minimal SMILES parser covering the organic subset (atoms
+B C N O P S F Cl Br I, rings, branches, - = # bonds, charges in brackets) —
+enough for QM9/OGB-style molecules; rdkit is used automatically if present.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..graphs.batch import GraphSample
+
+_ORGANIC = ["B", "C", "N", "O", "P", "S", "F", "Cl", "Br", "I", "H"]
+_Z = {"H": 1, "B": 5, "C": 6, "N": 7, "O": 8, "F": 9, "P": 15, "S": 16,
+      "Cl": 17, "Br": 35, "I": 53}
+
+_TOKEN = re.compile(
+    r"(\[[^\]]+\]|Cl|Br|[BCNOPSFI]|[bcnops]|=|#|\(|\)|[0-9]|%[0-9]{2}|[-+.\\/])")
+
+
+def parse_smiles(smiles: str) -> Tuple[List[int], List[Tuple[int, int, int]]]:
+    """Minimal SMILES parser -> (atomic_numbers, bonds(i, j, order))."""
+    atoms: List[int] = []
+    bonds: List[Tuple[int, int, int]] = []
+    stack: List[int] = []
+    prev = -1
+    order = 1
+    rings: Dict[str, Tuple[int, int]] = {}
+    for tok in _TOKEN.findall(smiles):
+        if tok in ("(",):
+            stack.append(prev)
+        elif tok == ")":
+            prev = stack.pop()
+        elif tok == "=":
+            order = 2
+        elif tok == "#":
+            order = 3
+        elif tok == ".":
+            prev = -1  # disconnected component: break the chain
+            order = 1
+        elif tok in ("-", "/", "\\"):
+            order = 1
+        elif tok.isdigit() or tok.startswith("%"):
+            key = tok
+            if key in rings:
+                j, o = rings.pop(key)
+                bonds.append((prev, j, max(order, o)))
+            else:
+                rings[key] = (prev, order)
+            order = 1
+        else:
+            if tok.startswith("["):
+                m = re.match(r"\[[0-9]*([A-Za-z][a-z]?)", tok)
+                sym = m.group(1)
+                sym = sym.capitalize() if sym.lower() in (
+                    "b", "c", "n", "o", "p", "s") and len(sym) == 1 else sym
+            else:
+                sym = tok.capitalize() if tok in "bcnops" else tok
+            z = _Z.get(sym)
+            if z is None:
+                raise ValueError(f"unsupported atom '{tok}' in '{smiles}'")
+            atoms.append(z)
+            idx = len(atoms) - 1
+            if prev >= 0:
+                bonds.append((prev, idx, order))
+            prev = idx
+            order = 1
+    return atoms, bonds
+
+
+def generate_graphdata_from_smilestr(
+        smiles: str, y: Optional[np.ndarray] = None,
+        types: Optional[List[str]] = None) -> GraphSample:
+    """SMILES string -> GraphSample (reference: smiles_utils.py:49
+    generate_graphdata_from_smilestr). Uses rdkit when available for exact
+    aromaticity/H-counts; falls back to the built-in parser."""
+    try:
+        from rdkit import Chem
+        mol = Chem.MolFromSmiles(smiles)
+        mol = Chem.AddHs(mol)
+        atoms = [a.GetAtomicNum() for a in mol.GetAtoms()]
+        bonds = [(b.GetBeginAtomIdx(), b.GetEndAtomIdx(),
+                  int(b.GetBondTypeAsDouble())) for b in mol.GetBonds()]
+    except ImportError:
+        atoms, bonds = parse_smiles(smiles)
+    z = np.asarray(atoms, np.float32)
+    types = types or _ORGANIC
+    one_hot = np.zeros((len(atoms), len(types)), np.float32)
+    for i, a in enumerate(atoms):
+        sym = {v: k for k, v in _Z.items()}[a]
+        if sym in types:
+            one_hot[i, types.index(sym)] = 1.0
+    x = np.concatenate([z[:, None], one_hot], axis=1)
+    send, recv, orders = [], [], []
+    for i, j, o in bonds:
+        send += [i, j]
+        recv += [j, i]
+        orders += [o, o]
+    return GraphSample(
+        x=x, pos=np.zeros((len(atoms), 3), np.float32),
+        senders=np.asarray(send, np.int32), receivers=np.asarray(recv, np.int32),
+        edge_attr=np.asarray(orders, np.float32)[:, None],
+        y_graph=y)

@@ -1,0 +1,79 @@
+"""Rotation invariance/equivariance of model outputs — analogue of the
+reference's tests/test_rotational_invariance.py and
+test_forces_equivariant.py (property level, no training)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hydragnn_tpu.models.create import create_model, init_params
+from tests.deterministic_data import deterministic_graph_dataset
+from tests.utils import prepare
+
+
+def _rotate_batch(batch, R):
+    import dataclasses
+    pos = np.asarray(batch.pos) @ R.T
+    return dataclasses.replace(batch, pos=jnp.asarray(pos.astype(np.float32)))
+
+
+def _random_rotation(seed=0):
+    from scipy.spatial.transform import Rotation
+    return Rotation.random(random_state=seed).as_matrix()
+
+
+EQUIVARIANT = [
+    ("EGNN", dict(equivariance=True)),
+    ("SchNet", dict(equivariance=True)),
+    ("PAINN", dict(equivariance=True)),
+    ("PNAEq", dict(equivariance=True)),
+    ("MACE", dict(equivariance=True, max_ell=2, node_max_ell=1,
+                  correlation=[2])),
+]
+
+
+@pytest.mark.parametrize("model_type,arch", EQUIVARIANT,
+                         ids=[m for m, _ in EQUIVARIANT])
+def test_invariant_outputs_under_rotation(model_type, arch):
+    samples = deterministic_graph_dataset(num_configs=6, heads=("graph",))
+    cfg, mcfg, batch = prepare(model_type, samples, **arch)
+    model = create_model(mcfg)
+    variables = init_params(model, batch)
+    out1, _ = model.apply(variables, batch, train=False)
+    R = _random_rotation(5)
+    out2, _ = model.apply(variables, _rotate_batch(batch, R), train=False)
+    gm = np.asarray(batch.graph_mask)
+    np.testing.assert_allclose(np.asarray(out1[0])[gm],
+                               np.asarray(out2[0])[gm],
+                               rtol=5e-3, atol=5e-4)
+
+
+def test_forces_rotate_covariantly():
+    """Force predictions (−dE/dpos of an invariant energy) must rotate with
+    the frame (reference: test_forces_equivariant.py intent)."""
+    from hydragnn_tpu.train.loss import energy_force_loss
+    import dataclasses
+    samples = deterministic_graph_dataset(num_configs=6, heads=("node",))
+    for s in samples:
+        s.energy = np.asarray([float(s.y_node.sum())], np.float32)
+        s.forces = np.zeros((s.num_nodes, 3), np.float32)
+    cfg, mcfg, _ = prepare("EGNN", samples, heads=("node",),
+                           equivariance=True)
+    from hydragnn_tpu.graphs.batch import collate
+    batch = collate(samples[:4])
+    model = create_model(mcfg)
+    variables = init_params(model, batch)
+
+    def apply_fn(v, b, train):
+        return model.apply(v, b, train=train)
+
+    _, aux1 = energy_force_loss(apply_fn, variables, mcfg, batch)
+    R = _random_rotation(7)
+    rb = dataclasses.replace(
+        batch, pos=jnp.asarray((np.asarray(batch.pos) @ R.T).astype(np.float32)))
+    _, aux2 = energy_force_loss(apply_fn, variables, mcfg, rb)
+    nm = np.asarray(batch.node_mask)
+    f1 = np.asarray(aux1["forces_pred"])[nm]
+    f2 = np.asarray(aux2["forces_pred"])[nm]
+    np.testing.assert_allclose(f2, f1 @ R.T, rtol=5e-3, atol=1e-4)

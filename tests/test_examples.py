@@ -1,0 +1,32 @@
+"""Smoke-run examples as subprocesses (reference: tests/test_examples.py:18-26
+runs qm9/md17/LennardJones CLIs the same way)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=560):
+    env = dict(os.environ)
+    return subprocess.run([sys.executable] + args, cwd=REPO, timeout=timeout,
+                          capture_output=True, text=True, env=env)
+
+
+@pytest.mark.parametrize("model_type", ["SchNet", "EGNN"])
+def test_lennard_jones_example(model_type):
+    r = _run(["examples/LennardJones/LennardJones.py",
+              "--model_type", model_type, "--num_configs", "40",
+              "--num_epoch", "2", "--batch_size", "8", "--hidden_dim", "8",
+              "--cpu"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final_train_loss" in r.stdout
+
+
+def test_lennard_jones_preonly_graphstore(tmp_path):
+    r = _run(["examples/LennardJones/LennardJones.py", "--preonly",
+              "--num_configs", "10", "--format", "graphstore", "--cpu"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "wrote 10 samples" in r.stdout

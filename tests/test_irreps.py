@@ -1,0 +1,106 @@
+"""Equivariance verification of the irreps machinery (SH + real CG).
+
+These tests are load-bearing: MACE's correctness rests on them
+(the reference leans on e3nn's tested algebra; we must prove ours)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from hydragnn_tpu.ops.irreps import (clebsch_gordan, real_spherical_harmonics,
+                                     tensor_product)
+
+
+def _wigner_d_from_sh(l, R, n=50, seed=0):
+    """Numerically recover D_l(R) from Y_l(Rv) = D_l(R) Y_l(v)."""
+    rng = np.random.RandomState(seed)
+    V = rng.randn(n, 3)
+    V /= np.linalg.norm(V, axis=1, keepdims=True)
+    Y = np.asarray(real_spherical_harmonics(jnp.asarray(V), l)[l])
+    YR = np.asarray(real_spherical_harmonics(jnp.asarray(V @ R.T), l)[l])
+    # solve D Y^T = YR^T  ->  D = YR^T Y (Y^T Y)^-1
+    D, *_ = np.linalg.lstsq(Y, YR, rcond=None)
+    return D.T
+
+
+def _random_rotation(seed):
+    from scipy.spatial.transform import Rotation
+    return Rotation.random(random_state=seed).as_matrix()
+
+
+class TestSphericalHarmonics:
+    @pytest.mark.parametrize("l", [0, 1, 2, 3])
+    def test_component_normalization(self, l):
+        rng = np.random.RandomState(1)
+        v = rng.randn(200, 3)
+        Y = np.asarray(real_spherical_harmonics(jnp.asarray(v), l)[l])
+        np.testing.assert_allclose(np.sum(Y ** 2, axis=1), 2 * l + 1,
+                                   rtol=1e-4)
+
+    @pytest.mark.parametrize("l", [1, 2, 3])
+    def test_rotation_representation(self, l):
+        """Y_l(Rv) = D_l(R) Y_l(v) with D orthogonal (it's a representation)."""
+        R = _random_rotation(3)
+        D = _wigner_d_from_sh(l, R)
+        np.testing.assert_allclose(D @ D.T, np.eye(2 * l + 1), atol=1e-5)
+        rng = np.random.RandomState(4)
+        v = rng.randn(20, 3)
+        v /= np.linalg.norm(v, axis=1, keepdims=True)
+        Y = np.asarray(real_spherical_harmonics(jnp.asarray(v), l)[l])
+        YR = np.asarray(real_spherical_harmonics(jnp.asarray(v @ R.T), l)[l])
+        np.testing.assert_allclose(YR, Y @ D.T, atol=1e-5)
+
+
+class TestClebschGordan:
+    @pytest.mark.parametrize("l1,l2,l3", [(1, 1, 0), (1, 1, 1), (1, 1, 2),
+                                          (2, 1, 1), (2, 2, 2), (2, 1, 3),
+                                          (3, 2, 1)])
+    def test_intertwining(self, l1, l2, l3):
+        """CG contraction commutes with rotation: the core equivariance
+        property every MACE layer relies on."""
+        C = clebsch_gordan(l1, l2, l3)
+        assert np.isfinite(C).all() and np.abs(C).max() > 0
+        R = _random_rotation(7)
+        D1 = _wigner_d_from_sh(l1, R)
+        D2 = _wigner_d_from_sh(l2, R)
+        D3 = _wigner_d_from_sh(l3, R)
+        rng = np.random.RandomState(8)
+        x = rng.randn(5, 2 * l1 + 1)
+        y = rng.randn(5, 2 * l2 + 1)
+        lhs = np.einsum("ni,nj,ijk->nk", x @ D1.T, y @ D2.T, C)
+        rhs = np.einsum("ni,nj,ijk->nk", x, y, C) @ D3.T
+        np.testing.assert_allclose(lhs, rhs, atol=1e-4)
+
+    def test_gaunt_selfconsistency(self):
+        """Y_1 x Y_1 -> l=2 of the same vector is proportional to Y_2."""
+        rng = np.random.RandomState(9)
+        v = rng.randn(30, 3)
+        sh = real_spherical_harmonics(jnp.asarray(v), 2)
+        C = clebsch_gordan(1, 1, 2)
+        prod = np.einsum("ni,nj,ijk->nk", np.asarray(sh[1]),
+                         np.asarray(sh[1]), C)
+        Y2 = np.asarray(sh[2])
+        ratio = prod / np.where(np.abs(Y2) > 1e-3, Y2, np.nan)
+        med = np.nanmedian(ratio)
+        np.testing.assert_allclose(np.nan_to_num(ratio, nan=med), med,
+                                   rtol=1e-3)
+
+
+def test_tensor_product_equivariance():
+    """Full tensor_product over an irreps dict commutes with rotation."""
+    rng = np.random.RandomState(11)
+    R = _random_rotation(12)
+    mul = 4
+    a = {l: rng.randn(6, mul, 2 * l + 1).astype(np.float32) for l in (0, 1, 2)}
+    b = {l: rng.randn(6, 1, 2 * l + 1).astype(np.float32) for l in (0, 1)}
+    Ds = {l: _wigner_d_from_sh(l, R) if l else np.ones((1, 1))
+          for l in (0, 1, 2, 3)}
+    rot = lambda d: {l: jnp.asarray(f @ Ds[l].T) for l, f in d.items()}
+    out1 = tensor_product(rot(a), rot(b), lmax_out=3)
+    out2 = {l: jnp.asarray(np.asarray(f) @ Ds[l].T)
+            for l, f in tensor_product(
+                {l: jnp.asarray(f) for l, f in a.items()},
+                {l: jnp.asarray(f) for l, f in b.items()}, lmax_out=3).items()}
+    for l in out1:
+        np.testing.assert_allclose(np.asarray(out1[l]), np.asarray(out2[l]),
+                                   atol=2e-4)

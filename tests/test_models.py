@@ -15,6 +15,22 @@ from tests.utils import prepare
 
 INVARIANT_MODELS = ["GIN", "SAGE", "GAT", "MFC", "CGCNN", "PNA", "PNAPlus",
                     "SchNet", "EGNN"]
+ALL_MODELS = INVARIANT_MODELS + ["PAINN", "PNAEq", "DimeNet", "MACE"]
+
+
+def _prepare_any(model_type, samples, **kw):
+    arch = {}
+    if model_type == "MACE":
+        arch = dict(max_ell=2, node_max_ell=1, correlation=[2])
+    arch.update(kw)
+    cfg, mcfg, batch = prepare(model_type, samples, **arch)
+    if model_type == "DimeNet":
+        import dataclasses
+        import numpy as np
+        from hydragnn_tpu.graphs.triplets import add_triplets, triplet_budget
+        batch = jax.tree_util.tree_map(lambda a: np.asarray(a), batch)
+        batch = add_triplets(batch, triplet_budget(samples[:8], 8))
+    return cfg, mcfg, batch
 
 
 @pytest.fixture(scope="module")
@@ -22,9 +38,9 @@ def samples():
     return deterministic_graph_dataset(num_configs=12, heads=("graph", "node"))
 
 
-@pytest.mark.parametrize("model_type", INVARIANT_MODELS)
+@pytest.mark.parametrize("model_type", ALL_MODELS)
 def test_forward_shapes_singlehead(model_type, samples):
-    cfg, mcfg, batch = prepare(model_type, samples)
+    cfg, mcfg, batch = _prepare_any(model_type, samples)
     model = create_model(mcfg)
     variables = init_params(model, batch)
     (outputs, outputs_var) = model.apply(variables, batch, train=False)
@@ -34,9 +50,11 @@ def test_forward_shapes_singlehead(model_type, samples):
     assert np.all(np.isfinite(np.asarray(outputs[0])))
 
 
-@pytest.mark.parametrize("model_type", ["GIN", "PNA", "SchNet", "EGNN"])
+@pytest.mark.parametrize("model_type", ["GIN", "PNA", "SchNet", "EGNN",
+                                        "PAINN", "PNAEq", "MACE"])
 def test_forward_multihead(model_type, samples):
-    cfg, mcfg, batch = prepare(model_type, samples, heads=("graph", "node"))
+    cfg, mcfg, batch = _prepare_any(model_type, samples,
+                                    heads=("graph", "node"))
     model = create_model(mcfg)
     variables = init_params(model, batch)
     outputs, _ = model.apply(variables, batch, train=False)
