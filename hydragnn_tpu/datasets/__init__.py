@@ -1,0 +1,6 @@
+from .gsdataset import GraphStoreDataset, GraphStoreWriter
+from .pickledataset import SimplePickleDataset, SimplePickleWriter
+from .lsmsdataset import LSMSDataset, load_lsms_splits
+from .xyzdataset import XYZDataset, load_xyz_splits
+from .cfgdataset import CFGDataset, load_cfg_splits
+from .ddstore import DDStore, DistDataset

@@ -1,0 +1,123 @@
+"""CFG (AtomEye) raw dataset.
+
+reference: hydragnn/utils/datasets/cfgdataset.py:11-83 (ase.io.cfg.read_cfg;
+node features = [Z, mass, c_peratom, fx, fy, fz]; graph target from a
+``<stem>.bulk`` sidecar) on the AbstractRawDataset pipeline.
+
+ase is not in this image; this parses the standard AtomEye CFG layout:
+``Number of particles``, ``H0(i,j)`` cell rows, ``entry_count``,
+``auxiliary[k]`` names, then per-atom blocks of (mass line, symbol line,
+scaled-coordinates + auxiliary line). Cartesian pos = s @ H0.
+"""
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Tuple
+
+import numpy as np
+
+from ..graphs.batch import GraphSample
+from ..preprocess.load_data import split_dataset
+from ..preprocess.transforms import build_graph_sample, normalize_edge_lengths
+from ..utils.elements import symbol_to_z
+from .xyzdataset import _read_sidecar_graph_feats
+
+
+def parse_cfg_file(filepath: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """-> (node_features [N, 2+naux], pos [N,3], cell [3,3]).
+
+    node_features columns: [Z, mass, aux...] (aux order as declared by the
+    file's auxiliary[] entries, typically c_peratom, fx, fy, fz)."""
+    h0 = np.zeros((3, 3), np.float64)
+    natoms = None
+    entry_count = None
+    aux_names = {}
+    rows = []
+    cur_mass, cur_z = None, None
+    has_velocity = True  # until .NO_VELOCITY. seen (AtomEye default layout)
+    with open(filepath, encoding="utf-8") as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "=" in line and not line[0].isdigit() and not line[0] == "-":
+                key, _, val = line.partition("=")
+                key, val = key.strip(), val.strip().split()[0]
+                if key == "Number of particles":
+                    natoms = int(val)
+                elif key.startswith("H0("):
+                    i, j = int(key[3]), int(key[5])
+                    h0[i - 1, j - 1] = float(val)
+                elif key == "entry_count":
+                    entry_count = int(val)
+                elif key.startswith("auxiliary["):
+                    aux_names[int(key[10:key.index("]")])] = val
+                continue
+            if line == ".NO_VELOCITY.":
+                has_velocity = False
+                continue
+            tok = line.split()
+            if len(tok) == 1 and natoms is not None:
+                if tok[0][0].isdigit():
+                    cur_mass = float(tok[0])       # mass line
+                else:
+                    cur_z = symbol_to_z(tok[0])    # symbol line
+                continue
+            if len(tok) >= 3 and cur_z is not None:
+                vals = [float(t) for t in tok]
+                s = np.asarray(vals[:3])
+                # velocities (3 cols after scaled coords, unless
+                # .NO_VELOCITY.) are positional metadata, not aux features —
+                # matching ase's reader which splits them out
+                aux_start = 6 if has_velocity else 3
+                aux = (vals[aux_start:entry_count] if entry_count
+                       else vals[aux_start:])
+                pos = s @ h0
+                rows.append([float(cur_z), float(cur_mass)] + list(pos) + aux)
+    if natoms is None or not rows:
+        raise ValueError(f"malformed CFG file {filepath}")
+    arr = np.asarray(rows, np.float64)
+    z_mass = arr[:, :2]
+    pos = arr[:, 2:5]
+    aux = arr[:, 5:]
+    feats = np.concatenate([z_mass, aux], axis=1).astype(np.float32)
+    return feats, pos.astype(np.float32), h0.astype(np.float32)
+
+
+class CFGDataset:
+    """Directory of ``*.cfg`` files (+ optional ``*.bulk`` graph-target
+    sidecars) -> GraphSamples."""
+
+    def __init__(self, config: Dict, dirpath: str):
+        ds = config["Dataset"]
+        gf = ds.get("graph_features", {"dim": [], "column_index": []})
+        files = sorted(glob.glob(os.path.join(dirpath, "*.cfg")))
+        if not files:
+            raise FileNotFoundError(f"no .cfg files in {dirpath}")
+        self.samples = []
+        for fp in files:
+            feats, pos, cell = parse_cfg_file(fp)
+            gfeat = _read_sidecar_graph_feats(
+                os.path.splitext(fp)[0] + ".bulk",
+                gf["dim"], gf["column_index"])
+            self.samples.append(build_graph_sample(
+                feats, pos, config, graph_feats=gfeat, cell=cell))
+        normalize_edge_lengths(self.samples)
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i) -> GraphSample:
+        return self.samples[i]
+
+    def __iter__(self):
+        return iter(self.samples)
+
+
+def load_cfg_splits(config: Dict):
+    ds = config["Dataset"]
+    total = CFGDataset(config, ds["path"]["total"])
+    perc = config["NeuralNetwork"]["Training"].get("perc_train", 0.7)
+    return split_dataset(list(total), perc,
+                         ds.get("compositional_stratified_splitting", False))

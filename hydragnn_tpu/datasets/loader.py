@@ -47,6 +47,7 @@ class GraphDataLoader:
         self.shuffle = shuffle
         self.seed = seed
         self.epoch = 0
+        self._transform_arity = None
         self.drop_last = shuffle if drop_last is None else drop_last
         bucket = bucket or BucketSpec(multiple=64)
         if n_node_per_shard is None or n_edge_per_shard is None:
@@ -78,11 +79,23 @@ class GraphDataLoader:
     def _collate_shard(self, samples: List[GraphSample]) -> GraphBatch:
         b = self._collate_shard_raw(samples)
         if self.batch_transform is not None:
-            try:
-                b = self.batch_transform(b, samples)
-            except TypeError:
-                b = self.batch_transform(b)
+            b = self._apply_transform(b, samples)
         return b
+
+    def _apply_transform(self, b: GraphBatch, samples) -> GraphBatch:
+        if self._transform_arity is None:
+            import inspect
+            try:
+                params = [
+                    p for p in inspect.signature(
+                        self.batch_transform).parameters.values()
+                    if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+                self._transform_arity = min(len(params), 2)
+            except (TypeError, ValueError):
+                self._transform_arity = 1
+        if self._transform_arity >= 2:
+            return self.batch_transform(b, samples)
+        return self.batch_transform(b)
 
     def _collate_shard_raw(self, samples: List[GraphSample]) -> GraphBatch:
         if not samples:

@@ -1,0 +1,104 @@
+"""XYZ / extended-XYZ raw dataset.
+
+reference: hydragnn/utils/datasets/xyzdataset.py:11-70 (ase.io.read of a
+.xyz file; node features = proton numbers; graph features read from a
+``<stem>_energy.txt`` sidecar selected by graph_feature column indices) on
+top of the AbstractRawDataset pipeline (utils/datasets/abstractrawdataset.py:29).
+
+ase is not in this image, so the (ext)XYZ parser is hand-rolled: it
+understands plain XYZ and the extxyz ``Lattice="..."`` comment convention.
+"""
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..graphs.batch import GraphSample
+from ..preprocess.load_data import split_dataset
+from ..preprocess.transforms import build_graph_sample, normalize_edge_lengths
+from ..utils.elements import symbol_to_z
+
+
+def parse_xyz_file(filepath: str) -> Tuple[np.ndarray, np.ndarray,
+                                           Optional[np.ndarray]]:
+    """-> (atomic_numbers [N,1] float32, pos [N,3] float32, cell [3,3]|None)."""
+    with open(filepath, encoding="utf-8") as f:
+        lines = f.readlines()
+    natoms = int(lines[0].split()[0])
+    comment = lines[1] if len(lines) > 1 else ""
+    cell = None
+    m = re.search(r'Lattice\s*=\s*"([^"]+)"', comment)
+    if m:
+        vals = [float(v) for v in m.group(1).split()]
+        cell = np.asarray(vals, np.float32).reshape(3, 3)
+    zs, pos = [], []
+    for line in lines[2:2 + natoms]:
+        tok = line.split()
+        sym = tok[0]
+        z = int(sym) if sym.isdigit() else symbol_to_z(sym)
+        zs.append(z)
+        pos.append([float(tok[1]), float(tok[2]), float(tok[3])])
+    return (np.asarray(zs, np.float32)[:, None],
+            np.asarray(pos, np.float32), cell)
+
+
+def _read_sidecar_graph_feats(filepath: str, graph_feature_dims,
+                              graph_feature_cols) -> Optional[np.ndarray]:
+    """Graph targets from ``<stem>_energy.txt`` (XYZ) or ``<stem>.bulk``
+    (CFG) sidecars (reference: xyzdataset.py:55-68, cfgdataset.py:68-81)."""
+    if not os.path.exists(filepath):
+        return None
+    with open(filepath, encoding="utf-8") as f:
+        tok = f.readline().split()
+    feats = []
+    for item, dim in enumerate(graph_feature_dims):
+        for icomp in range(dim):
+            feats.append(float(tok[graph_feature_cols[item] + icomp]))
+    return np.asarray(feats, np.float32)
+
+
+class XYZDataset:
+    """Directory of ``*.xyz`` files (+ ``*_energy.txt`` graph-target
+    sidecars) -> GraphSamples through the standard raw pipeline."""
+
+    def __init__(self, config: Dict, dirpath: str):
+        ds = config["Dataset"]
+        gf = ds.get("graph_features", {"dim": [], "column_index": []})
+        files = sorted(glob.glob(os.path.join(dirpath, "*.xyz")))
+        if not files:
+            raise FileNotFoundError(f"no .xyz files in {dirpath}")
+        needs_graph_target = "graph" in config["NeuralNetwork"][
+            "Variables_of_interest"]["type"]
+        self.samples = []
+        for fp in files:
+            z, pos, cell = parse_xyz_file(fp)
+            sidecar = os.path.splitext(fp)[0] + "_energy.txt"
+            gfeat = _read_sidecar_graph_feats(
+                sidecar, gf["dim"], gf["column_index"])
+            if gfeat is None and needs_graph_target:
+                raise FileNotFoundError(
+                    f"graph target requested but sidecar {sidecar} missing")
+            self.samples.append(build_graph_sample(
+                z, pos, config, graph_feats=gfeat, cell=cell))
+        normalize_edge_lengths(self.samples)
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i) -> GraphSample:
+        return self.samples[i]
+
+    def __iter__(self):
+        return iter(self.samples)
+
+
+def load_xyz_splits(config: Dict):
+    ds = config["Dataset"]
+    total = XYZDataset(config, ds["path"]["total"])
+    perc = config["NeuralNetwork"]["Training"].get("perc_train", 0.7)
+    return split_dataset(list(total), perc,
+                         ds.get("compositional_stratified_splitting", False))

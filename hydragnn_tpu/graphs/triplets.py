@@ -116,11 +116,14 @@ class TripletTransform:
         self._cache: dict = {}
 
     def _lookup(self, s) -> Tuple[np.ndarray, np.ndarray]:
-        key = id(s)
+        # content key, not id(s): datasets that materialize fresh GraphSample
+        # objects per access would alias reused ids
+        send = np.asarray(s.senders)
+        recv = np.asarray(s.receivers)
+        key = (send.shape[0], hash(send.tobytes()), hash(recv.tobytes()))
         hit = self._cache.get(key)
         if hit is None:
-            hit = sample_triplets(np.asarray(s.senders),
-                                  np.asarray(s.receivers))
+            hit = sample_triplets(send, recv)
             self._cache[key] = hit
         return hit
 

@@ -49,6 +49,12 @@ def _load_datasets_from_config(config):
         from .datasets.gsdataset import GraphStoreDataset
         return tuple(GraphStoreDataset(ds["path"][k])
                      for k in ("train", "validate", "test"))
+    if fmt == "XYZ":
+        from .datasets.xyzdataset import load_xyz_splits
+        return load_xyz_splits(config)
+    if fmt == "CFG":
+        from .datasets.cfgdataset import load_cfg_splits
+        return load_cfg_splits(config)
     raise ValueError(f"unsupported Dataset.format '{fmt}'")
 
 

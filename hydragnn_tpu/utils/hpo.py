@@ -79,18 +79,15 @@ class SearchSpace:
     def sample(self, rng: np.random.RandomState) -> Dict[str, Any]:
         out = {}
         for k, v in self.space.items():
-            if isinstance(v, (list, tuple)) and len(v) == 2 and all(
-                    isinstance(x, (int, float)) and not isinstance(x, bool)
-                    for x in v) and not isinstance(v, list):
-                lo, hi = v
-                out[k] = rng.uniform(lo, hi)
-            elif isinstance(v, list):
+            if isinstance(v, list):
                 out[k] = v[rng.randint(len(v))]
-            elif isinstance(v, tuple):
+            elif isinstance(v, tuple) and len(v) == 2:
                 lo, hi = v
                 if isinstance(lo, int) and isinstance(hi, int):
                     out[k] = int(rng.randint(lo, hi + 1))
                 else:
+                    # log-uniform for float ranges, matching the optuna
+                    # backend's suggest_float(log=True)
                     out[k] = float(10 ** rng.uniform(np.log10(lo),
                                                      np.log10(hi)))
             else:

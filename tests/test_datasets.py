@@ -118,3 +118,77 @@ def test_lsms_text_roundtrip(tmp_path):
     s = ds[0]
     assert s.x.shape[1] == 1 and s.y_graph.shape == (1,)
     assert s.num_edges > 0
+
+
+def _fmt_config(fmt, path):
+    import copy
+    from tests.utils import BASE_CONFIG
+    cfg = copy.deepcopy(BASE_CONFIG)
+    cfg["Dataset"]["format"] = fmt
+    cfg["Dataset"]["path"] = {"total": path}
+    cfg["NeuralNetwork"]["Architecture"]["radius"] = 2.0
+    return cfg
+
+
+def test_xyz_dataset(tmp_path):
+    from hydragnn_tpu.datasets.xyzdataset import XYZDataset
+    rng = np.random.RandomState(0)
+    for i in range(4):
+        n = 5 + i
+        pos = rng.rand(n, 3) * 3
+        with open(tmp_path / f"s{i}.xyz", "w") as f:
+            f.write(f"{n}\n")
+            f.write('Lattice="3 0 0 0 3 0 0 0 3"\n')
+            for p in pos:
+                f.write(f"C {p[0]:.6f} {p[1]:.6f} {p[2]:.6f}\n")
+        with open(tmp_path / f"s{i}_energy.txt", "w") as f:
+            f.write(f"{rng.rand():.6f}\n")
+    cfg = _fmt_config("XYZ", str(tmp_path))
+    cfg["Dataset"]["node_features"] = {"name": ["Z"], "dim": [1],
+                                       "column_index": [0]}
+    ds = XYZDataset(cfg, str(tmp_path))
+    assert len(ds) == 4
+    s = ds[0]
+    assert s.num_nodes == 5
+    assert s.x.shape == (5, 1)
+    assert s.y_graph.shape == (1,)
+    assert s.cell is not None and s.cell.shape == (3, 3)
+    assert s.num_edges > 0
+
+
+def test_cfg_dataset(tmp_path):
+    from hydragnn_tpu.datasets.cfgdataset import CFGDataset
+    rng = np.random.RandomState(1)
+    for i in range(3):
+        n = 4
+        s = rng.rand(n, 3)
+        with open(tmp_path / f"c{i}.cfg", "w") as f:
+            f.write(f"Number of particles = {n}\n")
+            f.write("A = 1.0 Angstrom (basic length-scale)\n")
+            for a in range(3):
+                for b in range(3):
+                    v = 4.0 if a == b else 0.0
+                    f.write(f"H0({a+1},{b+1}) = {v} A\n")
+            f.write(".NO_VELOCITY.\n")
+            f.write("entry_count = 7\n")
+            f.write("auxiliary[0] = c_peratom [reduced unit]\n")
+            f.write("auxiliary[1] = fx [reduced unit]\n")
+            f.write("auxiliary[2] = fy [reduced unit]\n")
+            f.write("auxiliary[3] = fz [reduced unit]\n")
+            f.write("55.845\nFe\n")
+            for row in s:
+                aux = rng.randn(4)
+                vals = " ".join(f"{v:.6f}" for v in list(row) + list(aux))
+                f.write(vals + "\n")
+        with open(tmp_path / f"c{i}.bulk", "w") as f:
+            f.write(f"{rng.rand():.6f} 0 0\n")
+    cfg = _fmt_config("CFG", str(tmp_path))
+    cfg["Dataset"]["node_features"] = {
+        "name": ["Z", "mass", "c", "fx", "fy", "fz"],
+        "dim": [1, 1, 1, 1, 1, 1], "column_index": [0, 1, 2, 3, 4, 5]}
+    ds = CFGDataset(cfg, str(tmp_path))
+    assert len(ds) == 3
+    s = ds[0]
+    assert s.x.shape == (4, 1)
+    assert s.y_graph.shape == (1,)
+    np.testing.assert_allclose(s.cell, np.eye(3) * 4.0)
