@@ -1,0 +1,85 @@
+"""3D Ising model multitask example CLI (graph energy + nodal spin).
+
+reference: examples/ising_model/train_ising.py — generates spin
+configurations (create_configurations), writes LSMS-style text files,
+loads through the unit_test raw path, persists pickle/adios (optionally
+DDStore-wrapped), trains PNA multihead per ising_model.json.
+
+Usage:
+    python examples/ising_model/train_ising.py [--natom 3] [--cutoff 100]
+        [--preonly] [--ddstore] [--num_epoch N] [--cpu]
+"""
+import argparse
+import json
+import math
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--inputfile", default="ising_model.json")
+    p.add_argument("--natom", type=int, default=3,
+                   help="number of atoms per dimension")
+    p.add_argument("--cutoff", type=int, default=100,
+                   help="configurational histogram cutoff")
+    p.add_argument("--max_configs", type=int, default=2000)
+    p.add_argument("--seed", type=int, default=43)
+    p.add_argument("--preonly", action="store_true")
+    p.add_argument("--ddstore", action="store_true",
+                   help="serve samples through the DDStore shard store")
+    p.add_argument("--num_epoch", type=int, default=None)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+
+    if args.cpu:
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_force_host_platform_device_count=8").strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, args.inputfile)) as f:
+        config = json.load(f)
+    if args.num_epoch is not None:
+        config["NeuralNetwork"]["Training"]["num_epoch"] = args.num_epoch
+
+    from examples.ising_model.create_configurations import create_dataset
+    from hydragnn_tpu.datasets.lsmsdataset import LSMSDataset
+    from hydragnn_tpu.preprocess.load_data import split_dataset
+    from hydragnn_tpu.run_training import run_training
+
+    rawdir = os.path.join(here, config["Dataset"]["path"]["total"])
+    if not os.path.isdir(rawdir) or not os.listdir(rawdir):
+        n = create_dataset(args.natom, args.cutoff, rawdir,
+                           spin_function=lambda x: np.tanh(x),
+                           scale_spin=True, seed=args.seed,
+                           max_configs=args.max_configs)
+        print(f"generated {n} configurations in {rawdir}")
+    if args.preonly:
+        return
+
+    total = LSMSDataset(config, rawdir)
+    splits = split_dataset(
+        list(total), config["NeuralNetwork"]["Training"]["perc_train"],
+        config["Dataset"]["compositional_stratified_splitting"])
+    if args.ddstore:
+        from hydragnn_tpu.datasets.ddstore import DistDataset
+        wrapped = []
+        for s in splits:
+            s = list(s)
+            dd = DistDataset()
+            dd.populate(s, 0, len(s), [0, len(s)])
+            wrapped.append(dd)
+        splits = tuple(wrapped)
+    state, history, model, completed = run_training(config, datasets=splits)
+    print(json.dumps({"final_train_loss": history["train_loss"][-1],
+                      "final_val_loss": history["val_loss"][-1]}))
+
+
+if __name__ == "__main__":
+    main()

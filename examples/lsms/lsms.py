@@ -1,0 +1,99 @@
+"""LSMS FePt multitask example CLI (graph free energy + nodal charge
+density and magnetic moment).
+
+reference: examples/lsms/lsms.py — LSMSDataset raw load (rank-0),
+compositional stratified split, SerializedWriter/SerializedDataset (or
+adios) persistence, PNA multihead training per lsms.json. TPU path keeps
+the same preonly/loadexistingsplit/format stages; the FePt raw directory
+is generated synthetically when absent (see lsms_data.py).
+
+Usage:
+    python examples/lsms/lsms.py [--preonly] [--loadexistingsplit]
+        [--format serialized|graphstore] [--num_epoch N] [--cpu]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--inputfile", default="lsms.json")
+    p.add_argument("--loadexistingsplit", action="store_true")
+    p.add_argument("--preonly", action="store_true")
+    p.add_argument("--format", default="serialized",
+                   choices=["serialized", "graphstore"])
+    p.add_argument("--num_configs", type=int, default=200)
+    p.add_argument("--num_epoch", type=int, default=None)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+
+    if args.cpu:
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_force_host_platform_device_count=8").strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, args.inputfile)) as f:
+        config = json.load(f)
+    if args.num_epoch is not None:
+        config["NeuralNetwork"]["Training"]["num_epoch"] = args.num_epoch
+
+    from examples.lsms.lsms_data import generate_fept_dataset
+    from hydragnn_tpu.datasets.lsmsdataset import LSMSDataset
+    from hydragnn_tpu.datasets.serializeddataset import (SerializedDataset,
+                                                         SerializedWriter)
+    from hydragnn_tpu.preprocess.load_data import split_dataset
+    from hydragnn_tpu.run_training import run_training
+
+    datasetname = config["Dataset"]["name"]
+    rawdir = os.path.join(here, config["Dataset"]["path"]["total"])
+    basedir = os.path.join(here, "dataset", "serialized_dataset")
+
+    if not args.loadexistingsplit:
+        if not os.path.isdir(rawdir) or not os.listdir(rawdir):
+            generate_fept_dataset(rawdir, num_configs=args.num_configs)
+        total = LSMSDataset(config, rawdir)
+        trainset, valset, testset = split_dataset(
+            list(total), config["NeuralNetwork"]["Training"]["perc_train"],
+            config["Dataset"]["compositional_stratified_splitting"])
+        print(len(total), len(trainset), len(valset), len(testset))
+        if args.format == "serialized":
+            SerializedWriter(trainset, basedir, datasetname, "trainset",
+                             minmax_node_feature=total.minmax_node_feature,
+                             minmax_graph_feature=total.minmax_graph_feature)
+            SerializedWriter(valset, basedir, datasetname, "valset")
+            SerializedWriter(testset, basedir, datasetname, "testset")
+        else:
+            from hydragnn_tpu.datasets.gsdataset import GraphStoreWriter
+            for label, ds in (("trainset", trainset), ("valset", valset),
+                              ("testset", testset)):
+                w = GraphStoreWriter(os.path.join(
+                    here, "dataset", f"{datasetname}_{label}_gs"))
+                w.add_all(ds)
+                w.save()
+    if args.preonly:
+        sys.exit(0)
+
+    if args.format == "serialized":
+        splits = tuple(
+            list(SerializedDataset(basedir, datasetname, label))
+            for label in ("trainset", "valset", "testset"))
+    else:
+        from hydragnn_tpu.datasets.gsdataset import GraphStoreDataset
+        splits = tuple(
+            list(GraphStoreDataset(os.path.join(
+                here, "dataset", f"{datasetname}_{label}_gs")))
+            for label in ("trainset", "valset", "testset"))
+
+    state, history, model, completed = run_training(config, datasets=splits)
+    print(json.dumps({"final_train_loss": history["train_loss"][-1],
+                      "final_val_loss": history["val_loss"][-1]}))
+
+
+if __name__ == "__main__":
+    main()

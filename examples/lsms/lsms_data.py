@@ -1,0 +1,54 @@
+"""Synthetic FePt LSMS-format data generator (no-egress stand-in).
+
+reference: examples/lsms/lsms.py expects a downloaded `FePt_enthalpy`
+directory of LSMS text files (row layout per
+hydragnn/preprocess/lsms_raw_dataset_loader.py:20-106: line 0 = graph
+features, node rows = [Z, species, x, y, z, charge_density_raw,
+magnetic_moment]). Here: BCC FePt configurations with smooth closed-form
+free energy (mixing-enthalpy-shaped), charge transfer, and Fe magnetic
+moments written in the same text layout, so the real dataset drops in
+unchanged.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+Z_FE, Z_PT = 26.0, 78.0
+
+
+def generate_fept_dataset(dirpath: str, num_configs: int = 200,
+                          atoms_per_dim: int = 2, lattice: float = 2.85,
+                          jitter: float = 0.05, seed: int = 0) -> str:
+    """Write `num_configs` LSMS text files of BCC FePt (2 atoms/cell =>
+    2 * atoms_per_dim^3 atoms) under `dirpath`."""
+    os.makedirs(dirpath, exist_ok=True)
+    rng = np.random.RandomState(seed)
+    grid = np.stack(np.meshgrid(*[np.arange(atoms_per_dim)] * 3,
+                                indexing="ij"), axis=-1).reshape(-1, 3)
+    corners = grid * lattice
+    centers = corners + lattice / 2.0
+    base = np.concatenate([corners, centers]).astype(np.float64)
+    n = len(base)
+    for i in range(num_configs):
+        z = np.where(rng.rand(n) < rng.uniform(0.2, 0.8), Z_FE, Z_PT)
+        c_fe = float((z == Z_FE).mean())
+        pos = base + rng.randn(n, 3) * jitter
+        # mixing-enthalpy-shaped free energy per config (smooth in c_fe)
+        fe = -4.0 * c_fe * (1.0 - c_fe) + 0.05 * np.sin(6.0 * np.pi * c_fe)
+        fe = fe * n + rng.randn() * 0.01
+        # charge transfer Fe->Pt ~ local composition; moments on Fe only
+        charge = np.where(z == Z_FE, -0.3 * (1 - c_fe), 0.3 * c_fe)
+        charge += rng.randn(n) * 0.01
+        moment = np.where(z == Z_FE, 2.2 + 0.5 * (1 - c_fe), 0.3 * c_fe)
+        moment += rng.randn(n) * 0.01
+        lines = [f"{fe:.8f} 0.0"]
+        for a in range(n):
+            # raw charge density column carries +Z (the loader subtracts it)
+            lines.append(
+                f"{z[a]:.1f} 0 {pos[a,0]:.6f} {pos[a,1]:.6f} {pos[a,2]:.6f} "
+                f"{charge[a] + z[a]:.6f} {moment[a]:.6f}")
+        with open(os.path.join(dirpath, f"FePt_{i:05d}.txt"), "w") as f:
+            f.write("\n".join(lines))
+    return dirpath

@@ -1,0 +1,91 @@
+"""MD17 (uracil) data loading: real npz when present, synthetic fallback.
+
+reference: examples/md17/md17.py:19-73 — torch_geometric.datasets.MD17
+("uracil", raw file `md17_uracil.npz`), pre-transform sets x = atomic
+number, y = energy / num_atoms, edges from the config radius graph; a
+random ~25% subsample of trajectory frames.
+
+No-egress path: put `md17_uracil.npz` under ``dataset/md17/raw/``; else a
+deterministic harmonic-perturbation trajectory of a uracil-shaped molecule
+(12 atoms, C4N2O2H4) with closed-form energies/forces keeps the example
+runnable.
+"""
+from __future__ import annotations
+
+import os
+from typing import List
+
+import numpy as np
+
+from hydragnn_tpu.graphs.batch import GraphSample
+from hydragnn_tpu.graphs.radius import radius_graph
+
+# planar-ish uracil-like equilibrium geometry (Angstrom), atoms:
+# ring C,C,N,C,N,C + 2 O + 4 H
+_URACIL_Z = np.array([6, 6, 7, 6, 7, 6, 8, 8, 1, 1, 1, 1], np.float32)
+_THETA = np.linspace(0, 2 * np.pi, 7)[:6]
+_RING = np.stack([1.4 * np.cos(_THETA), 1.4 * np.sin(_THETA),
+                  np.zeros(6)], axis=1)
+_EQ_POS = np.concatenate([
+    _RING,
+    _RING[[0, 3]] * 1.85,                       # carbonyl O
+    _RING[[1, 2, 4, 5]] * 1.75,                 # H
+]).astype(np.float32)
+
+
+def _load_real_md17(root: str, molecule: str, perc: float, seed: int):
+    for fname in (f"md17_{molecule}.npz", f"{molecule}.npz"):
+        path = os.path.join(root, "raw", fname)
+        if os.path.exists(path):
+            data = np.load(path)
+            keys = set(data.files)
+            if {"z", "R", "E", "F"} <= keys:
+                z, R, E, F = data["z"], data["R"], data["E"], data["F"]
+            elif {"nuclear_charges", "coords", "energies", "forces"} <= keys:
+                z, R = data["nuclear_charges"], data["coords"]
+                E, F = data["energies"], data["forces"]
+            else:
+                continue
+            rng = np.random.RandomState(seed)
+            keep = rng.rand(len(R)) < perc
+            E = np.asarray(E).reshape(len(R), -1)[:, 0]
+            return (np.asarray(z, np.float32), np.asarray(R[keep], np.float32),
+                    np.asarray(E[keep], np.float32),
+                    np.asarray(F[keep], np.float32))
+    return None
+
+
+def _synthetic_md17(num_frames: int, seed: int):
+    """Harmonic well around the uracil-like equilibrium: E = 0.5 k |dx|^2,
+    F = -k dx (per-frame closed form)."""
+    rng = np.random.RandomState(seed)
+    k = 5.0
+    disp = rng.randn(num_frames, *_EQ_POS.shape).astype(np.float32) * 0.15
+    R = _EQ_POS[None] + disp
+    E = 0.5 * k * (disp ** 2).sum(axis=(1, 2)).astype(np.float32) - 260.0
+    F = (-k * disp).astype(np.float32)
+    return _URACIL_Z, R, E, F
+
+
+def load_md17(root: str = "dataset/md17", molecule: str = "uracil",
+              num_frames: int = 1000, perc: float = 0.25,
+              radius: float = 7.0, max_neighbours: int = 5,
+              with_forces: bool = False, seed: int = 0) -> List[GraphSample]:
+    """Frames as GraphSamples with the reference pre-transform applied
+    (x = Z, y = E / num_atoms; examples/md17/md17.py:19-28)."""
+    raw = _load_real_md17(root, molecule, perc, seed)
+    if raw is None:
+        raw = _synthetic_md17(num_frames, seed)
+    z, R, E, F = raw
+    samples = []
+    for i in range(len(R)):
+        pos = R[i]
+        send, recv = radius_graph(pos, radius, max_neighbours=max_neighbours)
+        samples.append(GraphSample(
+            x=z[:, None], pos=pos, senders=send, receivers=recv,
+            y_graph=np.asarray([E[i] / len(z)], np.float32),
+            energy=np.asarray([E[i]], np.float32) if with_forces else None,
+            forces=F[i] if with_forces else None))
+        if len(samples) >= num_frames:
+            break
+    return samples

@@ -1,0 +1,130 @@
+"""QM9 data loading: real GDB-9 SDF files when present, synthetic fallback.
+
+reference: examples/qm9/qm9.py:19-62 — uses torch_geometric.datasets.QM9
+(raw files `gdb9.sdf` + `gdb9.sdf.csv`), pre-transform sets x = atomic
+number and y = free energy (property column 10) / num_atoms.
+
+Here the SDF/CSV pair is parsed directly (no egress: place the raw files
+under ``dataset/qm9/raw/`` to use the real data); otherwise a deterministic
+synthetic molecule generator with the same schema (organic CHNOF molecules,
+smooth composition+geometry free-energy label) keeps the example runnable
+end-to-end.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from hydragnn_tpu.graphs.batch import GraphSample
+from hydragnn_tpu.graphs.radius import radius_graph
+
+# PyG QM9 property column order; 10 = G (free energy at 298.15K)
+FREE_ENERGY_COL = 10
+
+
+def _parse_sdf_molecules(sdf_path: str, limit: Optional[int] = None):
+    """Minimal V2000 molfile parser: yields (block_index, atomic_numbers,
+    positions). The block index keeps labels aligned with the property CSV
+    even when a malformed block is skipped."""
+    from hydragnn_tpu.utils.elements import SYMBOL_TO_Z
+    mols = []
+    with open(sdf_path, encoding="utf-8", errors="replace") as f:
+        lines = f.read().split("$$$$\n")
+    for iblock, block in enumerate(lines):
+        rows = block.splitlines()
+        if len(rows) < 4:
+            continue
+        counts = rows[3]
+        try:
+            natoms = int(counts[0:3])
+        except ValueError:
+            continue
+        zs, pos = [], []
+        ok = True
+        for row in rows[4:4 + natoms]:
+            try:
+                x, y, z = float(row[0:10]), float(row[10:20]), float(row[20:30])
+                sym = row[31:34].strip()
+                zs.append(SYMBOL_TO_Z[sym])
+                pos.append([x, y, z])
+            except (ValueError, KeyError, IndexError):
+                ok = False
+                break
+        if ok and zs:
+            mols.append((iblock, np.asarray(zs, np.float32),
+                         np.asarray(pos, np.float32)))
+        if limit is not None and len(mols) >= limit:
+            break
+    return mols
+
+
+def _load_real_qm9(root: str, num_samples: int):
+    sdf = os.path.join(root, "raw", "gdb9.sdf")
+    csv = os.path.join(root, "raw", "gdb9.sdf.csv")
+    if not (os.path.exists(sdf) and os.path.exists(csv)):
+        return None
+    import pandas as pd
+    props = pd.read_csv(csv)
+    # csv columns: mol_id, A, B, C, mu, alpha, homo, lumo, gap, r2, zpve,
+    # u0, u298, h298, g298, cv -> g298 is the free energy
+    targets = props["g298"].to_numpy(np.float32)
+    mols = _parse_sdf_molecules(sdf, limit=num_samples)
+    out = []
+    for iblock, zs, pos in mols:
+        if iblock < len(targets):
+            out.append((zs, pos, float(targets[iblock])))
+    return out
+
+
+def _synthetic_qm9(num_samples: int, seed: int = 0):
+    """Deterministic CHNOF molecules: heavy-atom random tree with ~1.4 A
+    bonds, hydrogens attached; free energy = smooth function of composition
+    and geometry (trainable closed-form stand-in for g298)."""
+    rng = np.random.RandomState(seed)
+    elements = np.array([6, 7, 8, 9], np.float32)          # C N O F
+    elem_term = {1.0: -0.5, 6.0: -38.0, 7.0: -54.6, 8.0: -75.2, 9.0: -99.8}
+    out = []
+    for _ in range(num_samples):
+        n_heavy = rng.randint(4, 10)
+        zs = [float(rng.choice(elements)) for _ in range(n_heavy)]
+        pos = [np.zeros(3)]
+        for i in range(1, n_heavy):
+            parent = rng.randint(0, i)
+            direction = rng.randn(3)
+            direction /= np.linalg.norm(direction) + 1e-9
+            pos.append(pos[parent] + direction * (1.4 + 0.1 * rng.randn()))
+        # hydrogens on a few heavy atoms
+        n_h = rng.randint(2, 8)
+        for _ in range(n_h):
+            parent = rng.randint(0, n_heavy)
+            direction = rng.randn(3)
+            direction /= np.linalg.norm(direction) + 1e-9
+            zs.append(1.0)
+            pos.append(pos[parent] + direction * 1.0)
+        zs = np.asarray(zs, np.float32)
+        pos = np.asarray(pos, np.float32)
+        g = sum(elem_term[z] for z in zs)
+        d = np.linalg.norm(pos[:, None] - pos[None, :], axis=-1)
+        g += 0.1 * float(np.exp(-d[d > 0]).sum())
+        out.append((zs, pos, np.float32(g)))
+    return out
+
+
+def load_qm9(root: str = "dataset/qm9", num_samples: int = 1000,
+             radius: float = 7.0, max_neighbours: int = 5,
+             seed: int = 0) -> List[GraphSample]:
+    """Real-or-synthetic QM9 as GraphSamples with the reference's
+    pre-transform applied (x = Z, y = g298 / num_atoms;
+    examples/qm9/qm9.py:19-27)."""
+    raw = _load_real_qm9(root, num_samples)
+    if raw is None:
+        raw = _synthetic_qm9(num_samples, seed=seed)
+    samples = []
+    for zs, pos, g in raw:
+        send, recv = radius_graph(pos, radius, max_neighbours=max_neighbours)
+        samples.append(GraphSample(
+            x=zs[:, None], pos=pos, senders=send, receivers=recv,
+            y_graph=np.asarray([g / len(zs)], np.float32)))
+    return samples

@@ -4,3 +4,4 @@ from .lsmsdataset import LSMSDataset, load_lsms_splits
 from .xyzdataset import XYZDataset, load_xyz_splits
 from .cfgdataset import CFGDataset, load_cfg_splits
 from .ddstore import DDStore, DistDataset
+from .serializeddataset import SerializedDataset, SerializedWriter

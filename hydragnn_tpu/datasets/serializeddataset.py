@@ -1,0 +1,57 @@
+"""Monolithic per-split pickle dataset.
+
+reference: hydragnn/utils/datasets/serializeddataset.py:10-87 —
+`SerializedDataset` loads one `<basedir>/<name>/<label>.pkl` file holding the
+whole split plus minmax metadata; `SerializedWriter` writes it (rank-0 in the
+reference; single-process here, the SPMD loader shards by index instead).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Sequence
+
+from ..graphs.batch import GraphSample
+from .pickledataset import _from_dict, _to_dict
+
+
+class SerializedWriter:
+    """Write an entire split as one pickle file
+    (reference: serializeddataset.py:49-87)."""
+
+    def __init__(self, dataset: Sequence[GraphSample], basedir: str,
+                 name: str = "total", label: str = "trainset",
+                 minmax_node_feature=None, minmax_graph_feature=None):
+        dirpath = os.path.join(basedir, name)
+        os.makedirs(dirpath, exist_ok=True)
+        payload = {
+            "minmax_node_feature": minmax_node_feature,
+            "minmax_graph_feature": minmax_graph_feature,
+            "samples": [_to_dict(s) for s in dataset],
+        }
+        with open(os.path.join(dirpath, f"{label}.pkl"), "wb") as f:
+            pickle.dump(payload, f)
+
+
+class SerializedDataset:
+    """Load a split written by SerializedWriter
+    (reference: serializeddataset.py:10-46)."""
+
+    def __init__(self, basedir: str, name: str = "total",
+                 label: str = "trainset"):
+        path = os.path.join(basedir, name, f"{label}.pkl")
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        self.minmax_node_feature = payload["minmax_node_feature"]
+        self.minmax_graph_feature = payload["minmax_graph_feature"]
+        self.samples: List[GraphSample] = [
+            _from_dict(d) for d in payload["samples"]]
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+    def __iter__(self):
+        return iter(self.samples)

@@ -43,7 +43,8 @@ def _use_pallas() -> bool:
 def segment_sum(data, segment_ids, num_segments, mask=None):
     if mask is not None:
         data = jnp.where(_bcast(mask, data), data, 0.0)
-    if data.ndim == 2 and _use_pallas():
+    if (data.ndim == 2 and jnp.issubdtype(data.dtype, jnp.floating)
+            and _use_pallas()):
         from ..kernels.segment_pallas import segment_sum_pallas
         return segment_sum_pallas(data, segment_ids, num_segments,
                                   _PALLAS_STATE["interpret"])

@@ -1,6 +1,7 @@
 """Smoke-run examples as subprocesses (reference: tests/test_examples.py:18-26
 runs qm9/md17/LennardJones CLIs the same way)."""
 import os
+import shutil
 import subprocess
 import sys
 
@@ -10,6 +11,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run(args, timeout=560):
+    # hermetic: examples skip data generation when their dataset/ dir is
+    # non-empty, so wipe any leftover state from prior (possibly
+    # differently-sized) runs first
+    example_dir = os.path.join(REPO, os.path.dirname(args[0]))
+    shutil.rmtree(os.path.join(example_dir, "dataset"), ignore_errors=True)
     env = dict(os.environ)
     return subprocess.run([sys.executable] + args, cwd=REPO, timeout=timeout,
                           capture_output=True, text=True, env=env)
@@ -30,3 +36,31 @@ def test_lennard_jones_preonly_graphstore(tmp_path):
               "--num_configs", "10", "--format", "graphstore", "--cpu"])
     assert r.returncode == 0, r.stderr[-2000:]
     assert "wrote 10 samples" in r.stdout
+
+
+def test_qm9_example():
+    r = _run(["examples/qm9/qm9.py", "--num_samples", "80",
+              "--num_epoch", "2", "--batch_size", "16", "--cpu"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final_train_loss" in r.stdout
+
+
+def test_md17_example():
+    r = _run(["examples/md17/md17.py", "--num_frames", "80",
+              "--num_epoch", "2", "--batch_size", "16", "--cpu"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final_train_loss" in r.stdout
+
+
+def test_lsms_example():
+    r = _run(["examples/lsms/lsms.py", "--num_configs", "60",
+              "--num_epoch", "2", "--cpu"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final_train_loss" in r.stdout
+
+
+def test_ising_example():
+    r = _run(["examples/ising_model/train_ising.py", "--max_configs", "100",
+              "--num_epoch", "2", "--cpu"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final_train_loss" in r.stdout
