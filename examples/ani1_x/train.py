@@ -1,0 +1,71 @@
+"""ANI-1x example CLI (per-atom energy or nodal forces).
+
+reference: examples/ani1_x/train.py — frames from ani1x-release.h5 (DFT
+wB97x/6-31G(d) energies + forces), EGNN per ani1x_energy.json or
+ani1x_forces.json. The h5 file is generated synthetically when absent
+(see ani1x_data.py).
+
+Usage:
+    python examples/ani1_x/train.py [--inputfile ani1x_energy.json]
+        [--limit 500] [--num_epoch N] [--cpu]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--inputfile", default="ani1x_energy.json",
+                   choices=["ani1x_energy.json", "ani1x_forces.json"])
+    p.add_argument("--limit", type=int, default=500)
+    p.add_argument("--preonly", action="store_true")
+    p.add_argument("--num_epoch", type=int, default=None)
+    p.add_argument("--batch_size", type=int, default=None)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+
+    if args.cpu:
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_force_host_platform_device_count=8").strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, args.inputfile)) as f:
+        config = json.load(f)
+    train_cfg = config["NeuralNetwork"]["Training"]
+    arch = config["NeuralNetwork"]["Architecture"]
+    if args.num_epoch is not None:
+        train_cfg["num_epoch"] = args.num_epoch
+    if args.batch_size is not None:
+        train_cfg["batch_size"] = args.batch_size
+
+    from examples.ani1_x.ani1x_data import (generate_ani1x_dataset,
+                                            load_ani1x)
+    from hydragnn_tpu.preprocess.load_data import split_dataset
+    from hydragnn_tpu.run_training import run_training
+
+    datadir = os.path.join(here, "dataset")
+    if not (os.path.exists(os.path.join(datadir, "ani1x-release.h5")) or
+            os.path.exists(os.path.join(datadir, "synthetic",
+                                        "ani1x-release.h5"))):
+        generate_ani1x_dataset(datadir)
+    if args.preonly:
+        print(f"dataset ready at {datadir}")
+        return
+
+    samples = load_ani1x(datadir, radius=arch["radius"],
+                         max_neighbours=min(arch["max_neighbours"], 512),
+                         limit=args.limit)
+    splits = split_dataset(samples, train_cfg["perc_train"], False)
+    state, history, model, completed = run_training(config, datasets=splits)
+    print(json.dumps({"final_train_loss": history["train_loss"][-1],
+                      "final_val_loss": history["val_loss"][-1]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""NiNb EAM example CLI (atomic energy / forces / bulk modulus tasks).
+
+reference: examples/eam/eam.py — CFGDataset raw load of the OLCF NiNb
+solid-solution download, compositional stratified split,
+SerializedWriter/SerializedDataset (or adios) persistence, PNA training
+per one of four NiNb_EAM_*.json task configs. TPU path keeps the same
+preonly/loadexistingsplit/format stages; the CFG directory is generated
+synthetically with an EAM functional form when absent (see eam_data.py).
+
+Usage:
+    python examples/eam/eam.py [--inputfile NiNb_EAM_energy.json]
+        [--preonly] [--loadexistingsplit] [--num_epoch N] [--cpu]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--inputfile", default="NiNb_EAM_energy.json",
+                   choices=["NiNb_EAM_energy.json", "NiNb_EAM_bulk.json",
+                            "NiNb_EAM_multitask.json",
+                            "NiNb_EAM_bulk_multitask.json"])
+    p.add_argument("--loadexistingsplit", action="store_true")
+    p.add_argument("--preonly", action="store_true")
+    p.add_argument("--num_configs", type=int, default=100)
+    p.add_argument("--num_epoch", type=int, default=None)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+
+    if args.cpu:
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_force_host_platform_device_count=8").strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, args.inputfile)) as f:
+        config = json.load(f)
+    if args.num_epoch is not None:
+        config["NeuralNetwork"]["Training"]["num_epoch"] = args.num_epoch
+
+    from examples.eam.eam_data import generate_ninb_dataset
+    from hydragnn_tpu.datasets.cfgdataset import CFGDataset
+    from hydragnn_tpu.datasets.serializeddataset import (SerializedDataset,
+                                                         SerializedWriter)
+    from hydragnn_tpu.preprocess.load_data import split_dataset
+    from hydragnn_tpu.run_training import run_training
+
+    ds_cfg = config["Dataset"]
+    datasetname = ds_cfg["name"]
+    taskname = os.path.splitext(args.inputfile)[0]
+    rawdir = os.path.join(here, ds_cfg["path"]["total"])
+    basedir = os.path.join(here, "dataset", "serialized_dataset")
+
+    if not args.loadexistingsplit:
+        if not os.path.isdir(rawdir) or not os.listdir(rawdir):
+            with_forces = "atomic_force" in ds_cfg["node_features"]["name"]
+            with_bulk = bool(ds_cfg["graph_features"]["name"])
+            generate_ninb_dataset(rawdir, num_configs=args.num_configs,
+                                  with_forces=with_forces,
+                                  with_bulk=with_bulk)
+        total = CFGDataset(config, rawdir)
+        trainset, valset, testset = split_dataset(
+            list(total), config["NeuralNetwork"]["Training"]["perc_train"],
+            ds_cfg["compositional_stratified_splitting"])
+        print(len(total), len(trainset), len(valset), len(testset))
+        SerializedWriter(trainset, basedir, taskname, "trainset",
+                         minmax_node_feature=total.minmax_node_feature,
+                         minmax_graph_feature=total.minmax_graph_feature)
+        SerializedWriter(valset, basedir, taskname, "valset")
+        SerializedWriter(testset, basedir, taskname, "testset")
+    if args.preonly:
+        sys.exit(0)
+
+    splits = tuple(list(SerializedDataset(basedir, taskname, label))
+                   for label in ("trainset", "valset", "testset"))
+    state, history, model, completed = run_training(config, datasets=splits)
+    print(json.dumps({"final_train_loss": history["train_loss"][-1],
+                      "final_val_loss": history["val_loss"][-1]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+"""Synthetic NiNb EAM CFG-format data generator (no-egress stand-in).
+
+reference: examples/eam/eam.py expects the OLCF `10.13139_OLCF_1890159`
+NiNb solid-solution download: AtomEye CFG files whose auxiliary columns
+carry per-atom energy (+forces in the FCC variants) and `.bulk` sidecars
+with the bulk modulus. Here: FCC Ni(1-c)Nb(c) configurations with a real
+EAM functional form — embedding F(rho) = -A*sqrt(rho), density
+rho_i = sum_j exp(-r_ij/r0), pair phi(r) = B*exp(-2 r/r0) — so energies
+and analytic forces are physically shaped; bulk modulus is a smooth
+function of Nb concentration. Written in the same CFG layout so the real
+download drops in unchanged.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from hydragnn_tpu.graphs.radius import radius_graph_pbc
+
+Z_NI, Z_NB = 28.0, 41.0
+MASS = {Z_NI: 58.69, Z_NB: 92.91}
+A_EMB = {Z_NI: 1.8, Z_NB: 2.4}       # embedding strength per species
+B_PAIR = 0.8
+R0 = 2.6
+
+
+def eam_energy_forces(pos: np.ndarray, cell: np.ndarray, z: np.ndarray,
+                      cutoff: float = 5.0):
+    """Per-atom EAM energies and analytic forces under PBC."""
+    send, recv, shifts = radius_graph_pbc(pos, cell, cutoff)
+    disp = pos[send] + shifts - pos[recv]
+    r = np.maximum(np.linalg.norm(disp, axis=1), 1e-9)
+    w = np.exp(-r / R0)
+    n = len(pos)
+    rho = np.zeros(n)
+    np.add.at(rho, recv, w)
+    rho = np.maximum(rho, 1e-12)
+    a = np.vectorize(A_EMB.get)(z)
+    e_emb = -a * np.sqrt(rho)
+    pair = B_PAIR * np.exp(-2.0 * r / R0)
+    e_pair = np.zeros(n)
+    np.add.at(e_pair, recv, 0.5 * pair)
+    e_atom = e_emb + e_pair
+
+    # dE/dr_ij: embedding term from both ends (F'(rho)=-a/(2 sqrt(rho)),
+    # w'(r)=-w/R0 -> +a w / (2 sqrt(rho) R0)) plus pair phi'(r)=-2 phi/R0.
+    # Force on atom i (=recv): -dE/dx_i = +dE/dr * (x_j - x_i)/r = dEdr*unit.
+    demb = (a[recv] / (2.0 * np.sqrt(rho[recv])) +
+            a[send] / (2.0 * np.sqrt(rho[send]))) * (w / R0)
+    dEdr = demb - 2.0 * pair / R0
+    f_edge = dEdr[:, None] * disp / r[:, None]   # disp = x_send - x_recv
+    forces = np.zeros_like(pos)
+    np.add.at(forces, recv, f_edge)
+    return e_atom, forces
+
+
+def bulk_modulus(c_nb: float) -> float:
+    """Smooth GPa-scale stand-in: Ni 180 GPa -> Nb 170 GPa with a
+    solid-solution hardening bump."""
+    return 180.0 - 10.0 * c_nb + 25.0 * c_nb * (1.0 - c_nb)
+
+
+def _write_cfg(path: str, pos_frac: np.ndarray, cell: np.ndarray,
+               z: np.ndarray, e_atom: np.ndarray, forces: np.ndarray,
+               with_forces: bool):
+    from hydragnn_tpu.utils.elements import SYMBOLS
+    naux = 4 if with_forces else 1
+    lines = [f"Number of particles = {len(z)}",
+             "A = 1.0 Angstrom (basic length-scale)"]
+    for i in range(3):
+        for j in range(3):
+            lines.append(f"H0({i+1},{j+1}) = {cell[i,j]:.6f} A")
+    lines.append(".NO_VELOCITY.")
+    lines.append(f"entry_count = {3 + naux}")
+    lines.append("auxiliary[0] = c_peratom [eV]")
+    if with_forces:
+        for k, name in enumerate(("fx", "fy", "fz")):
+            lines.append(f"auxiliary[{k+1}] = {name} [eV/A]")
+    for i in range(len(z)):
+        lines.append(f"{MASS[float(z[i])]:.4f}")
+        lines.append(SYMBOLS[int(z[i])])
+        row = list(pos_frac[i]) + [e_atom[i]]
+        if with_forces:
+            row += list(forces[i])
+        lines.append(" ".join(f"{v:.8f}" for v in row))
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+
+
+def generate_ninb_dataset(dirpath: str, num_configs: int = 100,
+                          cells_per_dim: int = 2, lattice: float = 3.52,
+                          jitter: float = 0.06, with_forces: bool = False,
+                          with_bulk: bool = False, seed: int = 0) -> str:
+    """FCC supercells (4 atoms/cell) with random Nb substitution."""
+    os.makedirs(dirpath, exist_ok=True)
+    open(os.path.join(dirpath, ".synthetic"), "w").write("generated stand-in data; safe to delete\n")
+    rng = np.random.RandomState(seed)
+    basis = np.array([[0, 0, 0], [0, .5, .5], [.5, 0, .5], [.5, .5, 0]])
+    grid = np.stack(np.meshgrid(*[np.arange(cells_per_dim)] * 3,
+                                indexing="ij"), axis=-1).reshape(-1, 3)
+    frac = ((grid[:, None, :] + basis[None]) / cells_per_dim).reshape(-1, 3)
+    box = cells_per_dim * lattice
+    cell = np.eye(3) * box
+    n = len(frac)
+    for i in range(num_configs):
+        c_nb = rng.uniform(0.05, 0.5)
+        z = np.where(rng.rand(n) < c_nb, Z_NB, Z_NI)
+        pos = (frac * box + rng.randn(n, 3) * jitter) % box
+        e_atom, forces = eam_energy_forces(pos, cell, z)
+        stem = os.path.join(dirpath, f"NiNb_{i:05d}")
+        _write_cfg(stem + ".cfg", pos / box, cell, z, e_atom, forces,
+                   with_forces)
+        if with_bulk:
+            b = bulk_modulus(float((z == Z_NB).mean()))
+            with open(stem + ".bulk", "w") as f:
+                f.write(f"0.0 0.0 {b:.6f}\n")
+    return dirpath

@@ -12,6 +12,14 @@ Here the energy is vectorized with np.roll instead of the reference's
 triple python loop (same value), and enumeration below the cutoff uses
 itertools combinations of down-spin sites (equivalent to multiset
 permutations of the spin vector).
+
+NOTE (intentional reference parity): the row layout stores x,y,z in
+columns 1-3, but the LSMS text parser (ours and the reference's,
+lsms_raw_dataset_loader.py:71-73) reads positions from columns 2-4, so
+the "positions" seen by the model are (y, z, spin). The reference has
+the same quirk; it is harmless because radius=7 makes the 3x3x3 lattice
+graph fully connected either way, and we keep the files byte-compatible
+with the reference generator rather than silently changing geometry.
 """
 from __future__ import annotations
 
@@ -70,6 +78,7 @@ def create_dataset(L: int, histogram_cutoff: int, dirpath: str,
     """Generate the full sweep over down-spin counts
     (reference create_configurations.py:77-115)."""
     os.makedirs(dirpath, exist_ok=True)
+    open(os.path.join(dirpath, ".synthetic"), "w").write("generated stand-in data; safe to delete\n")
     rng = np.random.RandomState(seed)
     n = L ** 3
     count = 0

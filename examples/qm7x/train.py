@@ -1,0 +1,77 @@
+"""QM7-X multitask example CLI (HOMO-LUMO gap + nodal forces/charges/
+dipoles/Hirshfeld ratios).
+
+reference: examples/qm7x/train.py — HDF5 set files of molecular
+conformations, EGNN with graph+node heads per qm7x.json; force-norm
+sanity filter; per-atom energy normalization. The HDF5 directory is
+generated synthetically when absent (see qm7x_data.py).
+
+Usage:
+    python examples/qm7x/train.py [--num_mols 20] [--num_epoch N]
+        [--hidden_dim H] [--cpu]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--inputfile", default="qm7x.json")
+    p.add_argument("--num_mols", type=int, default=20)
+    p.add_argument("--limit", type=int, default=1000)
+    p.add_argument("--preonly", action="store_true")
+    p.add_argument("--num_epoch", type=int, default=None)
+    p.add_argument("--batch_size", type=int, default=None)
+    p.add_argument("--hidden_dim", type=int, default=None)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+
+    if args.cpu:
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_force_host_platform_device_count=8").strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, args.inputfile)) as f:
+        config = json.load(f)
+    train_cfg = config["NeuralNetwork"]["Training"]
+    arch = config["NeuralNetwork"]["Architecture"]
+    if args.num_epoch is not None:
+        train_cfg["num_epoch"] = args.num_epoch
+    if args.batch_size is not None:
+        train_cfg["batch_size"] = args.batch_size
+    if args.hidden_dim is not None:
+        arch["hidden_dim"] = args.hidden_dim
+        for head in arch["output_heads"].values():
+            if "dim_sharedlayers" in head:
+                head["dim_sharedlayers"] = args.hidden_dim
+            head["dim_headlayers"] = [args.hidden_dim] * len(
+                head["dim_headlayers"])
+
+    from examples.qm7x.qm7x_data import generate_qm7x_dataset, load_qm7x
+    from hydragnn_tpu.preprocess.load_data import split_dataset
+    from hydragnn_tpu.run_training import run_training
+
+    datadir = os.path.join(here, "dataset", "qm7x")
+    if not os.path.isdir(datadir) or not os.listdir(datadir):
+        generate_qm7x_dataset(datadir, num_mols=args.num_mols)
+    if args.preonly:
+        print(f"dataset ready at {datadir}")
+        return
+
+    samples = load_qm7x(datadir, radius=arch["radius"],
+                        max_neighbours=arch["max_neighbours"],
+                        limit=args.limit)
+    splits = split_dataset(samples, train_cfg["perc_train"], False)
+    state, history, model, completed = run_training(config, datasets=splits)
+    print(json.dumps({"final_train_loss": history["train_loss"][-1],
+                      "final_val_loss": history["val_loss"][-1]}))
+
+
+if __name__ == "__main__":
+    main()

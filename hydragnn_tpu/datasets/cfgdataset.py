@@ -21,6 +21,7 @@ from ..graphs.batch import GraphSample
 from ..preprocess.load_data import split_dataset
 from ..preprocess.transforms import build_graph_sample, normalize_edge_lengths
 from ..utils.elements import symbol_to_z
+from .lsmsdataset import _minmax_normalize
 from .xyzdataset import _read_sidecar_graph_feats
 
 
@@ -95,12 +96,33 @@ class CFGDataset:
         files = sorted(glob.glob(os.path.join(dirpath, "*.cfg")))
         if not files:
             raise FileNotFoundError(f"no .cfg files in {dirpath}")
-        self.samples = []
+        feats_all, pos_all, cell_all, gfeat_all = [], [], [], []
         for fp in files:
             feats, pos, cell = parse_cfg_file(fp)
             gfeat = _read_sidecar_graph_feats(
                 os.path.splitext(fp)[0] + ".bulk",
                 gf["dim"], gf["column_index"])
+            feats_all.append(feats)
+            pos_all.append(pos)
+            cell_all.append(cell)
+            gfeat_all.append(gfeat)
+        # dataset-wide min-max feature normalization (reference:
+        # AbstractRawDataset normalize, utils/datasets/abstractrawdataset.py:29)
+        feats_all, self.minmax_node_feature = _minmax_normalize(feats_all)
+        n_present = sum(g is not None for g in gfeat_all)
+        if gf["dim"] and n_present == len(gfeat_all):
+            gfeat_all, self.minmax_graph_feature = _minmax_normalize(
+                [g[None] for g in gfeat_all])
+            gfeat_all = [g[0] for g in gfeat_all]
+        elif gf["dim"] and 0 < n_present < len(gfeat_all):
+            raise ValueError(
+                f"{dirpath}: {n_present}/{len(gfeat_all)} .cfg files have "
+                ".bulk sidecars; all or none must be present")
+        else:
+            self.minmax_graph_feature = None
+        self.samples = []
+        for feats, pos, cell, gfeat in zip(feats_all, pos_all, cell_all,
+                                           gfeat_all):
             self.samples.append(build_graph_sample(
                 feats, pos, config, graph_feats=gfeat, cell=cell))
         normalize_edge_lengths(self.samples)
