@@ -1,0 +1,79 @@
+"""Alexandria database loading: real ComputedStructureEntry JSON dumps
+when present, synthetic fallback.
+
+reference: examples/alexandria/train.py:65-200 — directory of alexandria
+JSON files, each {"entries": [ComputedStructureEntry]}; per entry:
+data.mat_id, data.energy_total, structure.lattice.matrix,
+structure.sites[].xyz / species[0].element / properties.forces.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import List
+
+import numpy as np
+
+from examples.common_atomistic import (frame_to_sample, mark_synthetic,
+                                       random_crystal)
+from hydragnn_tpu.utils.elements import SYMBOLS, symbol_to_z
+
+
+def _entry_to_arrays(entry: dict):
+    structure = entry["structure"]
+    cell = np.asarray(structure["lattice"]["matrix"], np.float32)
+    zs, pos, forces = [], [], []
+    for site in structure["sites"]:
+        zs.append(symbol_to_z(site["species"][0]["element"]))
+        pos.append(site["xyz"])
+        forces.append(site["properties"]["forces"])
+    return (np.asarray(zs, np.float32), np.asarray(pos, np.float32), cell,
+            np.asarray(forces, np.float32))
+
+
+def load_alexandria(dirpath: str, radius: float = 5.0,
+                    max_neighbours: int = 100, limit: int = 1000,
+                    energy_per_atom: bool = True):
+    files = sorted(glob.glob(os.path.join(dirpath, "*.json")))
+    if not files:
+        files = sorted(glob.glob(os.path.join(dirpath, "synthetic",
+                                              "*.json")))
+    samples: List = []
+    for path in files:
+        with open(path, encoding="utf-8") as f:
+            entries = json.load(f)["entries"]
+        for entry in entries:
+            z, pos, cell, forces = _entry_to_arrays(entry)
+            s = frame_to_sample(z, pos, entry["data"]["energy_total"],
+                                forces, radius, max_neighbours, cell=cell,
+                                energy_per_atom=energy_per_atom)
+            if s is not None:
+                samples.append(s)
+            if len(samples) >= limit:
+                return samples
+    return samples
+
+
+def generate_alexandria_dataset(dirpath: str, num_entries: int = 120,
+                                seed: int = 0) -> str:
+    dirpath = os.path.join(dirpath, "synthetic")
+    mark_synthetic(dirpath)
+    rng = np.random.RandomState(seed)
+    entries = []
+    for m in range(num_entries):
+        z, pos, cell, energy, forces = random_crystal(rng)
+        sites = [{"species": [{"element": SYMBOLS[int(zi)], "occu": 1}],
+                  "xyz": pos[i].tolist(),
+                  "abc": (pos[i] @ np.linalg.inv(cell)).tolist(),
+                  "properties": {"forces": forces[i].tolist(),
+                                 "magmom": 0.0}}
+                 for i, zi in enumerate(z)]
+        entries.append({
+            "data": {"mat_id": f"agm{m:06d}", "energy_total": energy},
+            "structure": {"lattice": {"matrix": cell.tolist()},
+                          "sites": sites},
+        })
+    with open(os.path.join(dirpath, "alexandria_000.json"), "w") as f:
+        json.dump({"entries": entries}, f)
+    return dirpath

@@ -1,14 +1,13 @@
-"""QM7-X multitask example CLI (HOMO-LUMO gap + nodal forces/charges/
-dipoles/Hirshfeld ratios).
+"""Alexandria example CLI (per-atom energy or nodal forces over the
+Alexandria DFT database).
 
-reference: examples/qm7x/train.py — HDF5 set files of molecular
-conformations, EGNN with graph+node heads per qm7x.json; force-norm
-sanity filter; per-atom energy normalization. The HDF5 directory is
-generated synthetically when absent (see qm7x_data.py).
+reference: examples/alexandria/train.py — ComputedStructureEntry JSON
+dumps, EGNN per alexandria_energy.json / alexandria_forces.json. The
+JSON dump is generated synthetically when absent (alexandria_data.py).
 
 Usage:
-    python examples/qm7x/train.py [--num_mols 20] [--num_epoch N]
-        [--hidden_dim H] [--cpu]
+    python examples/alexandria/train.py [--inputfile alexandria_energy.json]
+        [--limit 500] [--num_epoch N] [--cpu]
 """
 import argparse
 import json
@@ -20,13 +19,13 @@ sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--inputfile", default="qm7x.json")
-    p.add_argument("--num_mols", type=int, default=20)
-    p.add_argument("--limit", type=int, default=1000)
+    p.add_argument("--inputfile", default="alexandria_energy.json",
+                   choices=["alexandria_energy.json",
+                            "alexandria_forces.json"])
+    p.add_argument("--limit", type=int, default=500)
     p.add_argument("--preonly", action="store_true")
     p.add_argument("--num_epoch", type=int, default=None)
     p.add_argument("--batch_size", type=int, default=None)
-    p.add_argument("--hidden_dim", type=int, default=None)
     p.add_argument("--cpu", action="store_true")
     args = p.parse_args()
 
@@ -45,30 +44,24 @@ def main():
         train_cfg["num_epoch"] = args.num_epoch
     if args.batch_size is not None:
         train_cfg["batch_size"] = args.batch_size
-    if args.hidden_dim is not None:
-        arch["hidden_dim"] = args.hidden_dim
-        for head in arch["output_heads"].values():
-            if "dim_sharedlayers" in head:
-                head["dim_sharedlayers"] = args.hidden_dim
-            head["dim_headlayers"] = [args.hidden_dim] * len(
-                head["dim_headlayers"])
 
-    from examples.qm7x.qm7x_data import generate_qm7x_dataset, load_qm7x
+    from examples.alexandria.alexandria_data import (
+        generate_alexandria_dataset, load_alexandria)
     from hydragnn_tpu.preprocess.load_data import split_dataset
     from hydragnn_tpu.run_training import run_training
 
+    datadir = os.path.join(here, "dataset")
     import glob
-    datadir = os.path.join(here, "dataset", "qm7x")
-    if not (glob.glob(os.path.join(datadir, "*.hdf5")) or
-            glob.glob(os.path.join(datadir, "synthetic", "*.hdf5"))):
-        generate_qm7x_dataset(datadir, num_mols=args.num_mols)
+    if not (glob.glob(os.path.join(datadir, "*.json")) or
+            glob.glob(os.path.join(datadir, "synthetic", "*.json"))):
+        generate_alexandria_dataset(datadir)
     if args.preonly:
         print(f"dataset ready at {datadir}")
         return
 
-    samples = load_qm7x(datadir, radius=arch["radius"],
-                        max_neighbours=arch["max_neighbours"],
-                        limit=args.limit)
+    samples = load_alexandria(datadir, radius=arch["radius"],
+                              max_neighbours=min(arch["max_neighbours"], 512),
+                              limit=args.limit)
     splits = split_dataset(samples, train_cfg["perc_train"], False)
     state, history, model, completed = run_training(config, datasets=splits)
     print(json.dumps({"final_train_loss": history["train_loss"][-1],

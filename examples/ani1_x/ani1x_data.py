@@ -17,27 +17,10 @@ from typing import List
 
 import numpy as np
 
+from examples.common_atomistic import frame_to_sample
 from hydragnn_tpu.graphs.batch import GraphSample
-from hydragnn_tpu.graphs.radius import radius_graph
 
-FORCES_NORM_THRESHOLD = 100.0
 DATA_KEYS = ["wb97x_dz.energy", "wb97x_dz.forces"]
-
-
-def _frame_to_sample(z, pos, energy, forces, natoms, radius, max_neighbours,
-                     energy_per_atom=True) -> GraphSample:
-    x = np.concatenate([z[:, None], pos, forces], axis=1)
-    send, recv = radius_graph(pos, radius, max_neighbours=max_neighbours)
-    vec = pos[send] - pos[recv]
-    edge_len = np.linalg.norm(vec, axis=1, keepdims=True)
-    e = energy / natoms if energy_per_atom else energy
-    return GraphSample(x=x.astype(np.float32), pos=pos.astype(np.float32),
-                       senders=send, receivers=recv,
-                       edge_attr=edge_len.astype(np.float32),
-                       y_graph=np.asarray([e], np.float32),
-                       y_node=forces.astype(np.float32),
-                       energy=np.asarray([e], np.float32),
-                       forces=forces.astype(np.float32))
 
 
 def load_ani1x(dirpath: str, radius: float = 5.0,
@@ -61,13 +44,11 @@ def load_ani1x(dirpath: str, radius: float = 5.0,
             F = np.asarray(g[DATA_KEYS[1]], np.float32)
             ok = ~np.isnan(E)
             for i in np.nonzero(ok)[0]:
-                forces = F[i]
-                if not np.all(np.linalg.norm(forces, axis=1)
-                              < FORCES_NORM_THRESHOLD):
-                    continue
-                samples.append(_frame_to_sample(
-                    z, X[i], float(E[i]), forces, len(z), radius,
-                    max_neighbours, energy_per_atom))
+                s = frame_to_sample(z, X[i], float(E[i]), F[i], radius,
+                                    max_neighbours,
+                                    energy_per_atom=energy_per_atom)
+                if s is not None:
+                    samples.append(s)
                 if len(samples) >= limit:
                     return samples
     return samples
@@ -77,9 +58,9 @@ def generate_ani1x_dataset(dirpath: str, num_formulas: int = 10,
                            frames_per_formula: int = 20,
                            seed: int = 0) -> str:
     import h5py
+    from examples.common_atomistic import mark_synthetic
     dirpath = os.path.join(dirpath, "synthetic")
-    os.makedirs(dirpath, exist_ok=True)
-    open(os.path.join(dirpath, ".synthetic"), "w").write("generated stand-in data; safe to delete\n")
+    mark_synthetic(dirpath)
     rng = np.random.RandomState(seed)
     elements = np.array([1, 6, 7, 8], np.int64)
     with h5py.File(os.path.join(dirpath, "ani1x-release.h5"), "w") as f:

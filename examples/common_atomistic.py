@@ -1,0 +1,72 @@
+"""Shared helpers for the atomistic example CLIs (mptrj, alexandria,
+open_catalyst_2020/2022, ani1_x-style frames).
+
+reference: each of those examples repeats the same frame->Data recipe
+(x = [Z, pos, forces], radius graph, edge lengths, per-atom energy,
+force-norm threshold; e.g. examples/mptrj/train.py:136-175,
+open_catalyst_2020/train.py:51-118); factored here once.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from hydragnn_tpu.graphs.batch import GraphSample
+from hydragnn_tpu.graphs.radius import radius_graph, radius_graph_pbc
+
+FORCES_NORM_THRESHOLD = 100.0
+
+
+def frame_to_sample(z: np.ndarray, pos: np.ndarray, energy: float,
+                    forces: np.ndarray, radius: float, max_neighbours: int,
+                    cell: Optional[np.ndarray] = None,
+                    energy_per_atom: bool = True) -> Optional[GraphSample]:
+    """None when the force-sanity threshold trips (reference
+    check_forces_values)."""
+    forces = np.asarray(forces, np.float32)
+    if not np.all(np.linalg.norm(forces, axis=1) < FORCES_NORM_THRESHOLD):
+        return None
+    z = np.asarray(z, np.float32)
+    pos = np.asarray(pos, np.float32)
+    x = np.concatenate([z[:, None], pos, forces], axis=1)
+    shifts = None
+    if cell is not None and np.abs(cell).sum() > 0:
+        send, recv, shifts = radius_graph_pbc(
+            pos, cell, radius, max_neighbours=max_neighbours)
+    else:
+        send, recv = radius_graph(pos, radius, max_neighbours=max_neighbours)
+    vec = pos[send] - pos[recv]
+    if shifts is not None:
+        vec = vec + shifts
+    edge_len = np.linalg.norm(vec, axis=1, keepdims=True).astype(np.float32)
+    e = float(energy) / len(z) if energy_per_atom else float(energy)
+    return GraphSample(x=x, pos=pos, senders=send, receivers=recv,
+                       edge_attr=edge_len, edge_shifts=shifts,
+                       y_graph=np.asarray([e], np.float32),
+                       y_node=forces, cell=cell,
+                       energy=np.asarray([e], np.float32), forces=forces)
+
+
+def random_crystal(rng, n_min=4, n_max=16, elements=(8, 13, 14, 22, 26, 28),
+                   box=8.0, jitter=0.15):
+    """A random periodic structure + harmonic-well energy/forces for the
+    synthetic stand-in generators."""
+    n = rng.randint(n_min, n_max)
+    z = np.asarray(rng.choice(elements, n), np.float64)
+    grid = rng.rand(n, 3) * box
+    disp = rng.randn(n, 3) * jitter
+    pos = (grid + disp) % box
+    cell = np.eye(3, dtype=np.float32) * box
+    k = 4.0
+    e0 = -5.0 * float(z.sum())
+    energy = e0 + 0.5 * k * float((disp ** 2).sum())
+    forces = -k * disp
+    return z, pos.astype(np.float32), cell, energy, forces.astype(np.float32)
+
+
+def mark_synthetic(dirpath: str) -> None:
+    os.makedirs(dirpath, exist_ok=True)
+    with open(os.path.join(dirpath, ".synthetic"), "w") as f:
+        f.write("generated stand-in data; safe to delete\n")

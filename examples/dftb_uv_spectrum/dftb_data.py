@@ -90,19 +90,33 @@ def load_dftb_dir(moldir: str, smooth: bool, num_bins: Optional[int] = None):
 
 def load_dftb_dataset(dirpath: str, smooth: bool,
                       limit: Optional[int] = None) -> List[GraphSample]:
-    dirs = sorted(d for d in os.listdir(dirpath)
-                  if os.path.isdir(os.path.join(dirpath, d)))
+    def _mol_dirs(root):
+        if not os.path.isdir(root):
+            return []
+        return sorted(d for d in os.listdir(root)
+                      if d.startswith("mol_")
+                      and os.path.isdir(os.path.join(root, d)))
+    dirs = _mol_dirs(dirpath)
+    if not dirs:
+        # synthetic stand-in lives in a marked subdir so purging it can
+        # never touch a user-downloaded dataset
+        dirpath = os.path.join(dirpath, "synthetic")
+        dirs = _mol_dirs(dirpath)
     if limit:
         dirs = dirs[:limit]
     return [load_dftb_dir(os.path.join(dirpath, d), smooth) for d in dirs]
 
 
 def _write_pdb(path: str, syms, pos):
+    """Standard-column PDB ATOM records: serial 7-11, name 13-16,
+    resName 18-20, chainID 22, resSeq 23-26, x/y/z 31-54, occupancy
+    55-60, tempFactor 61-66, element 77-78 (1-based columns)."""
     lines = []
     for i, (s, p) in enumerate(zip(syms, pos)):
         lines.append(
-            f"HETATM{i+1:5d} {s:<4s}MOL A   1    "
-            f"{p[0]:8.3f}{p[1]:8.3f}{p[2]:8.3f}  1.00  0.00          {s:>2s}")
+            f"HETATM{i+1:5d}  {s:<3s} MOL A{1:4d}    "
+            f"{p[0]:8.3f}{p[1]:8.3f}{p[2]:8.3f}{1.0:6.2f}{0.0:6.2f}"
+            f"          {s:>2s}")
     lines.append("END")
     with open(path, "w") as f:
         f.write("\n".join(lines))
@@ -112,9 +126,11 @@ def generate_dftb_dataset(dirpath: str, num_mols: int = 100,
                           smooth_bins: int = 500, discrete_lines: int = 50,
                           seed: int = 0) -> str:
     """Random organic molecules + composition-determined Gaussian-mixture
-    spectra, written in the reference's directory layout."""
-    os.makedirs(dirpath, exist_ok=True)
-    open(os.path.join(dirpath, ".synthetic"), "w").write("generated stand-in data; safe to delete\n")
+    spectra, written in the reference's directory layout under
+    `<dirpath>/synthetic/`."""
+    from examples.common_atomistic import mark_synthetic
+    dirpath = os.path.join(dirpath, "synthetic")
+    mark_synthetic(dirpath)
     rng = np.random.RandomState(seed)
     heavy = ["C", "N", "O", "F", "S"]
     grid = np.linspace(0.0, 25.0, smooth_bins)

@@ -63,8 +63,9 @@ def main():
 
     datadir = os.path.join(
         here, "dataset", "dftb_aisd_electronic_excitation_spectrum")
-    if not os.path.isdir(datadir) or not os.listdir(datadir):
-        os.makedirs(datadir, exist_ok=True)
+    import glob
+    if not (glob.glob(os.path.join(datadir, "mol_*")) or
+            glob.glob(os.path.join(datadir, "synthetic", "mol_*"))):
         generate_dftb_dataset(datadir, num_mols=args.num_mols,
                               smooth_bins=args.num_bins)
     if args.preonly:

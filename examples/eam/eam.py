@@ -59,11 +59,17 @@ def main():
 
     if not args.loadexistingsplit:
         if not os.path.isdir(rawdir) or not os.listdir(rawdir):
-            with_forces = "atomic_force" in ds_cfg["node_features"]["name"]
-            with_bulk = bool(ds_cfg["graph_features"]["name"])
-            generate_ninb_dataset(rawdir, num_configs=args.num_configs,
-                                  with_forces=with_forces,
-                                  with_bulk=with_bulk)
+            # synthetic stand-in lives in a marked subdir so purging it
+            # can never touch the real OLCF download at rawdir
+            rawdir = os.path.join(here, "dataset", "synthetic",
+                                  os.path.basename(rawdir))
+            if not os.path.isdir(rawdir) or not os.listdir(rawdir):
+                with_forces = ("atomic_force"
+                               in ds_cfg["node_features"]["name"])
+                with_bulk = bool(ds_cfg["graph_features"]["name"])
+                generate_ninb_dataset(rawdir, num_configs=args.num_configs,
+                                      with_forces=with_forces,
+                                      with_bulk=with_bulk)
         total = CFGDataset(config, rawdir)
         trainset, valset, testset = split_dataset(
             list(total), config["NeuralNetwork"]["Training"]["perc_train"],

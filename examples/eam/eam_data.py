@@ -93,8 +93,8 @@ def generate_ninb_dataset(dirpath: str, num_configs: int = 100,
                           jitter: float = 0.06, with_forces: bool = False,
                           with_bulk: bool = False, seed: int = 0) -> str:
     """FCC supercells (4 atoms/cell) with random Nb substitution."""
-    os.makedirs(dirpath, exist_ok=True)
-    open(os.path.join(dirpath, ".synthetic"), "w").write("generated stand-in data; safe to delete\n")
+    from examples.common_atomistic import mark_synthetic
+    mark_synthetic(dirpath)
     rng = np.random.RandomState(seed)
     basis = np.array([[0, 0, 0], [0, .5, .5], [.5, 0, .5], [.5, .5, 0]])
     grid = np.stack(np.meshgrid(*[np.arange(cells_per_dim)] * 3,

@@ -77,8 +77,10 @@ def create_dataset(L: int, histogram_cutoff: int, dirpath: str,
                    seed: int = 43, max_configs: Optional[int] = None) -> int:
     """Generate the full sweep over down-spin counts
     (reference create_configurations.py:77-115)."""
-    os.makedirs(dirpath, exist_ok=True)
-    open(os.path.join(dirpath, ".synthetic"), "w").write("generated stand-in data; safe to delete\n")
+    import sys as _sys
+    _sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+    from examples.common_atomistic import mark_synthetic
+    mark_synthetic(dirpath)
     rng = np.random.RandomState(seed)
     n = L ** 3
     count = 0

@@ -56,7 +56,12 @@ def main():
 
     if not args.loadexistingsplit:
         if not os.path.isdir(rawdir) or not os.listdir(rawdir):
-            generate_fept_dataset(rawdir, num_configs=args.num_configs)
+            # synthetic stand-in lives in a marked subdir so purging it
+            # can never touch a real FePt download at rawdir
+            rawdir = os.path.join(here, "dataset", "synthetic",
+                                  os.path.basename(rawdir))
+            if not os.path.isdir(rawdir) or not os.listdir(rawdir):
+                generate_fept_dataset(rawdir, num_configs=args.num_configs)
         total = LSMSDataset(config, rawdir)
         trainset, valset, testset = split_dataset(
             list(total), config["NeuralNetwork"]["Training"]["perc_train"],

@@ -23,8 +23,8 @@ def generate_fept_dataset(dirpath: str, num_configs: int = 200,
                           jitter: float = 0.05, seed: int = 0) -> str:
     """Write `num_configs` LSMS text files of BCC FePt (2 atoms/cell =>
     2 * atoms_per_dim^3 atoms) under `dirpath`."""
-    os.makedirs(dirpath, exist_ok=True)
-    open(os.path.join(dirpath, ".synthetic"), "w").write("generated stand-in data; safe to delete\n")
+    from examples.common_atomistic import mark_synthetic
+    mark_synthetic(dirpath)
     rng = np.random.RandomState(seed)
     grid = np.stack(np.meshgrid(*[np.arange(atoms_per_dim)] * 3,
                                 indexing="ij"), axis=-1).reshape(-1, 3)

@@ -1,0 +1,96 @@
+"""MPTrj (Materials Project trajectories) data loading: real
+`MPtrj_2022.9_full.json` when present, synthetic fallback.
+
+reference: examples/mptrj/train.py:63-190 — nested JSON
+{mp_id: {frame_id: {energy_per_atom, corrected_total_energy, force,
+stress, magmom, structure(pymatgen dict)}}}; frames become graphs with
+x = [Z, pos, forces], per-atom energy, radius graph + edge lengths,
+force-norm threshold. The pymatgen structure dict is parsed directly
+(lattice.matrix + sites[].abc/xyz + species[].element) instead of going
+through jarvis/pymatgen.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List
+
+import numpy as np
+
+from examples.common_atomistic import (frame_to_sample, mark_synthetic,
+                                       random_crystal)
+from hydragnn_tpu.utils.elements import SYMBOLS, symbol_to_z
+
+FNAME = "MPtrj_2022.9_full.json"
+
+
+def _structure_to_arrays(structure: dict):
+    cell = np.asarray(structure["lattice"]["matrix"], np.float32)
+    zs, pos = [], []
+    for site in structure["sites"]:
+        sp = site["species"][0]["element"]
+        zs.append(symbol_to_z(sp))
+        if "xyz" in site:
+            pos.append(site["xyz"])
+        else:
+            pos.append(np.asarray(site["abc"]) @ cell)
+    return np.asarray(zs, np.float32), np.asarray(pos, np.float32), cell
+
+
+def load_mptrj(dirpath: str, radius: float = 5.0, max_neighbours: int = 100,
+               limit: int = 1000, energy_per_atom: bool = True):
+    path = os.path.join(dirpath, FNAME)
+    if not os.path.exists(path):
+        path = os.path.join(dirpath, "synthetic", FNAME)
+    with open(path, encoding="utf-8") as f:
+        d = json.load(f)
+    samples: List = []
+    for mpid in d:
+        for jid, k in d[mpid].items():
+            z, pos, cell = _structure_to_arrays(k["structure"])
+            energy = (k["energy_per_atom"] * len(z) if energy_per_atom
+                      else k["corrected_total_energy"])
+            s = frame_to_sample(z, pos, energy, np.asarray(k["force"]),
+                                radius, max_neighbours, cell=cell,
+                                energy_per_atom=energy_per_atom)
+            if s is not None:
+                samples.append(s)
+            if len(samples) >= limit:
+                return samples
+    return samples
+
+
+def generate_mptrj_dataset(dirpath: str, num_structures: int = 30,
+                           frames_per_structure: int = 4,
+                           seed: int = 0) -> str:
+    dirpath = os.path.join(dirpath, "synthetic")
+    mark_synthetic(dirpath)
+    rng = np.random.RandomState(seed)
+    d = {}
+    for m in range(num_structures):
+        z, pos, cell, energy, forces = random_crystal(rng)
+        frames = {}
+        for t in range(frames_per_structure):
+            dd = rng.randn(*pos.shape).astype(np.float32) * 0.05
+            p = pos + dd
+            e = energy + 2.0 * float((dd ** 2).sum())
+            f = forces - 4.0 * dd
+            sites = [{"species": [{"element": SYMBOLS[int(zi)], "occu": 1}],
+                      "abc": (p[i] @ np.linalg.inv(cell)).tolist(),
+                      "xyz": p[i].tolist(),
+                      "properties": {}} for i, zi in enumerate(z)]
+            frames[f"{m}-{t}"] = {
+                "energy_per_atom": e / len(z),
+                "corrected_total_energy": e,
+                "force": f.tolist(),
+                "stress": np.zeros((3, 3)).tolist(),
+                "magmom": np.zeros(len(z)).tolist(),
+                "structure": {
+                    "lattice": {"matrix": cell.tolist()},
+                    "sites": sites,
+                },
+            }
+        d[f"mp-{m:06d}"] = frames
+    with open(os.path.join(dirpath, FNAME), "w") as f:
+        json.dump(d, f)
+    return dirpath

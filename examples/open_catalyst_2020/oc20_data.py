@@ -1,0 +1,87 @@
+"""OC20 S2EF data loading: real uncompressed extxyz chunks when present,
+synthetic fallback.
+
+reference: examples/open_catalyst_2020/train.py:51-118 + utils/ — S2EF
+splits ship as chunked `%d.txt` extxyz files (after uncompress.py);
+frames carry forces columns and free_energy in the comment line; graphs
+get x = [Z, pos, forces], per-atom energy, radius graph + edge lengths,
+force-norm threshold.
+
+Synthetic fallback: Cu/Pt slab + CO adsorbate-like configurations in the
+same chunked extxyz layout (see generate_oc20_dataset).
+"""
+from __future__ import annotations
+
+import glob
+import os
+from typing import List
+
+import numpy as np
+
+from examples.common_atomistic import frame_to_sample, mark_synthetic
+from hydragnn_tpu.datasets.extxyz import Frame, iread_extxyz, write_extxyz
+
+
+def load_oc20(dirpath: str, radius: float = 5.0, max_neighbours: int = 100,
+              limit: int = 1000, energy_per_atom: bool = True):
+    files = sorted(glob.glob(os.path.join(dirpath, "*.txt")))
+    if not files:
+        files = sorted(glob.glob(os.path.join(dirpath, "synthetic",
+                                              "*.txt")))
+    samples: List = []
+    for path in files:
+        for fr in iread_extxyz(path):
+            energy = fr.info.get("free_energy", fr.info.get("energy", 0.0))
+            forces = fr.arrays.get(
+                "forces", np.zeros((len(fr.z), 3), np.float32))
+            s = frame_to_sample(fr.z, fr.pos, energy, forces, radius,
+                                max_neighbours, cell=fr.cell,
+                                energy_per_atom=energy_per_atom)
+            if s is not None:
+                samples.append(s)
+            if len(samples) >= limit:
+                return samples
+    return samples
+
+
+def generate_oc20_dataset(dirpath: str, num_chunks: int = 2,
+                          frames_per_chunk: int = 40, seed: int = 0) -> str:
+    """Slab (Cu/Pt fcc layers) + CO adsorbate frames with harmonic-well
+    energies/forces, chunked as `%d.txt` like the S2EF uncompressed
+    layout."""
+    dirpath = os.path.join(dirpath, "synthetic")
+    mark_synthetic(dirpath)
+    rng = np.random.RandomState(seed)
+    a = 3.6
+    nx = ny = 3
+    layers = 3
+    for chunk in range(num_chunks):
+        frames = []
+        for _ in range(frames_per_chunk):
+            metal = 29.0 if rng.rand() < 0.5 else 78.0
+            slab_pos, slab_z = [], []
+            for l in range(layers):
+                for i in range(nx):
+                    for j in range(ny):
+                        off = (a / 2 if l % 2 else 0.0)
+                        slab_pos.append([i * a + off, j * a + off,
+                                         l * a * 0.7])
+                        slab_z.append(metal)
+            # CO adsorbate above a random site
+            site = rng.randint(len(slab_pos) - nx * ny, len(slab_pos))
+            cx, cy, cz = slab_pos[site]
+            slab_pos += [[cx, cy, cz + 1.9], [cx, cy, cz + 3.05]]
+            slab_z += [6.0, 8.0]
+            pos0 = np.asarray(slab_pos, np.float32)
+            z = np.asarray(slab_z, np.float32)
+            disp = rng.randn(*pos0.shape).astype(np.float32) * 0.08
+            pos = pos0 + disp
+            k = 5.0
+            energy = (-3.0 * len(z) + 0.5 * k * float((disp ** 2).sum())
+                      - 1.5 * (metal == 78.0))
+            forces = (-k * disp).astype(np.float32)
+            cell = np.diag([nx * a, ny * a, 25.0]).astype(np.float32)
+            frames.append(Frame(z, pos, cell, {"forces": forces},
+                                {"energy": energy, "free_energy": energy}))
+        write_extxyz(os.path.join(dirpath, f"{chunk}.txt"), frames)
+    return dirpath

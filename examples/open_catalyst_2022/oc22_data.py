@@ -1,0 +1,91 @@
+"""OC22 trajectory data loading: real trajectory filelist + extxyz frames
+when present, synthetic fallback.
+
+reference: examples/open_catalyst_2022/train.py:62-130 — a
+`<data_type>_t.txt` filelist under oc22_trajectories/trajectories/oc22/
+names per-system trajectory files read with ase.io.read; frames carry
+energies + forces. ase is not in this image, so trajectories must be in
+extxyz form (convert `.traj` with ase separately); the synthetic
+generator emits oxide-slab-like extxyz trajectories + filelist in the
+same layout.
+"""
+from __future__ import annotations
+
+import os
+from typing import List
+
+import numpy as np
+
+from examples.common_atomistic import frame_to_sample, mark_synthetic
+from hydragnn_tpu.datasets.extxyz import Frame, iread_extxyz, write_extxyz
+
+TRAJ_SUBDIR = os.path.join("oc22_trajectories", "trajectories", "oc22")
+
+
+def load_oc22(dirpath: str, data_type: str = "train", radius: float = 5.0,
+              max_neighbours: int = 100, limit: int = 1000,
+              energy_per_atom: bool = True):
+    root = os.path.join(dirpath, TRAJ_SUBDIR)
+    if not os.path.isdir(root):
+        root = os.path.join(dirpath, "synthetic", TRAJ_SUBDIR)
+    filelist = os.path.join(root, f"{data_type}_t.txt")
+    with open(filelist, encoding="utf-8") as f:
+        names = [line.strip() for line in f if line.strip()]
+    samples: List = []
+    for name in names:
+        path = os.path.join(root, data_type, name)
+        for fr in iread_extxyz(path):
+            energy = fr.info.get("energy", fr.info.get("free_energy", 0.0))
+            forces = fr.arrays.get(
+                "forces", np.zeros((len(fr.z), 3), np.float32))
+            s = frame_to_sample(fr.z, fr.pos, energy, forces, radius,
+                                max_neighbours, cell=fr.cell,
+                                energy_per_atom=energy_per_atom)
+            if s is not None:
+                samples.append(s)
+            if len(samples) >= limit:
+                return samples
+    return samples
+
+
+def generate_oc22_dataset(dirpath: str, data_type: str = "train",
+                          num_systems: int = 8, frames_per_system: int = 10,
+                          seed: int = 0) -> str:
+    """Metal-oxide slab trajectories (Ti/Ir + O) with harmonic-well
+    energies/forces in the reference's filelist + per-system layout."""
+    base = os.path.join(dirpath, "synthetic")
+    mark_synthetic(base)
+    root = os.path.join(base, TRAJ_SUBDIR)
+    os.makedirs(os.path.join(root, data_type), exist_ok=True)
+    rng = np.random.RandomState(seed)
+    a = 3.2
+    names = []
+    for sysid in range(num_systems):
+        metal = 22.0 if rng.rand() < 0.5 else 77.0
+        pos0, z = [], []
+        for l in range(2):
+            for i in range(3):
+                for j in range(3):
+                    pos0.append([i * a, j * a, l * a * 0.8])
+                    z.append(metal)
+                    pos0.append([i * a + a / 2, j * a + a / 2,
+                                 l * a * 0.8 + a * 0.4])
+                    z.append(8.0)
+        pos0 = np.asarray(pos0, np.float32)
+        z = np.asarray(z, np.float32)
+        cell = np.diag([3 * a, 3 * a, 20.0]).astype(np.float32)
+        frames = []
+        for _ in range(frames_per_system):
+            disp = rng.randn(*pos0.shape).astype(np.float32) * 0.07
+            pos = pos0 + disp
+            k = 6.0
+            energy = -4.0 * len(z) + 0.5 * k * float((disp ** 2).sum())
+            forces = (-k * disp).astype(np.float32)
+            frames.append(Frame(z, pos, cell, {"forces": forces},
+                                {"energy": energy}))
+        name = f"sys_{sysid:04d}.extxyz"
+        write_extxyz(os.path.join(root, data_type, name), frames)
+        names.append(name)
+    with open(os.path.join(root, f"{data_type}_t.txt"), "w") as f:
+        f.write("\n".join(names) + "\n")
+    return base

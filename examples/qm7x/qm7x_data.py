@@ -49,7 +49,13 @@ def load_qm7x(dirpath: str, radius: float = 5.0, max_neighbours: int = 20,
               limit: int = 1000) -> List[GraphSample]:
     import h5py
     samples = []
-    for path in sorted(glob.glob(os.path.join(dirpath, "*.hdf5"))):
+    files = sorted(glob.glob(os.path.join(dirpath, "*.hdf5")))
+    if not files:
+        # synthetic stand-in lives in a marked subdir so purging it can
+        # never touch user-downloaded set files
+        files = sorted(glob.glob(os.path.join(dirpath, "synthetic",
+                                              "*.hdf5")))
+    for path in files:
         with h5py.File(path, "r") as f:
             for mol_id in f.keys():
                 for conf_id in f[mol_id].keys():
@@ -75,10 +81,12 @@ def load_qm7x(dirpath: str, radius: float = 5.0, max_neighbours: int = 20,
 
 def generate_qm7x_dataset(dirpath: str, num_mols: int = 20,
                           confs_per_mol: int = 5, seed: int = 0) -> str:
-    """Write one set file `1000.hdf5` in the QM7-X layout."""
+    """Write one set file `1000.hdf5` (QM7-X layout) under
+    `<dirpath>/synthetic/`."""
     import h5py
-    os.makedirs(dirpath, exist_ok=True)
-    open(os.path.join(dirpath, ".synthetic"), "w").write("generated stand-in data; safe to delete\n")
+    from examples.common_atomistic import mark_synthetic
+    dirpath = os.path.join(dirpath, "synthetic")
+    mark_synthetic(dirpath)
     rng = np.random.RandomState(seed)
     elements = np.array([1, 6, 7, 8], np.int64)
     with h5py.File(os.path.join(dirpath, "1000.hdf5"), "w") as f:

@@ -21,7 +21,7 @@ from ..graphs.batch import GraphSample
 from ..preprocess.load_data import split_dataset
 from ..preprocess.transforms import build_graph_sample, normalize_edge_lengths
 from ..utils.elements import symbol_to_z
-from .lsmsdataset import _minmax_normalize
+from .lsmsdataset import _minmax_normalize, normalize_sidecar_graph_targets
 from .xyzdataset import _read_sidecar_graph_feats
 
 
@@ -109,17 +109,10 @@ class CFGDataset:
         # dataset-wide min-max feature normalization (reference:
         # AbstractRawDataset normalize, utils/datasets/abstractrawdataset.py:29)
         feats_all, self.minmax_node_feature = _minmax_normalize(feats_all)
-        n_present = sum(g is not None for g in gfeat_all)
-        if gf["dim"] and n_present == len(gfeat_all):
-            gfeat_all, self.minmax_graph_feature = _minmax_normalize(
-                [g[None] for g in gfeat_all])
-            gfeat_all = [g[0] for g in gfeat_all]
-        elif gf["dim"] and 0 < n_present < len(gfeat_all):
-            raise ValueError(
-                f"{dirpath}: {n_present}/{len(gfeat_all)} .cfg files have "
-                ".bulk sidecars; all or none must be present")
-        else:
-            self.minmax_graph_feature = None
+        needs_graph_target = "graph" in config["NeuralNetwork"][
+            "Variables_of_interest"]["type"]
+        gfeat_all, self.minmax_graph_feature = normalize_sidecar_graph_targets(
+            gfeat_all, gf["dim"], needs_graph_target, ".bulk", dirpath)
         self.samples = []
         for feats, pos, cell, gfeat in zip(feats_all, pos_all, cell_all,
                                            gfeat_all):

@@ -65,6 +65,27 @@ def _minmax_normalize(arrs: List[np.ndarray]) -> Tuple[List[np.ndarray], np.ndar
     return out, np.stack([lo, hi])
 
 
+def normalize_sidecar_graph_targets(gfeat_all, gf_dims, needs_graph_target,
+                                    what, dirpath):
+    """Shared all-or-none sidecar policy + dataset-wide min-max for graph
+    targets read from per-file sidecars (XYZ `*_energy.txt`, CFG `*.bulk`).
+    Returns (gfeat_all, minmax or None); raises when sidecars are partially
+    present, or absent while a graph output was requested."""
+    n_present = sum(g is not None for g in gfeat_all)
+    if not gf_dims or n_present == 0:
+        if needs_graph_target:
+            raise FileNotFoundError(
+                f"{dirpath}: graph target requested but no {what} sidecars "
+                "found")
+        return gfeat_all, None
+    if n_present < len(gfeat_all):
+        raise ValueError(
+            f"{dirpath}: {n_present}/{len(gfeat_all)} files have {what} "
+            "sidecars; all or none must be present")
+    gfeat_all, minmax = _minmax_normalize([g[None] for g in gfeat_all])
+    return [g[0] for g in gfeat_all], minmax
+
+
 class LSMSDataset:
     """Loads a directory of LSMS text files into GraphSamples with radius
     graphs, normalized features, selected inputs/targets — the raw->graph

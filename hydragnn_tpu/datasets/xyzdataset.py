@@ -79,9 +79,6 @@ class XYZDataset:
             sidecar = os.path.splitext(fp)[0] + "_energy.txt"
             gfeat = _read_sidecar_graph_feats(
                 sidecar, gf["dim"], gf["column_index"])
-            if gfeat is None and needs_graph_target:
-                raise FileNotFoundError(
-                    f"graph target requested but sidecar {sidecar} missing")
             z_all.append(z)
             pos_all.append(pos)
             cell_all.append(cell)
@@ -89,19 +86,10 @@ class XYZDataset:
         # dataset-wide min-max normalization of graph targets (reference:
         # AbstractRawDataset normalize, utils/datasets/abstractrawdataset.py:29;
         # node features here are bare atomic numbers, left unscaled)
+        from .lsmsdataset import normalize_sidecar_graph_targets
         self.minmax_node_feature = None
-        n_present = sum(g is not None for g in gfeat_all)
-        if gf["dim"] and n_present == len(gfeat_all):
-            from .lsmsdataset import _minmax_normalize
-            gfeat_all, self.minmax_graph_feature = _minmax_normalize(
-                [g[None] for g in gfeat_all])
-            gfeat_all = [g[0] for g in gfeat_all]
-        elif gf["dim"] and 0 < n_present < len(gfeat_all):
-            raise ValueError(
-                f"{dirpath}: {n_present}/{len(gfeat_all)} files have graph-"
-                "target sidecars; all or none must be present")
-        else:
-            self.minmax_graph_feature = None
+        gfeat_all, self.minmax_graph_feature = normalize_sidecar_graph_targets(
+            gfeat_all, gf["dim"], needs_graph_target, "*_energy.txt", dirpath)
         self.samples = []
         for z, pos, cell, gfeat in zip(z_all, pos_all, cell_all, gfeat_all):
             self.samples.append(build_graph_sample(

@@ -52,10 +52,13 @@ def update_atom_features(input_indices: Sequence[int], node_feats: np.ndarray,
     return np.concatenate(cols, axis=1)
 
 
-def normalize_rotation(pos: np.ndarray) -> np.ndarray:
+def normalize_rotation(pos: np.ndarray, return_rotation: bool = False):
     """Rotate to principal axes (reference: torch_geometric NormalizeRotation
     used at serialized_dataset_loader.py:123-125): eigenbasis of the
-    covariance of centered positions, sign-fixed."""
+    covariance of centered positions, sign-fixed. With
+    ``return_rotation=True`` also returns the rotation matrix so callers can
+    co-rotate the cell (the reference rotates pos only and leaves the cell,
+    which breaks PBC minimum images; we keep the frames consistent)."""
     centered = pos - pos.mean(axis=0, keepdims=True)
     cov = centered.T @ centered
     _, vecs = np.linalg.eigh(cov)
@@ -68,7 +71,10 @@ def normalize_rotation(pos: np.ndarray) -> np.ndarray:
             vecs[:, k] = -col
     if np.linalg.det(vecs) < 0:
         vecs[:, 2] = -vecs[:, 2]
-    return (centered @ vecs).astype(np.float32)
+    rotated = (centered @ vecs).astype(np.float32)
+    if return_rotation:
+        return rotated, vecs.astype(np.float32)
+    return rotated
 
 
 def build_graph_sample(
@@ -92,7 +98,10 @@ def build_graph_sample(
     graph_dims = ds.get("graph_features", {}).get("dim", [])
 
     if ds.get("rotational_invariance", False):
-        pos = normalize_rotation(pos)
+        pos, rot = normalize_rotation(pos, return_rotation=True)
+        if cell is not None:
+            # co-rotate the lattice so PBC minimum images stay correct
+            cell = (np.asarray(cell) @ rot).astype(np.float32)
 
     radius = float(arch.get("radius") or 5.0)
     max_nb = arch.get("max_neighbours")

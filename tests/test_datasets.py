@@ -192,3 +192,31 @@ def test_cfg_dataset(tmp_path):
     assert s.x.shape == (4, 1)
     assert s.y_graph.shape == (1,)
     np.testing.assert_allclose(s.cell, np.eye(3) * 4.0)
+
+
+def test_extxyz_roundtrip(tmp_path):
+    """extxyz writer -> reader preserves species, positions, cell, forces,
+    and comment-line scalars."""
+    import numpy as np
+    from hydragnn_tpu.datasets.extxyz import Frame, read_extxyz, write_extxyz
+    rng = np.random.RandomState(0)
+    frames = []
+    for i in range(3):
+        n = 4 + i
+        z = np.asarray(rng.choice([1, 6, 8, 29], n), np.float32)
+        pos = rng.rand(n, 3).astype(np.float32) * 5
+        cell = (np.eye(3) * (8.0 + i)).astype(np.float32)
+        forces = rng.randn(n, 3).astype(np.float32)
+        frames.append(Frame(z, pos, cell, {"forces": forces},
+                            {"energy": -1.5 * i, "free_energy": -1.6 * i}))
+    path = str(tmp_path / "frames.txt")
+    write_extxyz(path, frames)
+    back = read_extxyz(path)
+    assert len(back) == 3
+    for a, b in zip(frames, back):
+        np.testing.assert_allclose(a.z, b.z)
+        np.testing.assert_allclose(a.pos, b.pos, atol=1e-6)
+        np.testing.assert_allclose(a.cell, b.cell, atol=1e-6)
+        np.testing.assert_allclose(a.arrays["forces"], b.arrays["forces"],
+                                   atol=1e-6)
+        assert abs(a.info["energy"] - b.info["energy"]) < 1e-9
