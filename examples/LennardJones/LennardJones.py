@@ -37,10 +37,8 @@ def main():
     args = p.parse_args()
 
     if args.cpu:
-        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
-                                   " --xla_force_host_platform_device_count=8").strip()
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+        from examples.cli_utils import setup_cpu_devices
+        setup_cpu_devices()
 
     from examples.LennardJones.lj_data import generate_lj_dataset
     from hydragnn_tpu.preprocess.load_data import split_dataset

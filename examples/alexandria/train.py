@@ -30,25 +30,19 @@ def main():
     args = p.parse_args()
 
     if args.cpu:
-        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
-                                   " --xla_force_host_platform_device_count=8").strip()
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+        from examples.cli_utils import setup_cpu_devices
+        setup_cpu_devices()
 
     here = os.path.dirname(os.path.abspath(__file__))
-    with open(os.path.join(here, args.inputfile)) as f:
-        config = json.load(f)
+    from examples.cli_utils import load_example_config, split_and_train
+    config = load_example_config(here, args.inputfile,
+                                 num_epoch=args.num_epoch,
+                                 batch_size=args.batch_size)
     train_cfg = config["NeuralNetwork"]["Training"]
     arch = config["NeuralNetwork"]["Architecture"]
-    if args.num_epoch is not None:
-        train_cfg["num_epoch"] = args.num_epoch
-    if args.batch_size is not None:
-        train_cfg["batch_size"] = args.batch_size
 
     from examples.alexandria.alexandria_data import (
         generate_alexandria_dataset, load_alexandria)
-    from hydragnn_tpu.preprocess.load_data import split_dataset
-    from hydragnn_tpu.run_training import run_training
 
     datadir = os.path.join(here, "dataset")
     import glob
@@ -62,10 +56,7 @@ def main():
     samples = load_alexandria(datadir, radius=arch["radius"],
                               max_neighbours=min(arch["max_neighbours"], 512),
                               limit=args.limit)
-    splits = split_dataset(samples, train_cfg["perc_train"], False)
-    state, history, model, completed = run_training(config, datasets=splits)
-    print(json.dumps({"final_train_loss": history["train_loss"][-1],
-                      "final_val_loss": history["val_loss"][-1]}))
+    split_and_train(config, samples)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,82 @@
+"""CSCE HOMO-LUMO gap example CLI (SMILES -> PNA graph regression).
+
+reference: examples/csce/train_gap.py — CSCE GAP CSV (SMILES column 1,
+gap column -2), 6-type molecular featurization, PNA graph head per
+csce_gap.json, optional y mean/std normalization, pickle/adios
+persistence with DDStore option. The CSV is generated synthetically
+when absent (see csce_data.py).
+
+Usage:
+    python examples/csce/train_gap.py [--num_mols 300] [--sampling 1.0]
+        [--norm_yflag] [--num_epoch N] [--cpu]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--inputfile", default="csce_gap.json")
+    p.add_argument("--num_mols", type=int, default=300)
+    p.add_argument("--sampling", type=float, default=None)
+    p.add_argument("--norm_yflag", action="store_true")
+    p.add_argument("--preonly", action="store_true")
+    p.add_argument("--num_epoch", type=int, default=None)
+    p.add_argument("--batch_size", type=int, default=None)
+    p.add_argument("--hidden_dim", type=int, default=None)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+
+    if args.cpu:
+        from examples.cli_utils import setup_cpu_devices
+        setup_cpu_devices()
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, args.inputfile)) as f:
+        config = json.load(f)
+    train_cfg = config["NeuralNetwork"]["Training"]
+    if args.num_epoch is not None:
+        train_cfg["num_epoch"] = args.num_epoch
+    if args.batch_size is not None:
+        train_cfg["batch_size"] = args.batch_size
+    if args.hidden_dim is not None:
+        arch = config["NeuralNetwork"]["Architecture"]
+        arch["hidden_dim"] = args.hidden_dim
+        head = arch["output_heads"]["graph"]
+        head["dim_sharedlayers"] = args.hidden_dim
+        head["dim_headlayers"] = [args.hidden_dim] * len(
+            head["dim_headlayers"])
+
+    from examples.csce.csce_data import (CSCE_NODE_TYPES, csce_datasets_load,
+                                         generate_csce_csv,
+                                         smiles_sets_to_graphs)
+    from hydragnn_tpu.run_training import run_training
+
+    real = os.path.join(here, "dataset", "csce_gap.csv")
+    datafile = os.path.join(here, "dataset", "synthetic",
+                            "csce_gap_synth.csv")
+    if os.path.exists(real):
+        datafile = real
+    elif not os.path.exists(datafile):
+        datafile = generate_csce_csv(os.path.join(here, "dataset"),
+                                     num_mols=args.num_mols)
+    if args.preonly:
+        print(f"dataset ready at {datafile}")
+        return
+
+    sets, vals, ymean, ystd = csce_datasets_load(datafile,
+                                                 sampling=args.sampling)
+    splits = smiles_sets_to_graphs(sets, vals, norm_yflag=args.norm_yflag,
+                                   ymean=ymean, ystd=ystd,
+                                   types=list(CSCE_NODE_TYPES))
+    state, history, model, completed = run_training(config, datasets=splits)
+    print(json.dumps({"final_train_loss": history["train_loss"][-1],
+                      "final_val_loss": history["val_loss"][-1]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""OGB HOMO-LUMO gap example CLI (PCQM4Mv2-style SMILES CSV -> PNA).
+
+reference: examples/ogb/train_gap.py — CSV dir of SMILES + gap rows,
+31-type featurization (37 node features), PNA graph head per
+ogb_gap.json; pickle/adios persistence, DDStore option, deepspeed CLI
+(the TPU build's ZeRO-equivalent optimizer-state sharding is enabled
+with --shard_optimizer). CSVs are generated synthetically when absent.
+
+Usage:
+    python examples/ogb/train_gap.py [--num_mols 300] [--limit N]
+        [--shard_optimizer] [--num_epoch N] [--cpu]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--inputfile", default="ogb_gap.json")
+    p.add_argument("--num_mols", type=int, default=300)
+    p.add_argument("--limit", type=int, default=None)
+    p.add_argument("--shard_optimizer", action="store_true",
+                   help="shard optimizer state over the data mesh "
+                        "(ZeRO / deepspeed equivalent)")
+    p.add_argument("--preonly", action="store_true")
+    p.add_argument("--num_epoch", type=int, default=None)
+    p.add_argument("--batch_size", type=int, default=None)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+
+    if args.cpu:
+        from examples.cli_utils import setup_cpu_devices
+        setup_cpu_devices()
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, args.inputfile)) as f:
+        config = json.load(f)
+    train_cfg = config["NeuralNetwork"]["Training"]
+    if args.num_epoch is not None:
+        train_cfg["num_epoch"] = args.num_epoch
+    if args.batch_size is not None:
+        train_cfg["batch_size"] = args.batch_size
+    if args.shard_optimizer:
+        train_cfg.setdefault("Optimizer", {})["use_zero_redundancy"] = True
+
+    from examples.ogb.ogb_data import generate_ogb_csv, smiles_to_graphs
+    from hydragnn_tpu.preprocess.load_data import split_dataset
+    from hydragnn_tpu.run_training import run_training
+
+    import glob
+    datadir = os.path.join(here, "dataset")
+    if not (glob.glob(os.path.join(datadir, "*.csv")) or
+            glob.glob(os.path.join(datadir, "synthetic", "*.csv"))):
+        generate_ogb_csv(datadir, num_mols=args.num_mols)
+    if args.preonly:
+        print(f"dataset ready at {datadir}")
+        return
+
+    samples = smiles_to_graphs(datadir, limit=args.limit)
+    splits = split_dataset(samples, train_cfg["perc_train"], False)
+    state, history, model, completed = run_training(config, datasets=splits)
+    print(json.dumps({"final_train_loss": history["train_loss"][-1],
+                      "final_val_loss": history["val_loss"][-1]}))
+
+
+if __name__ == "__main__":
+    main()

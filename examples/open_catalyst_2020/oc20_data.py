@@ -24,10 +24,12 @@ from hydragnn_tpu.datasets.extxyz import Frame, iread_extxyz, write_extxyz
 
 def load_oc20(dirpath: str, radius: float = 5.0, max_neighbours: int = 100,
               limit: int = 1000, energy_per_atom: bool = True):
-    files = sorted(glob.glob(os.path.join(dirpath, "*.txt")))
+    # real uncompressed S2EF chunks are %d.extxyz (the sibling %d.txt files
+    # hold sid/fid metadata, not frames — reference utils/preprocess.py:32)
+    files = sorted(glob.glob(os.path.join(dirpath, "*.extxyz")))
     if not files:
         files = sorted(glob.glob(os.path.join(dirpath, "synthetic",
-                                              "*.txt")))
+                                              "*.extxyz")))
     samples: List = []
     for path in files:
         for fr in iread_extxyz(path):
@@ -47,7 +49,7 @@ def load_oc20(dirpath: str, radius: float = 5.0, max_neighbours: int = 100,
 def generate_oc20_dataset(dirpath: str, num_chunks: int = 2,
                           frames_per_chunk: int = 40, seed: int = 0) -> str:
     """Slab (Cu/Pt fcc layers) + CO adsorbate frames with harmonic-well
-    energies/forces, chunked as `%d.txt` like the S2EF uncompressed
+    energies/forces, chunked as `%d.extxyz` like the S2EF uncompressed
     layout."""
     dirpath = os.path.join(dirpath, "synthetic")
     mark_synthetic(dirpath)
@@ -83,5 +85,5 @@ def generate_oc20_dataset(dirpath: str, num_chunks: int = 2,
             cell = np.diag([nx * a, ny * a, 25.0]).astype(np.float32)
             frames.append(Frame(z, pos, cell, {"forces": forces},
                                 {"energy": energy, "free_energy": energy}))
-        write_extxyz(os.path.join(dirpath, f"{chunk}.txt"), frames)
+        write_extxyz(os.path.join(dirpath, f"{chunk}.extxyz"), frames)
     return dirpath

@@ -26,7 +26,9 @@ def load_oc22(dirpath: str, data_type: str = "train", radius: float = 5.0,
               max_neighbours: int = 100, limit: int = 1000,
               energy_per_atom: bool = True):
     root = os.path.join(dirpath, TRAJ_SUBDIR)
-    if not os.path.isdir(root):
+    # fall back to the synthetic tree per split filelist (a real download
+    # may ship some splits only)
+    if not os.path.exists(os.path.join(root, f"{data_type}_t.txt")):
         root = os.path.join(dirpath, "synthetic", TRAJ_SUBDIR)
     filelist = os.path.join(root, f"{data_type}_t.txt")
     with open(filelist, encoding="utf-8") as f:

@@ -25,10 +25,8 @@ def main():
     args = p.parse_args()
 
     if args.cpu:
-        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
-                                   " --xla_force_host_platform_device_count=8").strip()
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+        from examples.cli_utils import setup_cpu_devices
+        setup_cpu_devices()
 
     here = os.path.dirname(os.path.abspath(__file__))
     with open(os.path.join(here, args.inputfile)) as f:

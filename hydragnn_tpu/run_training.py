@@ -122,8 +122,15 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     cge = bool(train_cfg.get("compute_grad_energy", False))
     if num_shards > 1:
         mesh = make_mesh((("data", num_shards),))
+        # ZeRO-equivalent optimizer-state sharding (reference:
+        # Training.Optimizer.use_zero_redundancy, optimizer.py:104-113)
+        opt_cfg = train_cfg.get("Optimizer", {})
+        zero_opt = bool(opt_cfg.get("use_zero_redundancy", False))
+        zero_min = int(opt_cfg.get("zero_min_shard_size", 2 ** 14))
         train_step = make_spmd_train_step(model, mcfg, tx, mesh, loss_name,
-                                          compute_grad_energy=cge)
+                                          compute_grad_energy=cge,
+                                          zero_opt=zero_opt,
+                                          zero_min_size=zero_min)
         eval_step = make_spmd_eval_step(model, mcfg, mesh, loss_name,
                                         compute_grad_energy=cge)
     else:
