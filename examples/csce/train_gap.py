@@ -36,25 +36,15 @@ def main():
         setup_cpu_devices()
 
     here = os.path.dirname(os.path.abspath(__file__))
-    with open(os.path.join(here, args.inputfile)) as f:
-        config = json.load(f)
-    train_cfg = config["NeuralNetwork"]["Training"]
-    if args.num_epoch is not None:
-        train_cfg["num_epoch"] = args.num_epoch
-    if args.batch_size is not None:
-        train_cfg["batch_size"] = args.batch_size
-    if args.hidden_dim is not None:
-        arch = config["NeuralNetwork"]["Architecture"]
-        arch["hidden_dim"] = args.hidden_dim
-        head = arch["output_heads"]["graph"]
-        head["dim_sharedlayers"] = args.hidden_dim
-        head["dim_headlayers"] = [args.hidden_dim] * len(
-            head["dim_headlayers"])
+    from examples.cli_utils import load_example_config, train_and_report
+    config = load_example_config(here, args.inputfile,
+                                 num_epoch=args.num_epoch,
+                                 batch_size=args.batch_size,
+                                 hidden_dim=args.hidden_dim)
 
     from examples.csce.csce_data import (CSCE_NODE_TYPES, csce_datasets_load,
                                          generate_csce_csv,
                                          smiles_sets_to_graphs)
-    from hydragnn_tpu.run_training import run_training
 
     real = os.path.join(here, "dataset", "csce_gap.csv")
     datafile = os.path.join(here, "dataset", "synthetic",
@@ -73,9 +63,7 @@ def main():
     splits = smiles_sets_to_graphs(sets, vals, norm_yflag=args.norm_yflag,
                                    ymean=ymean, ystd=ystd,
                                    types=list(CSCE_NODE_TYPES))
-    state, history, model, completed = run_training(config, datasets=splits)
-    print(json.dumps({"final_train_loss": history["train_loss"][-1],
-                      "final_val_loss": history["val_loss"][-1]}))
+    train_and_report(config, splits)
 
 
 if __name__ == "__main__":

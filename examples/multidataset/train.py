@@ -1,0 +1,211 @@
+"""GFM multi-dataset training example CLI.
+
+reference: examples/multidataset/train.py — "--multi" mode splits the
+world communicator into per-dataset groups sized proportionally to
+dataset size; each group reads its own ADIOS file; gradients still
+allreduce globally; per-dataset pna_deg histograms are merged.
+
+TPU redesign (hydragnn_tpu/parallel/multidataset.py): one data mesh, a
+static device->dataset proportional assignment instead of communicator
+splits, per-device epoch streams, and the single gradient pmean as the
+global allreduce. The --preonly stage persists each member dataset as a
+GraphStore (the ADIOS-file equivalent) with its pna_deg attribute;
+training reads the stores back, merges histograms, and drives the SPMD
+step through the standard epoch driver.
+
+Usage:
+    python examples/multidataset/train.py
+        [--multi_model_list ANI1x,MPTrj,OC2020]
+        [--inputfile gfm_energy.json] [--preonly] [--num_epoch N] [--cpu]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+# member-dataset synthesizers: name -> loader returning GraphSamples with
+# x = [Z, pos, forces], graph energy + node forces (the GFM common schema)
+_KNOWN = ("ANI1x", "MPTrj", "OC2020", "OC2022", "qm7x")
+
+
+def _load_member(name: str, here: str, limit: int):
+    if name == "ANI1x":
+        from examples.ani1_x.ani1x_data import (generate_ani1x_dataset,
+                                                load_ani1x)
+        d = os.path.join(here, "dataset", "ani1x")
+        if not os.path.exists(os.path.join(d, "synthetic",
+                                           "ani1x-release.h5")):
+            generate_ani1x_dataset(d)
+        return load_ani1x(d, limit=limit, max_neighbours=64)
+    if name == "MPTrj":
+        from examples.mptrj.mptrj_data import (FNAME, generate_mptrj_dataset,
+                                               load_mptrj)
+        d = os.path.join(here, "dataset", "mptrj")
+        if not os.path.exists(os.path.join(d, "synthetic", FNAME)):
+            generate_mptrj_dataset(d)
+        return load_mptrj(d, limit=limit, max_neighbours=64)
+    if name == "OC2020":
+        from examples.open_catalyst_2020.oc20_data import (
+            generate_oc20_dataset, load_oc20)
+        import glob
+        d = os.path.join(here, "dataset", "oc2020")
+        if not glob.glob(os.path.join(d, "synthetic", "*.extxyz")):
+            generate_oc20_dataset(d)
+        return load_oc20(d, limit=limit, max_neighbours=64)
+    if name == "OC2022":
+        from examples.open_catalyst_2022.oc22_data import (
+            TRAJ_SUBDIR, generate_oc22_dataset, load_oc22)
+        d = os.path.join(here, "dataset", "oc2022")
+        if not os.path.exists(os.path.join(d, "synthetic", TRAJ_SUBDIR,
+                                           "train_t.txt")):
+            generate_oc22_dataset(d)
+        return load_oc22(d, limit=limit, max_neighbours=64)
+    if name == "qm7x":
+        from examples.qm7x.qm7x_data import generate_qm7x_dataset, load_qm7x
+        import glob
+        d = os.path.join(here, "dataset", "qm7x")
+        if not glob.glob(os.path.join(d, "synthetic", "*.hdf5")):
+            generate_qm7x_dataset(d)
+        # remap to the common x=[Z,pos,forces] / energy / forces schema
+        samples = load_qm7x(d, limit=limit)
+        import numpy as np
+        from hydragnn_tpu.graphs.batch import GraphSample
+        out = []
+        for s in samples:
+            forces = s.y_node[:, :3]
+            out.append(GraphSample(
+                x=np.concatenate([s.x[:, :1], s.pos, forces], axis=1),
+                pos=s.pos, senders=s.senders, receivers=s.receivers,
+                edge_attr=s.edge_attr, y_graph=s.y_graph, y_node=forces))
+        return out
+    raise ValueError(f"unknown member dataset '{name}'; known: {_KNOWN}")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--inputfile", default="gfm_energy.json",
+                   help="gfm_energy.json / gfm_forces.json / "
+                        "gfm_multitasking.json, or an HPO trial overlay")
+    p.add_argument("--multi_model_list", default="ANI1x,MPTrj,OC2020")
+    p.add_argument("--limit", type=int, default=200,
+                   help="samples per member dataset")
+    p.add_argument("--num_shards", type=int, default=None)
+    p.add_argument("--preonly", action="store_true")
+    p.add_argument("--num_epoch", type=int, default=None)
+    p.add_argument("--batch_size", type=int, default=None)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+
+    if args.cpu:
+        from examples.cli_utils import setup_cpu_devices
+        setup_cpu_devices()
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    from examples.cli_utils import load_example_config
+    config = load_example_config(here, args.inputfile,
+                                 num_epoch=args.num_epoch,
+                                 batch_size=args.batch_size)
+    train_cfg = config["NeuralNetwork"]["Training"]
+
+    import jax
+    import numpy as np
+    from hydragnn_tpu.config import (build_model_config, gather_deg,
+                                     update_config)
+    from hydragnn_tpu.datasets.gsdataset import (GraphStoreDataset,
+                                                 GraphStoreWriter)
+    from hydragnn_tpu.datasets.loader import GraphDataLoader
+    from hydragnn_tpu.models.create import create_model, init_params
+    from hydragnn_tpu.parallel.mesh import make_mesh
+    from hydragnn_tpu.parallel.multidataset import (MultiDatasetLoader,
+                                                    merge_pna_deg)
+    from hydragnn_tpu.parallel.spmd import (make_spmd_eval_step,
+                                            make_spmd_train_step)
+    from hydragnn_tpu.preprocess.load_data import split_dataset
+    from hydragnn_tpu.graphs.batch import collate
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.train_step import TrainState
+    from hydragnn_tpu.train.trainer import train_validate_test
+
+    modellist = args.multi_model_list.split(",")
+
+    # --preonly: persist each member as a GraphStore with its pna_deg
+    # (reference: per-dataset .bp files with pna_deg attrs)
+    stores = {}
+    for name in modellist:
+        gsdir = os.path.join(here, "dataset", f"{name}_gs")
+        if not os.path.isdir(gsdir):
+            samples = _load_member(name, here, args.limit)
+            w = GraphStoreWriter(
+                gsdir, attrs={"pna_deg": gather_deg(samples).tolist()})
+            w.add_all(samples)
+            w.save()
+            # derived from (possibly synthetic) member data: mark so the
+            # hermetic test purge regenerates differently-sized caches
+            from examples.common_atomistic import mark_synthetic
+            mark_synthetic(gsdir)
+        stores[name] = gsdir
+    if args.preonly:
+        print(f"wrote {len(stores)} graphstores: {sorted(stores)}")
+        return
+
+    # load members back, merge pna_deg across datasets
+    member_splits = []
+    pna_deg_list = []
+    for name in modellist:
+        ds = GraphStoreDataset(stores[name])
+        pna_deg_list.append(ds.attrs.get("pna_deg"))
+        member_splits.append(split_dataset(
+            list(ds), train_cfg["perc_train"], False))
+    merged_deg = merge_pna_deg([d for d in pna_deg_list if d is not None])
+
+    trainsets = [s[0] for s in member_splits]
+    valset = sum((list(s[1]) for s in member_splits), [])
+    testset = sum((list(s[2]) for s in member_splits), [])
+
+    all_train = sum((list(t) for t in trainsets), [])
+
+    class _WithDeg(list):
+        pass
+    train_proxy = _WithDeg(all_train)
+    train_proxy.pna_deg = merged_deg
+    config = update_config(config, train_proxy, valset, testset)
+    mcfg = build_model_config(config)
+    model = create_model(mcfg)
+
+    num_shards = args.num_shards or len(jax.devices())
+    batch_size = train_cfg["batch_size"]
+    if batch_size % num_shards != 0:
+        batch_size = num_shards * max(1, batch_size // num_shards)
+    loader = MultiDatasetLoader(trainsets, batch_size=batch_size,
+                                num_shards=num_shards)
+    val_loader = GraphDataLoader(valset, batch_size=batch_size,
+                                 num_shards=num_shards)
+    test_loader = GraphDataLoader(testset, batch_size=batch_size,
+                                  num_shards=num_shards)
+
+    init_batch = collate(all_train[:loader.graphs_per_shard],
+                         n_node=loader.n_node, n_edge=loader.n_edge,
+                         n_graph=loader.n_graph)
+    variables = init_params(model, init_batch)
+    tx = select_optimizer(train_cfg)
+    state = TrainState.create(variables, tx)
+    mesh = make_mesh((("data", num_shards),))
+    loss_name = train_cfg.get("loss_function_type", "mae")
+    train_step = make_spmd_train_step(model, mcfg, tx, mesh, loss_name)
+    eval_step = make_spmd_eval_step(model, mcfg, mesh, loss_name)
+
+    state, history = train_validate_test(
+        train_step, eval_step, state, loader, val_loader, test_loader,
+        num_epochs=train_cfg["num_epoch"], log_name="gfm_multidataset",
+        use_early_stopping=bool(train_cfg.get("EarlyStopping", False)),
+        verbosity=config.get("Verbosity", {}).get("level", 0))
+    print(json.dumps({"final_train_loss": history["train_loss"][-1],
+                      "final_val_loss": history["val_loss"][-1],
+                      "num_datasets": len(modellist),
+                      "shard_batch": batch_size}))
+
+
+if __name__ == "__main__":
+    main()

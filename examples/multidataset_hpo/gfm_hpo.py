@@ -1,0 +1,109 @@
+"""GFM multi-dataset hyperparameter-search example CLI.
+
+reference: examples/multidataset_hpo/gfm_deephyper_multi.py — DeepHyper
+CBO launching concurrent srun trials over SLURM node subsets, each trial
+a full multidataset training (gfm.py) with sampled architecture params;
+utils/hpo/deephyper.py builds the srun lines. TPU path: trials are
+subprocess launches of examples/multidataset/train.py built with
+hydragnn_tpu.utils.hpo.create_launch_command (TPU-slice pinning instead
+of srun), scored by their reported final validation loss; the search
+loop is utils.hpo.search (optuna TPE when importable, random otherwise).
+
+Usage:
+    python examples/multidataset_hpo/gfm_hpo.py [--num_trials 5]
+        [--trial_epochs 2] [--multi_model_list ANI1x,MPTrj] [--cpu]
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num_trials", type=int, default=5)
+    p.add_argument("--trial_epochs", type=int, default=2)
+    p.add_argument("--multi_model_list", default="ANI1x,MPTrj")
+    p.add_argument("--limit", type=int, default=80)
+    p.add_argument("--inputfile", default="gfm_energy.json",
+                   choices=["gfm_energy.json", "gfm_forces.json",
+                            "gfm_multitasking.json"])
+    p.add_argument("--trial_timeout", type=int, default=360,
+                   help="per-trial wall clock (s); slow trials score inf")
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(os.path.dirname(here))
+    train_script = os.path.join(repo, "examples", "multidataset",
+                                "train.py")
+
+    from hydragnn_tpu.utils.hpo import create_launch_command, search
+
+    # reference search space shape (gfm_deephyper_multi.py problem dims:
+    # conv layers, hidden dim, learning rate)
+    space = {
+        "num_conv_layers": (1, 4),
+        "hidden_dim": (16, 64),
+        "learning_rate": (1e-4, 1e-2),
+        "batch_size": [16, 32],
+    }
+
+    def objective(params):
+        # per-trial config overlay written next to the base config
+        import tempfile
+        base = json.load(open(os.path.join(
+            repo, "examples", "multidataset", args.inputfile)))
+        arch = base["NeuralNetwork"]["Architecture"]
+        arch["num_conv_layers"] = int(params["num_conv_layers"])
+        arch["hidden_dim"] = int(params["hidden_dim"])
+        tr = base["NeuralNetwork"]["Training"]
+        tr["Optimizer"]["learning_rate"] = float(params["learning_rate"])
+        fd, overlay = tempfile.mkstemp(suffix=".json", dir=os.path.join(
+            repo, "examples", "multidataset"))
+        with os.fdopen(fd, "w") as f:
+            json.dump(base, f)
+        trial_args = {
+            "inputfile": os.path.basename(overlay),
+            "multi_model_list": args.multi_model_list,
+            "limit": args.limit,
+            "num_epoch": args.trial_epochs,
+            "batch_size": int(params["batch_size"]),
+        }
+        cmd = create_launch_command(train_script, trial_args)
+        if args.cpu:
+            cmd = [c for c in cmd] + ["--cpu"]
+        # env-assignment prefixes -> env dict for subprocess
+        env = dict(os.environ)
+        while cmd and "=" in cmd[0] and not cmd[0].startswith("-"):
+            k, _, v = cmd.pop(0).partition("=")
+            env[k] = v
+        try:
+            r = subprocess.run(cmd, cwd=repo, env=env,
+                               timeout=args.trial_timeout,
+                               capture_output=True, text=True)
+            for line in reversed(r.stdout.splitlines()):
+                if line.startswith("{"):
+                    return float(json.loads(line)["final_val_loss"])
+            print(f"trial produced no result: {r.stderr[-500:]}")
+            return float("inf")
+        except (subprocess.TimeoutExpired, ValueError, KeyError) as e:
+            print(f"trial failed: {e}")
+            return float("inf")
+        finally:
+            os.unlink(overlay)
+
+    best, history = search(objective, space, num_trials=args.num_trials,
+                           log_path=os.path.join(here, "hpo_results.json"))
+    print(json.dumps({"best_params": best, "num_trials": len(history)},
+                     default=str))
+
+
+if __name__ == "__main__":
+    main()

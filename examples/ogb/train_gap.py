@@ -37,19 +37,15 @@ def main():
         setup_cpu_devices()
 
     here = os.path.dirname(os.path.abspath(__file__))
-    with open(os.path.join(here, args.inputfile)) as f:
-        config = json.load(f)
-    train_cfg = config["NeuralNetwork"]["Training"]
-    if args.num_epoch is not None:
-        train_cfg["num_epoch"] = args.num_epoch
-    if args.batch_size is not None:
-        train_cfg["batch_size"] = args.batch_size
+    from examples.cli_utils import load_example_config, split_and_train
+    config = load_example_config(here, args.inputfile,
+                                 num_epoch=args.num_epoch,
+                                 batch_size=args.batch_size)
     if args.shard_optimizer:
-        train_cfg.setdefault("Optimizer", {})["use_zero_redundancy"] = True
+        config["NeuralNetwork"]["Training"].setdefault(
+            "Optimizer", {})["use_zero_redundancy"] = True
 
     from examples.ogb.ogb_data import generate_ogb_csv, smiles_to_graphs
-    from hydragnn_tpu.preprocess.load_data import split_dataset
-    from hydragnn_tpu.run_training import run_training
 
     import glob
     datadir = os.path.join(here, "dataset")
@@ -61,10 +57,7 @@ def main():
         return
 
     samples = smiles_to_graphs(datadir, limit=args.limit)
-    splits = split_dataset(samples, train_cfg["perc_train"], False)
-    state, history, model, completed = run_training(config, datasets=splits)
-    print(json.dumps({"final_train_loss": history["train_loss"][-1],
-                      "final_val_loss": history["val_loss"][-1]}))
+    split_and_train(config, samples)
 
 
 if __name__ == "__main__":

@@ -133,12 +133,29 @@ class GraphDataLoader:
 
 
 def _stack_batches(shards: List[GraphBatch]) -> GraphBatch:
-    """Stack per-shard batches into [D, ...] arrays for shard_map."""
+    """Stack per-shard batches into [D, ...] arrays for shard_map.
+
+    Heterogeneous multi-dataset mixes may populate the PBC geometry fields
+    (edge_shifts, cells) on some shards only — absent shards get zeros,
+    which are no-ops in the edge-vector math. Any other field (labels,
+    edge_attr, ...) present on some shards but not others is a real
+    schema mismatch between member datasets and raises, because
+    zero-filling a label would silently train those shards toward 0."""
     import dataclasses
+    _ZERO_FILL_OK = ("edge_shifts", "cell")
     def stk(field):
         vals = [getattr(s, field) for s in shards]
-        if vals[0] is None:
+        present = [v for v in vals if v is not None]
+        if not present:
             return None
+        if len(present) < len(vals):
+            if field not in _ZERO_FILL_OK:
+                raise ValueError(
+                    f"member datasets disagree on field '{field}': present "
+                    f"on {len(present)}/{len(vals)} shards — all member "
+                    "datasets must share one label/feature schema")
+            proto = present[0]
+            vals = [np.zeros_like(proto) if v is None else v for v in vals]
         return np.stack(vals, axis=0)
     return GraphBatch(**{f.name: stk(f.name)
                          for f in dataclasses.fields(GraphBatch)})

@@ -130,8 +130,12 @@ def _features_from_parsed(atoms, bonds, aromatic, types, hybrid=None):
     type_idx = np.zeros((n, len(types)), np.float32)
     for i, z in enumerate(atoms):
         sym = _SYM[z]
-        if sym in types:
-            type_idx[i, list(types).index(sym)] = 1.0
+        if sym not in types:
+            # reference indexes types[atom.GetSymbol()] and lets KeyError
+            # propagate (smiles_utils.py:64); callers skip such molecules
+            raise KeyError(
+                f"atom {sym!r} not in the node-type dictionary {types}")
+        type_idx[i, list(types).index(sym)] = 1.0
     z_arr = np.asarray(atoms, np.float32)
     arom = np.asarray(aromatic, np.float32)
     # hybridization estimate: sp = triple or >=2 doubles; sp2 = aromatic or
