@@ -21,9 +21,6 @@ import sys
 
 sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
 
-REPO = os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
-
 
 def main():
     p = argparse.ArgumentParser()
@@ -58,8 +55,7 @@ def main():
     def objective(params):
         # per-trial config overlay written next to the base config
         import tempfile
-        base = json.load(open(os.path.join(
-            repo, "examples", "multidataset", args.inputfile)))
+        base = json.load(open(os.path.join(here, args.inputfile)))
         arch = base["NeuralNetwork"]["Architecture"]
         arch["num_conv_layers"] = int(params["num_conv_layers"])
         arch["hidden_dim"] = int(params["hidden_dim"])

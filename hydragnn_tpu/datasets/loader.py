@@ -66,7 +66,10 @@ class GraphDataLoader:
     def __len__(self):
         n = len(self.dataset)
         if self.drop_last:
-            return n // self.batch_size
+            # never drop down to zero batches: a dataset smaller than one
+            # batch still yields one padded batch, otherwise an epoch
+            # silently performs no updates (loss 0.0 with no error)
+            return max(n // self.batch_size, 1 if n else 0)
         return math.ceil(n / self.batch_size)
 
     def _order(self) -> np.ndarray:

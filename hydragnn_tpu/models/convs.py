@@ -164,14 +164,11 @@ class PNAConv(nn.Module):
             parts.append(nn.Dense(fin, name="rbf_encoder")(cargs["rbf"]))
         h = nn.Dense(fin, name="pre_nn")(jnp.concatenate(parts, axis=-1))
 
-        mean = seg.segment_mean(h, batch.receivers, n, batch.edge_mask)
-        mn = seg.segment_min(h, batch.receivers, n, batch.edge_mask)
-        mx = seg.segment_max(h, batch.receivers, n, batch.edge_mask)
-        sd = seg.segment_std(h, batch.receivers, n, batch.edge_mask)
+        mean, mn, mx, sd, deg = seg.pna_aggregate(
+            h, batch.receivers, n, batch.edge_mask)
         aggs = jnp.concatenate([mean, mn, mx, sd], axis=-1)      # [N, 4F]
 
         avg_lin, avg_log = pna_degree_stats(self.deg_hist)
-        deg = seg.degree(batch.receivers, n, batch.edge_mask)
         logd = jnp.log(deg + 1.0)
         amp = (logd / avg_log)[:, None]
         att = (avg_log / jnp.maximum(logd, 1e-6))[:, None]

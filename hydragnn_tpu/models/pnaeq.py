@@ -28,13 +28,9 @@ def degree_scaler_aggregation(h, recv, num_nodes, edge_mask, deg_hist,
                                        "inverse_linear")):
     """PyG DegreeScalerAggregation semantics: concat 4 aggregators, then
     concat one scaled copy per scaler."""
-    mean = seg.segment_mean(h, recv, num_nodes, edge_mask)
-    mn = seg.segment_min(h, recv, num_nodes, edge_mask)
-    mx = seg.segment_max(h, recv, num_nodes, edge_mask)
-    sd = seg.segment_std(h, recv, num_nodes, edge_mask)
+    mean, mn, mx, sd, deg = seg.pna_aggregate(h, recv, num_nodes, edge_mask)
     aggs = jnp.concatenate([mean, mn, mx, sd], axis=-1)
     avg_lin, avg_log = pna_degree_stats(deg_hist)
-    deg = seg.degree(recv, num_nodes, edge_mask)
     logd = jnp.log(deg + 1.0)
     parts = []
     for s in scalers:

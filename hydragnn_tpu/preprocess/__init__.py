@@ -1,3 +1,4 @@
 from .load_data import create_dataloaders, split_dataset, stratified_sampling
 from .transforms import (build_graph_sample, normalize_rotation,
+                         point_pair_features, spherical_coordinates,
                          update_atom_features, update_predicted_values)

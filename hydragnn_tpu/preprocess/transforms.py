@@ -121,13 +121,23 @@ def build_graph_sample(
         node_feature_matrix, graph_dims, node_dims)
 
     edge_attr = None
+    vec = pos[send] - pos[recv]
+    if shifts is not None:
+        vec = vec + shifts
     if arch.get("edge_features"):
         # edge length feature, globally normalized later
         # (reference: serialized_dataset_loader.py:127-164 Distance transform)
-        vec = pos[send] - pos[recv]
-        if shifts is not None:
-            vec = vec + shifts
         edge_attr = np.linalg.norm(vec, axis=1, keepdims=True).astype(np.float32)
+
+    # optional geometric descriptors appended to edge_attr (reference:
+    # Dataset.Descriptors SphericalCoordinates / PointPairFeatures,
+    # serialized_dataset_loader.py:70-76,167-171)
+    descriptors = ds.get("Descriptors", [])
+    if "SphericalCoordinates" in descriptors:
+        edge_attr = _append_edge_attr(edge_attr, spherical_coordinates(vec))
+    if "PointPairFeatures" in descriptors:
+        edge_attr = _append_edge_attr(
+            edge_attr, point_pair_features(pos, vec, send, recv))
 
     return GraphSample(x=x, pos=pos, senders=send, receivers=recv,
                        edge_attr=edge_attr, edge_shifts=shifts,
@@ -135,11 +145,56 @@ def build_graph_sample(
                        energy=energy, forces=forces)
 
 
+def _append_edge_attr(edge_attr, extra):
+    extra = extra.astype(np.float32)
+    if edge_attr is None:
+        return extra
+    return np.concatenate([edge_attr, extra], axis=1)
+
+
+def spherical_coordinates(vec: np.ndarray) -> np.ndarray:
+    """Per-edge spherical coordinates [rho, theta, phi] of the edge vector
+    (the torch_geometric Spherical transform the reference applies,
+    serialized_dataset_loader.py:168)."""
+    rho = np.linalg.norm(vec, axis=1)
+    theta = np.arctan2(vec[:, 1], vec[:, 0])
+    theta = theta + (theta < 0) * (2 * np.pi)
+    phi = np.arccos(np.clip(vec[:, 2] / np.maximum(rho, 1e-12), -1.0, 1.0))
+    return np.stack([rho, theta, phi], axis=1)
+
+
+def point_pair_features(pos: np.ndarray, vec: np.ndarray,
+                        send: np.ndarray, recv: np.ndarray) -> np.ndarray:
+    """Per-edge point-pair features [d, angle(n_i, d), angle(n_j, d),
+    angle(n_i, n_j)] (torch_geometric PointPairFeatures, reference
+    serialized_dataset_loader.py:171). Atomistic data carries no surface
+    normals, so the radially-outward direction from the structure centroid
+    stands in for them — rotation-invariant and well-defined for point
+    clouds."""
+    center = pos.mean(axis=0, keepdims=True)
+    normals = pos - center
+    nrm = np.linalg.norm(normals, axis=1, keepdims=True)
+    normals = normals / np.maximum(nrm, 1e-12)
+    d = np.linalg.norm(vec, axis=1)
+    unit = vec / np.maximum(d[:, None], 1e-12)
+
+    def angle(a, b):
+        return np.arccos(np.clip(np.sum(a * b, axis=1), -1.0, 1.0))
+
+    n_i = normals[recv]
+    n_j = normals[send]
+    return np.stack([d, angle(n_i, unit), angle(n_j, unit),
+                     angle(n_i, n_j)], axis=1)
+
+
 def normalize_edge_lengths(samples: Sequence[GraphSample]) -> None:
-    """Divide edge-length features by the global max
+    """Divide the edge-LENGTH column (column 0) by the global max
     (reference: serialized_dataset_loader.py:148-164; the allreduce there
     becomes a host-side max since every process sees the same data or shards
-    deterministically)."""
+    deterministically). Descriptor columns appended after the length
+    (spherical angles, point-pair features) are left unscaled, matching the
+    reference where descriptors are added after normalization
+    (serialized_dataset_loader.py:167-171)."""
     gmax = 0.0
     for s in samples:
         if s.edge_attr is not None and s.edge_attr.size:
@@ -147,4 +202,6 @@ def normalize_edge_lengths(samples: Sequence[GraphSample]) -> None:
     if gmax > 0:
         for s in samples:
             if s.edge_attr is not None:
-                s.edge_attr = (s.edge_attr / gmax).astype(np.float32)
+                s.edge_attr = s.edge_attr.copy()
+                s.edge_attr[:, 0] = (s.edge_attr[:, 0] / gmax).astype(
+                    np.float32)

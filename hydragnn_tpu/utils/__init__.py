@@ -1,0 +1,1 @@
+from .time_utils import Timer, print_timers, reset_timers

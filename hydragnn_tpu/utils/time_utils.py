@@ -1,0 +1,117 @@
+"""Aggregate region timers with cross-process min/max/avg reduction.
+
+reference: hydragnn/utils/profiling_and_tracing/time_utils.py:22-138 —
+`Timer` accumulates per-name elapsed times in class-level dicts; `stop()`
+reduces min/max/avg across ranks; `print_timers(verbosity)` prints the
+summary. TPU build: reductions run through
+jax.experimental.multihost_utils.process_allgather when more than one
+JAX process is initialized, else they are local; device sync uses value
+fetch instead of cuda synchronize.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+
+class TimerError(Exception):
+    pass
+
+
+def _allgather_scalar(value: float):
+    """All ranks' values as a list (single-process: [value])."""
+    import jax
+    if jax.process_count() <= 1:
+        return [value]
+    import numpy as np
+    from jax.experimental import multihost_utils
+    arr = multihost_utils.process_allgather(np.asarray([value]))
+    return [float(v) for v in np.asarray(arr).reshape(-1)]
+
+
+class Timer:
+    """Accumulating named timer (reference: time_utils.py:22-92)."""
+
+    timers_local: Dict[str, float] = {}
+    timers_min: Dict[str, float] = {}
+    timers_max: Dict[str, float] = {}
+    timers_avg: Dict[str, float] = {}
+    number_calls: Dict[str, int] = {}
+
+    def __init__(self, name: str):
+        self.name = name
+        self.start_time = None
+        self.elapsed_time = None
+        self.running = False
+        self.calls = 0
+        self.timers_local.setdefault(name, 0.0)
+        self.timers_min.setdefault(name, 0.0)
+        self.timers_max.setdefault(name, 0.0)
+        self.timers_avg.setdefault(name, 0.0)
+        self.number_calls.setdefault(name, 0)
+
+    def start(self):
+        if self.start_time is not None:
+            raise TimerError("Timer is running. Use .stop() to stop it")
+        self.running = True
+        self.calls += 1
+        self.start_time = time.perf_counter()
+
+    def stop(self):
+        if self.start_time is None:
+            raise TimerError("Timer is not running. Use .start() to start it")
+        self.elapsed_time = time.perf_counter() - self.start_time
+        self.start_time = None
+        vals = _allgather_scalar(self.elapsed_time)
+        self.timers_local[self.name] += self.elapsed_time
+        self.timers_min[self.name] += min(vals)
+        self.timers_max[self.name] += max(vals)
+        self.timers_avg[self.name] += sum(vals) / len(vals)
+        self.number_calls[self.name] += 1
+        self.running = False
+
+    def reset(self):
+        self.start_time = None
+        self.elapsed_time = None
+        self.running = False
+        self.calls = 0
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def print_timers(verbosity: int = 0) -> str:
+    """Summary string + print (reference: time_utils.py:95-138: rank-0
+    min/max/avg table; verbosity>=1 adds the local values)."""
+    import jax
+    rank = jax.process_index() if jax.process_count() > 1 else 0
+    lines = []
+    if rank == 0:
+        lines.append(f"{'timer':<24}{'calls':>8}{'min(s)':>12}"
+                     f"{'max(s)':>12}{'avg(s)':>12}")
+        for name in Timer.timers_avg:
+            lines.append(
+                f"{name:<24}{Timer.number_calls[name]:>8}"
+                f"{Timer.timers_min[name]:>12.4f}"
+                f"{Timer.timers_max[name]:>12.4f}"
+                f"{Timer.timers_avg[name]:>12.4f}")
+    if verbosity >= 1:
+        for name, v in Timer.timers_local.items():
+            lines.append(f"rank {rank} {name}: {v:.4f}s")
+    out = "\n".join(lines)
+    if out:
+        print(out)
+    return out
+
+
+def reset_timers():
+    Timer.timers_local.clear()
+    Timer.timers_min.clear()
+    Timer.timers_max.clear()
+    Timer.timers_avg.clear()
+    Timer.number_calls.clear()

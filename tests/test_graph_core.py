@@ -123,3 +123,75 @@ class TestRadiusGraph:
         np.fill_diagonal(adj, False)
         r2, s2 = np.nonzero(adj)
         assert set(zip(s1.tolist(), r1.tolist())) == set(zip(s2.tolist(), r2.tolist()))
+
+
+def test_timer_aggregation():
+    """Timer accumulates per-name min/max/avg (reference: time_utils.py)."""
+    import time as _time
+    from hydragnn_tpu.utils.time_utils import Timer, print_timers, reset_timers
+    reset_timers()
+    t = Timer("unit")
+    for _ in range(3):
+        t.start()
+        _time.sleep(0.01)
+        t.stop()
+    assert Timer.number_calls["unit"] >= 3
+    assert Timer.timers_local["unit"] >= 0.03
+    assert Timer.timers_min["unit"] <= Timer.timers_max["unit"] + 1e-9
+    out = print_timers()
+    assert "unit" in out
+    reset_timers()
+
+
+def test_descriptor_transforms():
+    """Spherical + PointPair descriptors append edge columns and are
+    rotation-equivariant/invariant as appropriate."""
+    import numpy as np
+    from hydragnn_tpu.preprocess.transforms import (point_pair_features,
+                                                    spherical_coordinates)
+    rng = np.random.RandomState(0)
+    pos = rng.rand(10, 3).astype(np.float32) * 4
+    send = np.repeat(np.arange(10), 3)
+    recv = (send + rng.randint(1, 10, 30)) % 10
+    vec = pos[send] - pos[recv]
+    sph = spherical_coordinates(vec)
+    assert sph.shape == (30, 3)
+    np.testing.assert_allclose(sph[:, 0], np.linalg.norm(vec, axis=1),
+                               rtol=1e-5)
+    assert np.all(sph[:, 1] >= 0) and np.all(sph[:, 1] <= 2 * np.pi)
+    ppf = point_pair_features(pos, vec, send, recv)
+    assert ppf.shape == (30, 4)
+    # PPF is rotation invariant (normals from the centroid co-rotate)
+    theta = 0.7
+    R = np.array([[np.cos(theta), -np.sin(theta), 0],
+                  [np.sin(theta), np.cos(theta), 0],
+                  [0, 0, 1]], np.float32)
+    pos_r = pos @ R.T
+    vec_r = pos_r[send] - pos_r[recv]
+    ppf_r = point_pair_features(pos_r, vec_r, send, recv)
+    np.testing.assert_allclose(ppf, ppf_r, atol=1e-4)
+
+
+def test_build_graph_sample_with_descriptors():
+    import numpy as np
+    from hydragnn_tpu.preprocess.transforms import build_graph_sample
+    rng = np.random.RandomState(1)
+    nf = rng.rand(12, 2).astype(np.float32)
+    pos = rng.rand(12, 3).astype(np.float32) * 3
+    cfg = {
+        "Dataset": {
+            "node_features": {"dim": [1, 1], "column_index": [0, 1]},
+            "graph_features": {"dim": [], "column_index": []},
+            "Descriptors": ["SphericalCoordinates", "PointPairFeatures"],
+        },
+        "NeuralNetwork": {
+            "Architecture": {"radius": 2.5, "max_neighbours": 10,
+                             "edge_features": ["lengths"]},
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "type": ["node"], "output_index": [1]},
+        },
+    }
+    s = build_graph_sample(nf, pos, cfg)
+    # 1 length + 3 spherical + 4 ppf columns
+    assert s.edge_attr.shape[1] == 8
