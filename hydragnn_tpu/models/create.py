@@ -88,6 +88,31 @@ def _replace(cfg: ModelConfig, **kw) -> ModelConfig:
 
 def init_params(model: BaseStack, sample_batch, seed: int = 0):
     """Initialize parameter pytree (reference seeds torch.manual_seed(0) at
-    create.py:123; we use an explicit PRNGKey)."""
+    create.py:123; we use an explicit PRNGKey). Applies the UQ
+    `initial_bias` to every head's final Dense bias
+    (reference: Base.py:145-150)."""
     variables = model.init(jax.random.PRNGKey(seed), sample_batch, train=False)
+    bias0 = getattr(model.cfg, "initial_bias", None)
+    if bias0 is not None:
+        import jax.numpy as jnp
+        from flax.core import unfreeze
+        params = unfreeze(variables["params"])
+
+        def set_final_bias(tree):
+            dense_keys = sorted(
+                (k for k in tree if k.startswith("dense_")),
+                key=lambda k: int(k.split("_")[-1]))
+            if dense_keys:
+                last = tree[dense_keys[-1]]
+                if "bias" in last:
+                    last["bias"] = jnp.full_like(last["bias"], float(bias0))
+            for k, v in tree.items():
+                if isinstance(v, dict) and not k.startswith("dense_"):
+                    set_final_bias(v)
+
+        for key in params:
+            if key.startswith("head_"):
+                set_final_bias(params[key])
+        variables = dict(variables)
+        variables["params"] = params
     return variables

@@ -32,7 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..config.config import ModelConfig
 from ..graphs.batch import GraphBatch
 from ..train.loss import energy_force_loss, multihead_loss
-from ..train.train_step import TrainState
+from ..train.train_step import TrainState, freeze_conv_grads
 
 
 def _batch_spec(batch: GraphBatch):
@@ -86,7 +86,7 @@ def make_spmd_train_step(model, cfg: ModelConfig,
             lambda a: None if a is None else a[0], batch)
         grads_fn = jax.value_and_grad(loss_fn, has_aux=True)
         (_, (new_bs, metrics)), grads = grads_fn(params, batch_stats, local)
-        grads = jax.lax.pmean(grads, "data")
+        grads = freeze_conv_grads(jax.lax.pmean(grads, "data"), cfg)
         metrics = jax.lax.pmean(metrics, "data")
         # cross-replica BatchNorm running stats (SyncBatchNorm semantics)
         new_bs = jax.lax.pmean(new_bs, "data")
@@ -95,6 +95,7 @@ def make_spmd_train_step(model, cfg: ModelConfig,
     def per_device(params, batch_stats, opt_state, batch: GraphBatch):
         grads, new_bs, metrics = grads_per_device(params, batch_stats, batch)
         updates, new_opt = tx.update(grads, opt_state, params)
+        updates = freeze_conv_grads(updates, cfg)
         new_params = optax.apply_updates(params, updates)
         return new_params, new_bs, new_opt, metrics
 
@@ -117,6 +118,7 @@ def make_spmd_train_step(model, cfg: ModelConfig,
             opt_state = jax.lax.with_sharding_constraint(
                 state.opt_state, opt_spec)
             updates, new_opt = tx.update(grads, opt_state, state.params)
+            updates = freeze_conv_grads(updates, cfg)
             new_opt = jax.lax.with_sharding_constraint(new_opt, opt_spec)
             new_params = optax.apply_updates(state.params, updates)
             return state.replace(params=new_params, batch_stats=new_bs,

@@ -7,6 +7,7 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
+import os
 import jax
 import numpy as np
 
@@ -78,4 +79,18 @@ def run_prediction(config_or_path, datasets: Optional[Tuple] = None,
     voi = config["NeuralNetwork"]["Variables_of_interest"]
     if voi.get("denormalize_output"):
         trues, preds = output_denormalize(voi["y_minmax"], trues, preds)
+
+    # per-head true/pred pickle dump (reference: HYDRAGNN_DUMP_TESTDATA,
+    # train_validate_test.py:640-703 writes rank-local test-data pickles)
+    from .utils.envflags import env_flag
+    if env_flag("HYDRAGNN_DUMP_TESTDATA"):
+        import pickle
+        log_name = get_log_name_config(config)
+        dump_dir = os.path.join("./logs", log_name)
+        os.makedirs(dump_dir, exist_ok=True)
+        names = voi.get("output_names",
+                        [f"head_{i}" for i in range(len(trues))])
+        with open(os.path.join(dump_dir, "test_data.pk"), "wb") as f:
+            pickle.dump({name: {"true": t, "pred": p}
+                         for name, t, p in zip(names, trues, preds)}, f)
     return trues, preds

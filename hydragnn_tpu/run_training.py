@@ -24,7 +24,7 @@ from .train.train_step import TrainState, make_eval_step, make_train_step
 from .train.trainer import train_validate_test
 from .utils import profiling as tr
 from .utils.checkpoint import save_model
-from .utils.print_utils import setup_log
+from .utils.print_utils import print_peak_memory, setup_log
 
 
 def _load_datasets_from_config(config):
@@ -154,4 +154,5 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     if train_cfg.get("Checkpoint", False):
         save_model(state, log_name)
     tr.print_timers(os.path.join("./logs", log_name))
+    print_peak_memory(verbosity)
     return state, history, model, config

@@ -36,6 +36,38 @@ class TrainState(struct.PyTreeNode):
                    step=jnp.zeros((), jnp.int32))
 
 
+def freeze_conv_grads(grads, cfg: ModelConfig):
+    """Zero the gradients/updates of the conv stack + feature-norm layers
+    when `freeze_conv_layers` is set — the transfer-learning freeze
+    (reference: Base.py:139-143 sets requires_grad=False on graph_convs and
+    feature_layers). Must be applied to the optimizer UPDATES as well as
+    the gradients: decoupled weight decay (AdamW) moves parameters even
+    for zero gradients."""
+    if not getattr(cfg, "freeze_conv", False):
+        return grads
+    from flax.core import unfreeze
+    num_conv = int(getattr(cfg, "num_conv_layers", 0))
+
+    def is_encoder(key: str) -> bool:
+        # encoder stack = conv_0..conv_{L-1} + feature_norm_*; node-head
+        # convs are named conv_{L + 100*head + layer} (base.py make_conv)
+        # and must stay trainable
+        if key.startswith("feature_norm_"):
+            return True
+        if key.startswith("conv_"):
+            try:
+                return int(key.split("_")[-1]) < num_conv
+            except ValueError:
+                return False
+        return False
+
+    grads = unfreeze(grads)
+    for key in grads:
+        if is_encoder(key):
+            grads[key] = jax.tree_util.tree_map(jnp.zeros_like, grads[key])
+    return grads
+
+
 def make_train_step(model, cfg: ModelConfig, tx: optax.GradientTransformation,
                     loss_name: str = "mse", compute_grad_energy: bool = False,
                     energy_weight: float = 1.0, force_weight: float = 1.0,
@@ -74,7 +106,9 @@ def make_train_step(model, cfg: ModelConfig, tx: optax.GradientTransformation,
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
         (_, (new_bs, metrics)), grads = grad_fn(
             state.params, state.batch_stats, batch)
+        grads = freeze_conv_grads(grads, cfg)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        updates = freeze_conv_grads(updates, cfg)
         new_params = optax.apply_updates(state.params, updates)
         new_state = state.replace(params=new_params, batch_stats=new_bs,
                                   opt_state=new_opt, step=state.step + 1)

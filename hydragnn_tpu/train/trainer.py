@@ -124,6 +124,13 @@ def train_validate_test(
                                        "test_loss": [], "lr": []}
     best_state, best_val = None, float("inf")
 
+    # env-flag layer (reference: HYDRAGNN_MAX_NUM_BATCH caps batches/epoch
+    # for scaling runs, train_validate_test.py:39-49; HYDRAGNN_VALTEST
+    # disables the val/test passes, :177)
+    from ..utils.envflags import env_flag, env_int
+    max_num_batch = env_int("HYDRAGNN_MAX_NUM_BATCH")
+    run_valtest = env_flag("HYDRAGNN_VALTEST", default=True)
+
     for epoch in range(num_epochs):
         train_loader.set_epoch(epoch)
         # ---- train pass (reference: train, :449-565) ----
@@ -135,11 +142,18 @@ def train_validate_test(
                     state, metrics = train_step(state, batch)
                 tot += float(metrics["loss"])
                 nb += 1
+                if max_num_batch is not None and nb >= max_num_batch:
+                    break
         train_loss = tot / max(nb, 1)
 
         # ---- val/test passes ----
-        val_loss = _eval_epoch(eval_step, state, val_loader, tr, "validate")
-        test_loss = _eval_epoch(eval_step, state, test_loader, tr, "test")
+        if run_valtest:
+            val_loss = _eval_epoch(eval_step, state, val_loader, tr,
+                                   "validate")
+            test_loss = _eval_epoch(eval_step, state, test_loader, tr,
+                                    "test")
+        else:
+            val_loss = test_loss = float("nan")
 
         if keep_best and val_loss == val_loss and val_loss < best_val:
             best_val = val_loss
@@ -148,12 +162,15 @@ def train_validate_test(
         # ---- LR plateau schedule ----
         if supports_lr_schedule(state.opt_state):
             lr = get_learning_rate(state.opt_state)
-            new_lr = plateau.step(val_loss, lr)
-            if new_lr != lr:
-                set_learning_rate(state.opt_state, new_lr)
-                print_distributed(verbosity, 1,
-                                  f"reducing lr {lr:.2e} -> {new_lr:.2e}")
-            lr = new_lr
+            # plateau decisions need a real val loss (HYDRAGNN_VALTEST=0
+            # suppresses it); the current LR is still reported either way
+            if val_loss == val_loss:
+                new_lr = plateau.step(val_loss, lr)
+                if new_lr != lr:
+                    set_learning_rate(state.opt_state, new_lr)
+                    print_distributed(verbosity, 1,
+                                      f"reducing lr {lr:.2e} -> {new_lr:.2e}")
+                lr = new_lr
         else:
             lr = float("nan")
 
@@ -168,9 +185,10 @@ def train_validate_test(
         log(f"epoch {epoch}: train {train_loss:.5f} val {val_loss:.5f} "
             f"test {test_loss:.5f} lr {lr:.2e}")
 
-        if checkpoint_fn is not None and gate.should_save(epoch, val_loss):
+        if (checkpoint_fn is not None and val_loss == val_loss
+                and gate.should_save(epoch, val_loss)):
             checkpoint_fn(state, epoch, val_loss)
-        if early is not None and early(val_loss):
+        if early is not None and val_loss == val_loss and early(val_loss):
             print_distributed(verbosity, 1, f"early stop at epoch {epoch}")
             break
         if not _walltime_remaining_guard(walltime_deadline):

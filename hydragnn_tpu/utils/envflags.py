@@ -1,0 +1,24 @@
+"""Uniform parsing for the HYDRAGNN_* env-flag layer
+(reference: the flags enumerated at SURVEY.md §5.6 /
+hydragnn distributed.py:126-141, train_validate_test.py:46,177,475,640)."""
+from __future__ import annotations
+
+import os
+
+_FALSY = ("", "0", "false", "no", "off")
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Boolean env flag: unset -> default; '0'/'false'/'no'/'off' (any
+    case) -> False; anything else -> True."""
+    val = os.getenv(name)
+    if val is None:
+        return default
+    return val.strip().lower() not in _FALSY
+
+
+def env_int(name: str, default=None):
+    val = os.getenv(name)
+    if val is None or not val.strip():
+        return default
+    return int(val)

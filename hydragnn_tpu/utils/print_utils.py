@@ -38,6 +38,23 @@ def iterate_tqdm(iterable: Iterable, verbosity: int, level: int = 2, **kw):
     return iterable
 
 
+def print_peak_memory(verbosity: int, prefix: str = "") -> None:
+    """Device peak-memory report (reference: print_peak_memory via
+    torch.cuda.max_memory_allocated, utils/distributed/distributed.py:
+    291-298; TPU path reads jax device memory_stats)."""
+    import jax
+    for d in jax.local_devices():
+        stats = getattr(d, "memory_stats", lambda: None)()
+        if not stats:
+            continue
+        peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0))
+        limit = stats.get("bytes_limit", 0)
+        print_distributed(
+            verbosity, 1,
+            f"{prefix}{d}: peak memory {peak / 2**20:.1f} MiB"
+            + (f" / {limit / 2**20:.1f} MiB" if limit else ""))
+
+
 def setup_log(name: str, log_dir: str = "./logs") -> logging.Logger:
     """File + console logger per run dir (reference: print_utils.py:63-91)."""
     global _LOGGER

@@ -90,3 +90,103 @@ def test_zero_opt_matches_replicated():
     for a, b in zip(leaves0, leaves1):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_freeze_conv_layers():
+    """freeze_conv_layers keeps conv + feature-norm params fixed while
+    heads train (reference: Base.py:139-143 transfer-learning freeze)."""
+    import jax
+    samples = deterministic_graph_dataset(num_configs=48)
+    splits = split_dataset(samples, 0.7)
+    cfg = make_config("GIN")
+    cfg["NeuralNetwork"]["Architecture"]["freeze_conv_layers"] = True
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 3
+    cfg["NeuralNetwork"]["Training"]["EarlyStopping"] = False
+    cfg["NeuralNetwork"]["Training"]["keep_best"] = False
+    state, hist, model, completed = run_training(cfg, datasets=splits,
+                                                 num_shards=1)
+    from hydragnn_tpu.config import build_model_config, update_config
+    from hydragnn_tpu.models.create import create_model, init_params
+    from hydragnn_tpu.graphs.batch import collate
+    init_vars = init_params(create_model(build_model_config(completed)),
+                            collate(samples[:4]))
+    for key in state.params:
+        a = jax.tree_util.tree_leaves(state.params[key])
+        b = jax.tree_util.tree_leaves(init_vars["params"][key])
+        same = all(np.allclose(np.asarray(x), np.asarray(y))
+                   for x, y in zip(a, b))
+        if key.startswith(("conv_", "feature_norm_")):
+            assert same, f"{key} changed despite freeze"
+        elif key.startswith("head_") or key == "graph_shared":
+            assert not same, f"{key} did not train"
+
+
+def test_initial_bias_applied():
+    """initial_bias sets every head's final Dense bias (Base.py:145-150)."""
+    from hydragnn_tpu.config import build_model_config, update_config
+    from hydragnn_tpu.models.create import create_model, init_params
+    from hydragnn_tpu.graphs.batch import collate
+    samples = deterministic_graph_dataset(num_configs=8,
+                                          heads=("graph", "node"))
+    cfg = make_config("GIN", heads=("graph", "node"))
+    cfg["NeuralNetwork"]["Architecture"]["initial_bias"] = 2.5
+    cfg = update_config(cfg, samples)
+    model = create_model(build_model_config(cfg))
+    v = init_params(model, collate(samples[:4]))
+    p = v["params"]
+    assert np.allclose(np.asarray(p["head_0"]["dense_2"]["bias"]), 2.5)
+    assert np.allclose(np.asarray(p["head_1"]["MLP_0"]["dense_2"]["bias"]),
+                       2.5)
+    # non-final biases untouched
+    assert not np.allclose(np.asarray(p["head_0"]["dense_0"]["bias"]), 2.5)
+
+
+def test_env_flag_max_num_batch_and_valtest(monkeypatch):
+    """HYDRAGNN_MAX_NUM_BATCH caps batches/epoch; HYDRAGNN_VALTEST=0 skips
+    the eval passes (reference: train_validate_test.py:39-49,177)."""
+    samples = deterministic_graph_dataset(num_configs=64)
+    splits = split_dataset(samples, 0.7)
+    cfg = make_config("GIN")
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 2
+    cfg["NeuralNetwork"]["Training"]["EarlyStopping"] = False
+    monkeypatch.setenv("HYDRAGNN_MAX_NUM_BATCH", "1")
+    monkeypatch.setenv("HYDRAGNN_VALTEST", "0")
+    state, hist, _, _ = run_training(cfg, datasets=splits, num_shards=1)
+    assert len(hist["train_loss"]) == 2
+    assert all(np.isnan(v) for v in hist["val_loss"])
+
+
+def test_freeze_conv_leaves_conv_node_head_trainable():
+    """freeze_conv_layers must not freeze conv-type NODE HEADS — only the
+    encoder stack (reference Base.py:139-143 freezes graph_convs +
+    feature_layers; head convs stay trainable)."""
+    import jax
+    samples = deterministic_graph_dataset(num_configs=48, heads=("node",))
+    splits = split_dataset(samples, 0.7)
+    cfg = make_config("GIN", heads=("node",))
+    cfg["NeuralNetwork"]["Architecture"]["output_heads"]["node"]["type"] = \
+        "conv"
+    cfg["NeuralNetwork"]["Architecture"]["freeze_conv_layers"] = True
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 3
+    cfg["NeuralNetwork"]["Training"]["EarlyStopping"] = False
+    state, hist, model, completed = run_training(cfg, datasets=splits,
+                                                 num_shards=1)
+    from hydragnn_tpu.config import build_model_config
+    from hydragnn_tpu.models.create import create_model, init_params
+    from hydragnn_tpu.graphs.batch import collate
+    init_vars = init_params(create_model(build_model_config(completed)),
+                            collate(samples[:4]))
+    ncl = completed["NeuralNetwork"]["Architecture"]["num_conv_layers"]
+    trained_any_head_conv = False
+    for key in state.params:
+        a = jax.tree_util.tree_leaves(state.params[key])
+        b = jax.tree_util.tree_leaves(init_vars["params"][key])
+        same = all(np.allclose(np.asarray(x), np.asarray(y))
+                   for x, y in zip(a, b))
+        if key.startswith("conv_"):
+            idx = int(key.split("_")[-1])
+            if idx < ncl:
+                assert same, f"encoder {key} changed despite freeze"
+            else:
+                trained_any_head_conv = trained_any_head_conv or not same
+    assert trained_any_head_conv, "conv node head was frozen too"
