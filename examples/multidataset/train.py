@@ -196,11 +196,13 @@ def main():
     train_step = make_spmd_train_step(model, mcfg, tx, mesh, loss_name)
     eval_step = make_spmd_eval_step(model, mcfg, mesh, loss_name)
 
+    from hydragnn_tpu.parallel.mesh import shard_batch
     state, history = train_validate_test(
         train_step, eval_step, state, loader, val_loader, test_loader,
         num_epochs=train_cfg["num_epoch"], log_name="gfm_multidataset",
         use_early_stopping=bool(train_cfg.get("EarlyStopping", False)),
-        verbosity=config.get("Verbosity", {}).get("level", 0))
+        verbosity=config.get("Verbosity", {}).get("level", 0),
+        place_fn=lambda b: shard_batch(b, mesh))
     print(json.dumps({"final_train_loss": history["train_loss"][-1],
                       "final_val_loss": history["val_loss"][-1],
                       "num_datasets": len(modellist),

@@ -135,6 +135,35 @@ class GraphDataLoader:
             yield _stack_batches(shards)
 
 
+def prefetch_to_device(iterator, size: int = 2, place_fn=None):
+    """Double-buffered device prefetch: enqueue `size` batches ahead so the
+    host->device copy of batch k+1 overlaps the compute of batch k (the
+    DataLoader worker/pin-memory overlap of the reference's HydraDataLoader,
+    preprocess/load_data.py:93-203, expressed as async dispatch).
+
+    `place_fn` customizes placement (e.g. mesh-sharded via
+    parallel.mesh.shard_batch); default = jax.device_put to the default
+    device."""
+    import collections
+
+    import jax
+    place = place_fn or (lambda b: jax.tree_util.tree_map(
+        lambda a: None if a is None else jax.device_put(a), b))
+    queue = collections.deque()
+    it = iter(iterator)
+    try:
+        for _ in range(size):
+            queue.append(place(next(it)))
+    except StopIteration:
+        pass
+    while queue:
+        yield queue.popleft()
+        try:
+            queue.append(place(next(it)))
+        except StopIteration:
+            continue
+
+
 def _stack_batches(shards: List[GraphBatch]) -> GraphBatch:
     """Stack per-shard batches into [D, ...] arrays for shard_map.
 

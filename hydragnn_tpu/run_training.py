@@ -143,13 +143,20 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     if train_cfg.get("Checkpoint", False):
         ckpt_fn = lambda s, e, v: save_model(s, log_name)
 
+    if num_shards > 1:
+        from .parallel.mesh import shard_batch
+        place_fn = lambda b: shard_batch(b, mesh)
+    else:
+        place_fn = lambda b: jax.tree_util.tree_map(
+            lambda a: None if a is None else jax.device_put(a), b)
     state, history = train_validate_test(
         train_step, eval_step, state, train_loader, val_loader, test_loader,
         num_epochs=int(train_cfg["num_epoch"]), log_name=log_name,
         patience=int(train_cfg.get("patience", 10)),
         use_early_stopping=bool(train_cfg.get("EarlyStopping", False)),
         checkpoint_warmup=int(train_cfg.get("checkpoint_warmup", 0)),
-        checkpoint_fn=ckpt_fn, verbosity=verbosity, tracer=tr.get())
+        checkpoint_fn=ckpt_fn, verbosity=verbosity, tracer=tr.get(),
+        place_fn=place_fn)
 
     if train_cfg.get("Checkpoint", False):
         save_model(state, log_name)

@@ -16,6 +16,7 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 import numpy as np
 
+from ..datasets.loader import prefetch_to_device
 from ..utils.print_utils import iterate_tqdm, log, print_distributed
 from ..utils.profiling import Tracer
 from .optimizer import (get_learning_rate, set_learning_rate,
@@ -109,6 +110,7 @@ def train_validate_test(
     verbosity: int = 0,
     tracer: Optional[Tracer] = None,
     keep_best: bool = True,
+    place_fn: Optional[Callable] = None,
 ):
     """Returns (final_state, history dict). With `keep_best` the returned
     state is the best-validation one (mirrors the reference's best-val
@@ -136,8 +138,14 @@ def train_validate_test(
         # ---- train pass (reference: train, :449-565) ----
         tot, nb = 0.0, 0
         with tr.timer("train_epoch"):
-            for batch in iterate_tqdm(train_loader, verbosity,
-                                      desc=f"epoch {epoch} train"):
+            # double-buffered device prefetch only when the caller supplies
+            # a placement (meshes need mesh-aware sharding; committing to a
+            # single device would break multi-device shard_map steps)
+            stream = (prefetch_to_device(train_loader, place_fn=place_fn)
+                      if place_fn is not None else train_loader)
+            for batch in iterate_tqdm(stream, verbosity,
+                                      desc=f"epoch {epoch} train",
+                                      total=len(train_loader)):
                 with tr.timer("train_step"):
                     state, metrics = train_step(state, batch)
                 tot += float(metrics["loss"])

@@ -1,0 +1,50 @@
+"""Checkpoint save -> reload -> predict consistency
+(reference: tests/test_model_loadpred.py — train, save, reload via
+load_existing_model, verify predictions match)."""
+import os
+
+import numpy as np
+
+from hydragnn_tpu.preprocess.load_data import split_dataset
+from hydragnn_tpu.run_prediction import run_prediction
+from hydragnn_tpu.run_training import run_training
+from hydragnn_tpu.utils.checkpoint import load_existing_model, save_model
+
+from tests.deterministic_data import deterministic_graph_dataset
+from tests.utils import make_config
+
+
+def test_checkpoint_roundtrip_predict(tmp_path):
+    samples = deterministic_graph_dataset(num_configs=64,
+                                          heads=("graph", "node"))
+    splits = split_dataset(samples, 0.7)
+    cfg = make_config("PNA", heads=("graph", "node"))
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 8
+    cfg["NeuralNetwork"]["Training"]["EarlyStopping"] = False
+    cfg["Verbosity"] = {"level": 0}
+    state, hist, model, completed = run_training(cfg, datasets=splits)
+
+    log_name = "loadpred_test"
+    save_model(state, log_name, path=str(tmp_path))
+    restored = load_existing_model(state, log_name, path=str(tmp_path))
+    assert restored is not None
+    assert int(restored.step) == int(state.step)
+
+    t0, p0 = run_prediction(completed, datasets=splits, state=state,
+                            model=model)
+    t1, p1 = run_prediction(completed, datasets=splits, state=restored,
+                            model=model)
+    for a, b in zip(p0, p1):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+    for a, b in zip(t0, t1):
+        np.testing.assert_allclose(a, b)
+
+
+def test_load_missing_returns_none(tmp_path):
+    samples = deterministic_graph_dataset(num_configs=16)
+    cfg = make_config("GIN")
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 1
+    splits = split_dataset(samples, 0.7)
+    state, _, _, _ = run_training(cfg, datasets=splits)
+    assert load_existing_model(state, "no_such_run",
+                               path=str(tmp_path)) is None
