@@ -69,8 +69,11 @@ def main():
     variables = init_params(model, batch)
     tx = select_optimizer(cfg["NeuralNetwork"]["Training"])
     state = TrainState.create(variables, tx)
+    # f32 compute: this workload is gather/scatter (HBM) bound, so bf16
+    # mixed precision (compute_dtype="bfloat16") measures within noise of f32
     train_step = make_train_step(model, mcfg, tx, loss_name="mae",
-                                 compute_grad_energy=True, donate=False)
+                                 compute_grad_energy=True, donate=False,
+                                 compute_dtype="float32")
 
     # warmup/compile (value fetch, not block_until_ready — the axon tunnel's
     # block_until_ready returns before remote execution finishes)

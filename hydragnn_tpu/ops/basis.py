@@ -59,11 +59,21 @@ def gaussian_basis_mace(d, cutoff: float, num_basis: int = 8):
 
 
 def chebyshev_basis(d, cutoff: float, num_basis: int = 8):
-    """MACE ChebychevBasis: T_n(2d/c - 1) for n = 1..num_basis."""
+    """MACE ChebychevBasis: T_n(2d/c - 1) for n = 1..num_basis.
+
+    Uses the T_{n+1} = 2x T_n - T_{n-1} recurrence rather than
+    cos(n*arccos(x)): arccos has an infinite derivative at x = +-1, which
+    poisons force gradients for edges at d = 0 or d = cutoff; the
+    polynomial recurrence is smooth everywhere.
+    """
     x = jnp.clip(2.0 * d / cutoff - 1.0, -1.0, 1.0)
-    theta = jnp.arccos(x)
-    n = jnp.arange(1, num_basis + 1, dtype=d.dtype)
-    return jnp.cos(n * theta[..., None])
+    t_prev = jnp.ones_like(x)  # T_0
+    t_cur = x                  # T_1
+    out = [t_cur]
+    for _ in range(num_basis - 1):
+        t_prev, t_cur = t_cur, 2.0 * x * t_cur - t_prev
+        out.append(t_cur)
+    return jnp.stack(out, axis=-1)
 
 
 def cosine_cutoff(d, cutoff: float):

@@ -68,38 +68,72 @@ def freeze_conv_grads(grads, cfg: ModelConfig):
     return grads
 
 
+def _cast_floats(tree, dtype):
+    """Cast every floating-point leaf to `dtype`; ints/bools untouched."""
+    def cast(a):
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating):
+            return a.astype(dtype)
+        return a
+    return jax.tree_util.tree_map(cast, tree)
+
+
+def _resolve_compute_dtype(cfg: ModelConfig, compute_dtype):
+    """bf16 mixed precision: params/opt-state/losses stay f32, model compute
+    runs in bfloat16 (MXU-native). Selected by Architecture.dtype or the
+    explicit `compute_dtype` argument."""
+    name = compute_dtype or getattr(cfg, "dtype", None) or "float32"
+    return jnp.dtype(name)
+
+
 def make_train_step(model, cfg: ModelConfig, tx: optax.GradientTransformation,
                     loss_name: str = "mse", compute_grad_energy: bool = False,
                     energy_weight: float = 1.0, force_weight: float = 1.0,
-                    donate: bool = True):
+                    donate: bool = True, compute_dtype: Optional[str] = None):
     """Build the jitted SPMD train step.
 
     `compute_grad_energy` selects the energy-force path
     (reference: Training.compute_grad_energy, train_validate_test.py:515-521).
     """
+    cdtype = _resolve_compute_dtype(cfg, compute_dtype)
+    mixed = cdtype != jnp.float32
 
     def loss_fn(params, batch_stats, batch: GraphBatch):
+        orig_batch_stats = batch_stats
+        if mixed:
+            params = _cast_floats(params, cdtype)
+            batch_stats = _cast_floats(batch_stats, cdtype)
         variables = {"params": params, "batch_stats": batch_stats}
         if compute_grad_energy:
             def apply_fn(v, b, train):
+                if mixed:
+                    b = _cast_floats(b, cdtype)
                 out, mut = model.apply(
                     v, b, train=train, mutable=["batch_stats"])
-                return out
+                # losses/pooling accumulate in f32 regardless of compute dtype
+                return jax.tree_util.tree_map(
+                    lambda o: o.astype(jnp.float32), out)
             total, aux = energy_force_loss(
                 apply_fn, variables, cfg, batch, loss_name,
                 energy_weight, force_weight, train=True)
             # batch_stats not updated on E-F path (identity feature layers
             # for the equivariant stacks that support it)
-            return total, (batch_stats, {"loss": total, **{
+            return total, (orig_batch_stats, {"loss": total, **{
                 k: v for k, v in aux.items() if v.ndim == 0}})
         outputs_and_var, mutated = model.apply(
-            variables, batch, train=True, mutable=["batch_stats"])
+            variables, _cast_floats(batch, cdtype) if mixed else batch,
+            train=True, mutable=["batch_stats"])
         outputs, outputs_var = outputs_and_var
+        if mixed:
+            outputs = _cast_floats(outputs, jnp.float32)
+            outputs_var = _cast_floats(outputs_var, jnp.float32)
         total, tasks = multihead_loss(cfg, loss_name, outputs, outputs_var, batch)
         metrics = {"loss": total}
         for i, t in enumerate(tasks):
             metrics[f"task_{i}"] = t
-        return total, (mutated["batch_stats"], metrics)
+        new_bs = mutated["batch_stats"]
+        if mixed:  # running statistics must not degrade to bf16 across epochs
+            new_bs = _cast_floats(new_bs, jnp.float32)
+        return total, (new_bs, metrics)
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_step(state: TrainState, batch: GraphBatch):
@@ -119,16 +153,25 @@ def make_train_step(model, cfg: ModelConfig, tx: optax.GradientTransformation,
 
 def make_eval_step(model, cfg: ModelConfig, loss_name: str = "mse",
                    compute_grad_energy: bool = False,
-                   energy_weight: float = 1.0, force_weight: float = 1.0):
+                   energy_weight: float = 1.0, force_weight: float = 1.0,
+                   compute_dtype: Optional[str] = None):
     """Jitted validation/test step returning (metrics, outputs)
     (reference: validate/test, train_validate_test.py:568-746)."""
+    cdtype = _resolve_compute_dtype(cfg, compute_dtype)
+    mixed = cdtype != jnp.float32
 
     @jax.jit
     def eval_step(state: TrainState, batch: GraphBatch):
         variables = {"params": state.params, "batch_stats": state.batch_stats}
+        if mixed:
+            variables = _cast_floats(variables, cdtype)
         if compute_grad_energy:
             def apply_fn(v, b, train):
-                return model.apply(v, b, train=train)
+                if mixed:
+                    b = _cast_floats(b, cdtype)
+                out = model.apply(v, b, train=train)
+                return jax.tree_util.tree_map(
+                    lambda o: o.astype(jnp.float32), out)
             total, aux = energy_force_loss(
                 apply_fn, variables, cfg, batch, loss_name,
                 energy_weight, force_weight, train=False)
@@ -136,7 +179,12 @@ def make_eval_step(model, cfg: ModelConfig, loss_name: str = "mse",
                        "energy_loss": aux["energy_loss"],
                        "force_loss": aux["force_loss"]}
             return metrics, [aux["energy_pred"], aux["forces_pred"]]
-        outputs, outputs_var = model.apply(variables, batch, train=False)
+        outputs, outputs_var = model.apply(
+            variables, _cast_floats(batch, cdtype) if mixed else batch,
+            train=False)
+        if mixed:
+            outputs = _cast_floats(outputs, jnp.float32)
+            outputs_var = _cast_floats(outputs_var, jnp.float32)
         total, tasks = multihead_loss(cfg, loss_name, outputs, outputs_var, batch)
         metrics = {"loss": total}
         for i, t in enumerate(tasks):

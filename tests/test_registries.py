@@ -1,0 +1,151 @@
+"""Registry sweeps + small-subsystem tests mirroring the reference's
+test_loss_and_activation_functions.py, test_optimizer.py,
+test_radial_transforms.py, test_enthalpy.py, test_atomicdescriptors.py.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hydragnn_tpu.ops.activations import (ACTIVATIONS, LOSSES,
+                                          activation_function_selection,
+                                          loss_function_selection,
+                                          masked_loss)
+from hydragnn_tpu.ops.basis import DISTANCE_TRANSFORMS, RADIAL_BASES
+
+
+@pytest.mark.parametrize("name", sorted(ACTIVATIONS))
+def test_activation_registry(name):
+    fn = activation_function_selection(name)
+    x = jnp.linspace(-2.0, 2.0, 11)
+    y = fn(x)
+    assert y.shape == x.shape
+    assert bool(jnp.all(jnp.isfinite(y)))
+    # every registered activation must be differentiable under jit
+    g = jax.jit(jax.grad(lambda v: jnp.sum(fn(v))))(x)
+    assert bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_activation_unknown_raises():
+    with pytest.raises(ValueError):
+        activation_function_selection("nope")
+
+
+@pytest.mark.parametrize("name", sorted(LOSSES))
+def test_loss_registry(name):
+    rng = np.random.RandomState(0)
+    pred = jnp.asarray(rng.randn(16, 3).astype(np.float32))
+    target = jnp.asarray(rng.randn(16, 3).astype(np.float32))
+    fn = loss_function_selection(name)
+    if name == "GaussianNLLLoss":
+        val = fn(pred, target, var=jnp.ones_like(pred))
+    else:
+        val = fn(pred, target)
+        # zero at pred == target
+        assert float(fn(pred, pred)) == pytest.approx(0.0, abs=1e-6)
+    assert np.isfinite(float(val))
+
+
+@pytest.mark.parametrize("name", ["mse", "mae", "rmse", "smooth_l1"])
+def test_masked_loss_ignores_padding(name):
+    rng = np.random.RandomState(1)
+    pred = jnp.asarray(rng.randn(8, 2).astype(np.float32))
+    target = jnp.asarray(rng.randn(8, 2).astype(np.float32))
+    mask = jnp.asarray([True] * 5 + [False] * 3)
+    # corrupt padded rows wildly; masked loss must not change
+    pred_bad = pred.at[5:].set(1e6)
+    a = float(masked_loss(name, pred, target, mask))
+    b = float(masked_loss(name, pred_bad, target, mask))
+    assert a == pytest.approx(b, rel=1e-6)
+
+
+@pytest.mark.parametrize("radial", sorted(RADIAL_BASES))
+@pytest.mark.parametrize("transform", sorted(DISTANCE_TRANSFORMS))
+def test_radial_transform_combinations(radial, transform):
+    """Every MACE radial basis x distance transform must be finite, smooth,
+    and differentiable (reference: tests/test_radial_transforms.py)."""
+    d = jnp.linspace(0.05, 4.9, 64)
+    cutoff = 5.0
+
+    def embed(dd):
+        t = DISTANCE_TRANSFORMS[transform](dd)
+        return RADIAL_BASES[radial](t, cutoff, 8)
+
+    out = embed(d)
+    assert out.shape == (64, 8)
+    assert bool(jnp.all(jnp.isfinite(out)))
+    g = jax.grad(lambda v: jnp.sum(embed(v)))(d)
+    assert bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_distance_transforms_shape():
+    # Soft is monotone increasing; Agnesi is a decreasing soft-inverse warp
+    # (MACE radial.py:151) — both must be strictly monotone and bounded.
+    d = jnp.linspace(0.05, 4.9, 200)
+    soft = DISTANCE_TRANSFORMS["Soft"](d)
+    assert bool(jnp.all(jnp.diff(soft) > 0))
+    agnesi = DISTANCE_TRANSFORMS["Agnesi"](d)
+    assert bool(jnp.all(jnp.diff(agnesi) < 0))
+    assert bool(jnp.all((agnesi > 0) & (agnesi <= 1.0)))
+
+
+@pytest.mark.parametrize("opt_name", ["SGD", "Adam", "Adadelta", "Adagrad",
+                                      "Adamax", "AdamW", "RMSprop",
+                                      "FusedLAMB"])
+def test_optimizer_registry_step(opt_name):
+    """Every optimizer must init + apply on a param pytree and support
+    runtime LR adjustment (reference: tests/test_optimizer.py)."""
+    from hydragnn_tpu.train.optimizer import (get_learning_rate,
+                                              select_optimizer,
+                                              set_learning_rate)
+    tx = select_optimizer({"Optimizer": {"type": opt_name,
+                                         "learning_rate": 1e-2}})
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    state = tx.init(params)
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    updates, state = tx.update(grads, state, params)
+    new_params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+    assert float(jnp.sum(jnp.abs(new_params["w"] - params["w"]))) > 0
+    assert get_learning_rate(state) == pytest.approx(1e-2)
+    state = set_learning_rate(state, 5e-3)
+    assert get_learning_rate(state) == pytest.approx(5e-3)
+
+
+def test_optimizer_unknown_raises():
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    with pytest.raises(ValueError):
+        select_optimizer({"Optimizer": {"type": "Lion9000"}})
+
+
+def test_formation_energy_conversion():
+    """reference: tests/test_enthalpy.py — total energy minus pure-element
+    references."""
+    from hydragnn_tpu.graphs.batch import GraphSample
+    from hydragnn_tpu.utils.lsms import convert_total_energy_to_formation_energy
+    x = np.asarray([[0.0], [1.0], [1.0]], np.float32)  # types 0,1,1
+    s = GraphSample(x=x, pos=np.zeros((3, 3), np.float32),
+                    senders=np.zeros(0, np.int32),
+                    receivers=np.zeros(0, np.int32),
+                    y_graph=np.asarray([-10.0], np.float32),
+                    y_node=None)
+    convert_total_energy_to_formation_energy([s], {0: -2.0, 1: -3.0})
+    # -10 - (-2 + -3 + -3) = -2
+    assert float(s.y_graph[0]) == pytest.approx(-2.0)
+
+
+def test_atomicdescriptors_shapes_and_values():
+    """reference: tests/test_atomicdescriptors.py."""
+    from hydragnn_tpu.utils.atomicdescriptors import get_atomicdescriptors
+    z = [1, 6, 8, 26, 79]  # H C O Fe Au
+    feats = get_atomicdescriptors(z)
+    assert feats.shape[0] == 5
+    # one-hot block: exactly one hot per row at z-1
+    oh = feats[:, :118]
+    assert np.array_equal(np.argmax(oh, axis=1), np.asarray(z) - 1)
+    assert np.all(oh.sum(axis=1) == 1.0)
+    # remaining descriptors finite and bounded
+    rest = feats[:, 118:]
+    assert np.all(np.isfinite(rest))
+    assert np.all(np.abs(rest) <= 5.0)
+    # distinct elements get distinct descriptor rows
+    assert len({tuple(row) for row in feats.tolist()}) == 5

@@ -42,6 +42,16 @@ def test_train_pna_multihead():
     assert rmse[0] < 0.3 and rmse[1] < 0.3, f"PNA RMSE {rmse}"
 
 
+def test_train_bfloat16_compute():
+    """Architecture.dtype="bfloat16" selects the mixed-precision compute
+    path: model compute in bf16 (MXU-native), params/losses/batch-stats in
+    f32. Must still converge on the deterministic dataset."""
+    rmse, history = _train_and_rmse("PNA", num_epochs=60, dtype="bfloat16")
+    assert history["train_loss"][-1] < history["train_loss"][0]
+    assert rmse[0] < 0.35, f"bf16 PNA RMSE {rmse[0]} above threshold"
+    assert all(np.isfinite(v) for v in history["train_loss"])
+
+
 def test_spmd_matches_single_device():
     """8-way shard_map DP training must track single-device training."""
     samples = deterministic_graph_dataset(num_configs=64)
