@@ -10,6 +10,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Runs on whatever jax.devices() provides (the real TPU chip under the driver).
 """
 import json
+import os
 import time
 
 import numpy as np
@@ -66,6 +67,11 @@ def main():
     n_edge = BATCH_GRAPHS * NODES_PER_GRAPH * DEG + 8
     batch = collate(samples, n_node=n_node, n_edge=n_edge,
                     n_graph=BATCH_GRAPHS + 1)
+    if os.environ.get("BENCH_NBR", "1") != "0":
+        # dense neighbor-list layout: PNA aggregation becomes [N, K, F]
+        # axis reductions with zero scatters
+        from hydragnn_tpu.graphs.batch import with_neighbor_format
+        batch = with_neighbor_format(batch)
     variables = init_params(model, batch)
     tx = select_optimizer(cfg["NeuralNetwork"]["Training"])
     state = TrainState.create(variables, tx)

@@ -37,6 +37,8 @@ class GraphDataLoader:
         n_edge_per_shard: Optional[int] = None,
         bucket: Optional[BucketSpec] = None,
         batch_transform=None,
+        neighbor_format: bool = False,
+        neighbor_k: Optional[int] = None,
     ):
         assert batch_size % num_shards == 0 or num_shards == 1, (
             f"batch_size {batch_size} must divide evenly over {num_shards} shards")
@@ -59,6 +61,12 @@ class GraphDataLoader:
         self.n_edge = n_edge_per_shard
         self.n_graph = self.graphs_per_shard + 1
         self.batch_transform = batch_transform
+        # dense neighbor-list layout: K is pinned ONCE from dataset-level
+        # max in-degree so every batch shares one [N, K] shape (one compile)
+        self.neighbor_k = None
+        if neighbor_format:
+            from ..graphs.batch import neighbor_budget_for_dataset
+            self.neighbor_k = neighbor_k or neighbor_budget_for_dataset(dataset)
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
@@ -83,6 +91,11 @@ class GraphDataLoader:
         b = self._collate_shard_raw(samples)
         if self.batch_transform is not None:
             b = self._apply_transform(b, samples)
+        # after batch_transform: a transform may rewire/prune edges, and the
+        # neighbor tables must describe the edge set the model actually sees
+        if self.neighbor_k is not None:
+            from ..graphs.batch import with_neighbor_format
+            b = with_neighbor_format(b, k=self.neighbor_k)
         return b
 
     def _apply_transform(self, b: GraphBatch, samples) -> GraphBatch:

@@ -113,6 +113,31 @@ def pna_aggregate(data, segment_ids, num_segments, mask=None, eps=1e-5):
     return mean, mn, mx, std, cnt[..., 0]
 
 
+def neighbor_aggregate(h, nbr_mask, eps=1e-5):
+    """PNA statistics over the dense neighbor-list layout
+    (graphs.batch.with_neighbor_format): h is [N, K, F] per-slot messages,
+    nbr_mask [N, K]. Pure axis reductions — no scatter, no segment ids —
+    the layout of choice on TPU for bounded-degree radius graphs.
+
+    Returns (mean, min, max, std, degree), matching `pna_aggregate`.
+    """
+    m = nbr_mask[:, :, None]
+    cnt = jnp.sum(nbr_mask.astype(h.dtype), axis=1)
+    cnt_safe = jnp.maximum(cnt, 1.0)[:, None]
+    hm = jnp.where(m, h, 0.0)
+    s = jnp.sum(hm, axis=1)
+    sq = jnp.sum(hm * hm, axis=1)
+    mean = s / cnt_safe
+    var = jnp.maximum(sq / cnt_safe - mean * mean, 0.0)
+    std = jnp.sqrt(var + eps)
+    big = jnp.asarray(jnp.finfo(h.dtype).max, h.dtype)
+    mn = jnp.min(jnp.where(m, h, big), axis=1)
+    mn = jnp.where(cnt[:, None] > 0, mn, 0.0)
+    mx = jnp.max(jnp.where(m, h, -big), axis=1)
+    mx = jnp.where(cnt[:, None] > 0, mx, 0.0)
+    return mean, mn, mx, std, cnt
+
+
 def segment_softmax(logits, segment_ids, num_segments, mask=None):
     """Numerically-stable softmax within segments (GAT attention,
     reference: torch_geometric GATConv used at hydragnn/models/GATStack.py:29)."""

@@ -185,10 +185,10 @@ def ring_aggregate(message_fn: Callable, x_block: jnp.ndarray,
     agg0 = jnp.zeros((block, probe.shape[-1]), probe.dtype)
     # the carry accumulator is device-varying (it sums varying messages);
     # mark the literal zeros as such or scan's carry typecheck rejects it
-    try:
+    if hasattr(lax, "pcast"):
+        agg0 = lax.pcast(agg0, (axis_name,), to="varying")
+    elif hasattr(lax, "pvary"):
         agg0 = lax.pvary(agg0, (axis_name,))
-    except AttributeError:
-        pass
     if edge_attr_buckets is None:
         xs = (buckets.send_local, buckets.recv_local, buckets.mask)
     else:
