@@ -63,21 +63,27 @@ def create_dataloaders(trainset, valset, testset, batch_size: int,
                        num_shards: int = 1, seed: int = 0,
                        n_node_per_shard: Optional[int] = None,
                        n_edge_per_shard: Optional[int] = None,
-                       batch_transform=None):
+                       batch_transform=None, neighbor_format: bool = False):
     """reference: load_data.py:225-296 — DataLoader + DistributedSampler;
     here one static-shape loader per split, all sharing the max padded shape
     so train/val/test reuse one compiled program."""
+    all_samples = list(trainset) + list(valset) + list(testset)
     if n_node_per_shard is None or n_edge_per_shard is None:
-        all_samples = list(trainset) + list(valset) + list(testset)
         g = max(batch_size // num_shards, 1)
         from ..graphs.batch import BucketSpec
         b = BucketSpec(multiple=64)
         n_node_per_shard = b.bucket(max(s.num_nodes for s in all_samples) * g + 1)
         n_edge_per_shard = b.bucket(max(s.num_edges for s in all_samples) * g + 1)
+    neighbor_k = None
+    if neighbor_format:
+        # one K for all three splits so they share one compiled program
+        from ..graphs.batch import neighbor_budget_for_dataset
+        neighbor_k = neighbor_budget_for_dataset(all_samples)
     mk = lambda ds, shuffle: GraphDataLoader(
         ds, batch_size, shuffle=shuffle, seed=seed, num_shards=num_shards,
         n_node_per_shard=n_node_per_shard, n_edge_per_shard=n_edge_per_shard,
-        drop_last=shuffle, batch_transform=batch_transform)
+        drop_last=shuffle, batch_transform=batch_transform,
+        neighbor_format=neighbor_format, neighbor_k=neighbor_k)
     return mk(trainset, True), mk(valset, False), mk(testset, False)
 
 

@@ -100,9 +100,19 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         nn["Architecture"]["model_type"], trainset + valset + testset,
         max(batch_size // max(num_shards, 1), 1))
 
+    # dense neighbor-list layout (zero-scatter aggregation): default-on for
+    # the PNA family, whose convs consume it when present; K pinned across
+    # splits by create_dataloaders. Architecture.neighbor_format or
+    # HYDRAGNN_NEIGHBOR_FORMAT overrides.
+    from .utils.envflags import env_flag
+    nbr_fmt = nn["Architecture"].get(
+        "neighbor_format",
+        nn["Architecture"]["model_type"] in ("PNA", "PNAPlus"))
+    nbr_fmt = env_flag("HYDRAGNN_NEIGHBOR_FORMAT", bool(nbr_fmt))
+
     train_loader, val_loader, test_loader = create_dataloaders(
         trainset, valset, testset, batch_size, num_shards=num_shards,
-        batch_transform=batch_transform)
+        batch_transform=batch_transform, neighbor_format=nbr_fmt)
 
     mcfg = build_model_config(config)
     model = create_model(mcfg)

@@ -200,3 +200,29 @@ def test_freeze_conv_leaves_conv_node_head_trainable():
             else:
                 trained_any_head_conv = trained_any_head_conv or not same
     assert trained_any_head_conv, "conv node head was frozen too"
+
+
+def test_neighbor_format_wired_through_loaders(monkeypatch):
+    """PNA-family training defaults to the dense neighbor-list layout with
+    one K pinned across splits (single compiled shape);
+    HYDRAGNN_NEIGHBOR_FORMAT=0 opts out."""
+    from hydragnn_tpu.preprocess.load_data import create_dataloaders
+
+    samples = deterministic_graph_dataset(num_configs=24)
+    tr, va, te = samples[:16], samples[16:20], samples[20:]
+    loaders = create_dataloaders(tr, va, te, batch_size=8,
+                                 neighbor_format=True)
+    ks = {ld.neighbor_k for ld in loaders}
+    assert len(ks) == 1 and None not in ks
+    batch = next(iter(loaders[0]))
+    assert batch.nbr is not None and batch.nbr.shape[1] == ks.pop()
+
+    cfg = make_config("PNA", heads=("graph",))
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 2
+    state, history, _, _ = run_training(cfg, datasets=(tr, va, te),
+                                        num_shards=1)
+    assert all(np.isfinite(v) for v in history["train_loss"])
+
+    monkeypatch.setenv("HYDRAGNN_NEIGHBOR_FORMAT", "0")
+    loaders_off = create_dataloaders(tr, va, te, batch_size=8)
+    assert next(iter(loaders_off[0])).nbr is None
