@@ -1,9 +1,10 @@
 """Visualizer — parity plots, error histograms, training history curves.
 
 reference: hydragnn/postprocess/visualizer.py:24-742 (Visualizer class:
-create_scatter_plots :692, plot_history :629, error histograms, per-node
-vector plots). Matplotlib is optional in this image; all methods degrade to
-writing the underlying data as .npz next to where the plot would go, so the
+create_scatter_plots :692, create_plot_global :722, plot_history :629,
+create_parity_plot_vector :467, error histograms :281,387, num_nodes_plot
+:734). Matplotlib is optional in this image; all methods degrade to writing
+the underlying data as .npz next to where the plot would go, so the
 artifacts exist either way.
 """
 from __future__ import annotations
@@ -29,22 +30,46 @@ class Visualizer:
 
     def __init__(self, model_with_config_name: str, node_feature: Optional[list] = None,
                  num_heads: int = 1, head_dims: Optional[Sequence[int]] = None,
+                 num_nodes_list: Optional[Sequence[int]] = None,
                  plot_dir: str = "./logs"):
         self.name = model_with_config_name
         self.outdir = os.path.join(plot_dir, model_with_config_name,
                                    "postprocess")
         os.makedirs(self.outdir, exist_ok=True)
+        self.node_feature = node_feature
         self.num_heads = num_heads
         self.head_dims = head_dims or [1] * num_heads
+        self.num_nodes_list = list(num_nodes_list or [])
 
+    # -- dataset structure ------------------------------------------------
+    def num_nodes_plot(self):
+        """Histogram of graph sizes in the test set (reference: :734-742)."""
+        counts = np.asarray(self.num_nodes_list)
+        base = os.path.join(self.outdir, "num_nodes")
+        np.savez(base + ".npz", num_nodes=counts)
+        plt = _plt()
+        if plt is None or counts.size == 0:
+            return
+        fig, ax = plt.subplots(figsize=(5, 4))
+        ax.hist(counts, bins=min(50, max(int(counts.max() - counts.min()), 1)))
+        ax.set_xlabel("nodes per graph")
+        ax.set_ylabel("count")
+        fig.tight_layout()
+        fig.savefig(base + ".png", dpi=120)
+        plt.close(fig)
+
+    # -- parity -----------------------------------------------------------
     def create_scatter_plots(self, trues: List[np.ndarray],
                              preds: List[np.ndarray],
-                             output_names: Optional[Sequence[str]] = None):
-        """Parity scatter per head (reference: :692)."""
+                             output_names: Optional[Sequence[str]] = None,
+                             iepoch: Optional[int] = None):
+        """Parity scatter per head (reference: :692-720; iepoch=-1 tags the
+        initial-solution plots, run_training.py:119-125)."""
+        suffix = "" if iepoch is None else f"_epoch{iepoch}"
         plt = _plt()
         for ih, (t, p) in enumerate(zip(trues, preds)):
             name = (output_names[ih] if output_names else f"head{ih}")
-            base = os.path.join(self.outdir, f"parity_{name}")
+            base = os.path.join(self.outdir, f"parity_{name}{suffix}")
             np.savez(base + ".npz", true=t, pred=p)
             if plt is None:
                 continue
@@ -61,9 +86,37 @@ class Visualizer:
             fig.savefig(base + ".png", dpi=120)
             plt.close(fig)
 
+    def create_parity_plot_vector(self, true: np.ndarray, pred: np.ndarray,
+                                  name: str = "vector"):
+        """Per-component parity for a vector-valued head
+        (reference: create_parity_plot_vector :467-516)."""
+        t = np.asarray(true).reshape(len(true), -1)
+        p = np.asarray(pred).reshape(len(pred), -1)
+        dim = t.shape[1]
+        base = os.path.join(self.outdir, f"parity_vector_{name}")
+        np.savez(base + ".npz", true=t, pred=p)
+        plt = _plt()
+        if plt is None:
+            return
+        fig, axes = plt.subplots(1, dim, figsize=(4 * dim, 4), squeeze=False)
+        for d in range(dim):
+            ax = axes[0, d]
+            ax.scatter(t[:, d], p[:, d], s=4, alpha=0.5)
+            lo, hi = min(t[:, d].min(), p[:, d].min()), max(t[:, d].max(), p[:, d].max())
+            ax.plot([lo, hi], [lo, hi], "k--", lw=1)
+            ax.set_title(f"{name}[{d}]")
+            ax.set_xlabel("true")
+            if d == 0:
+                ax.set_ylabel("predicted")
+        fig.tight_layout()
+        fig.savefig(base + ".png", dpi=120)
+        plt.close(fig)
+
+    # -- errors -----------------------------------------------------------
     def create_error_histograms(self, trues: List[np.ndarray],
                                 preds: List[np.ndarray],
                                 output_names: Optional[Sequence[str]] = None):
+        """reference: create_parity_plot_and_error_histogram_scalar :281."""
         plt = _plt()
         for ih, (t, p) in enumerate(zip(trues, preds)):
             name = (output_names[ih] if output_names else f"head{ih}")
@@ -79,14 +132,60 @@ class Visualizer:
             fig.savefig(base + ".png", dpi=120)
             plt.close(fig)
 
+    def create_plot_global(self, trues: List[np.ndarray],
+                           preds: List[np.ndarray],
+                           output_names: Optional[Sequence[str]] = None):
+        """One summary figure over all heads: parity density + conditional
+        mean absolute error vs true value (reference: create_plot_global
+        :722 and the __hist2d_contour/__err_condmean machinery :83-105)."""
+        nh = len(trues)
+        stats = {}
+        for ih, (t, p) in enumerate(zip(trues, preds)):
+            name = (output_names[ih] if output_names else f"head{ih}")
+            t1, p1 = t.reshape(-1), p.reshape(-1)
+            centers, condmean = _err_condmean(t1, p1)
+            stats[f"{name}_bin_centers"] = centers
+            stats[f"{name}_cond_mae"] = condmean
+        base = os.path.join(self.outdir, "global_analysis")
+        np.savez(base + ".npz", **stats)
+        plt = _plt()
+        if plt is None:
+            return
+        fig, axes = plt.subplots(2, nh, figsize=(4.5 * nh, 8), squeeze=False)
+        for ih, (t, p) in enumerate(zip(trues, preds)):
+            name = (output_names[ih] if output_names else f"head{ih}")
+            t1, p1 = t.reshape(-1), p.reshape(-1)
+            ax = axes[0, ih]
+            # density parity (the hist2d-contour of the reference)
+            ax.hist2d(t1, p1, bins=60, cmin=1)
+            lo, hi = min(t1.min(), p1.min()), max(t1.max(), p1.max())
+            ax.plot([lo, hi], [lo, hi], "k--", lw=1)
+            ax.set_title(name)
+            ax.set_xlabel("true")
+            ax.set_ylabel("predicted")
+            ax2 = axes[1, ih]
+            centers, condmean = _err_condmean(t1, p1)
+            ax2.plot(centers, condmean)
+            ax2.set_xlabel("true")
+            ax2.set_ylabel("mean |error|")
+        fig.tight_layout()
+        fig.savefig(base + ".png", dpi=120)
+        plt.close(fig)
+
+    # -- history ----------------------------------------------------------
     def plot_history(self, history: Dict[str, List[float]]):
-        """Loss-history curves (reference: plot_history :629)."""
+        """Loss-history curves, total + per-task
+        (reference: plot_history :629-690)."""
         plt = _plt()
         base = os.path.join(self.outdir, "history")
         np.savez(base + ".npz", **{k: np.asarray(v) for k, v in history.items()})
         if plt is None:
             return
-        fig, ax = plt.subplots(figsize=(6, 4))
+        task_keys = sorted(k for k in history if k.startswith("task_"))
+        ncols = 2 if task_keys else 1
+        fig, axes = plt.subplots(1, ncols, figsize=(6 * ncols, 4),
+                                 squeeze=False)
+        ax = axes[0, 0]
         for key in ("train_loss", "val_loss", "test_loss"):
             if key in history:
                 ax.plot(history[key], label=key)
@@ -94,6 +193,30 @@ class Visualizer:
         ax.set_ylabel("loss")
         ax.set_yscale("log")
         ax.legend()
+        if task_keys:
+            ax2 = axes[0, 1]
+            for key in task_keys:
+                ax2.plot(history[key], label=key)
+            ax2.set_xlabel("epoch")
+            ax2.set_ylabel("per-task loss")
+            ax2.set_yscale("log")
+            ax2.legend()
         fig.tight_layout()
         fig.savefig(base + ".png", dpi=120)
         plt.close(fig)
+
+
+def _err_condmean(true1d: np.ndarray, pred1d: np.ndarray, nbins: int = 40):
+    """Mean |error| conditioned on binned true value
+    (reference: __err_condmean, visualizer.py:93-105)."""
+    err = np.abs(pred1d - true1d)
+    lo, hi = float(true1d.min()), float(true1d.max())
+    if hi <= lo:
+        return np.asarray([lo]), np.asarray([float(err.mean())])
+    edges = np.linspace(lo, hi, nbins + 1)
+    which = np.clip(np.digitize(true1d, edges) - 1, 0, nbins - 1)
+    sums = np.bincount(which, weights=err, minlength=nbins)
+    cnts = np.bincount(which, minlength=nbins)
+    keep = cnts > 0
+    centers = 0.5 * (edges[:-1] + edges[1:])
+    return centers[keep], sums[keep] / cnts[keep]

@@ -79,6 +79,7 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     trainset = list(trainset)
     valset = list(valset)
     testset = list(testset)
+    datasets = (trainset, valset, testset)
 
     config = update_config(config, trainset, valset, testset)
     log_name = get_log_name_config(config)
@@ -153,6 +154,29 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     if train_cfg.get("Checkpoint", False):
         ckpt_fn = lambda s, e, v: save_model(s, log_name)
 
+    # visualization wiring (reference: run_training.py:76-78 reads the
+    # Visualization section; train_validate_test.py:100-125,264-311 builds
+    # the Visualizer, initial-solution scatter, and final plots)
+    viz_cfg = config.get("Visualization", {})
+    create_plots = bool(viz_cfg.get("create_plots", False))
+    visualizer = None
+    if create_plots:
+        from .postprocess.visualizer import Visualizer
+        from .run_prediction import run_prediction
+        voi = nn["Variables_of_interest"]
+        out_names = voi.get("output_names",
+                            [f"head_{i}" for i in range(len(mcfg.heads))])
+        visualizer = Visualizer(
+            log_name, num_heads=len(mcfg.heads),
+            head_dims=[h.output_dim for h in mcfg.heads],
+            num_nodes_list=[s.num_nodes for s in testset])
+        visualizer.num_nodes_plot()
+        if viz_cfg.get("plot_init_solution", False):
+            t0, p0 = run_prediction(config, datasets=datasets, state=state,
+                                    model=model)
+            visualizer.create_scatter_plots(t0, p0, output_names=out_names,
+                                            iepoch=-1)
+
     if num_shards > 1:
         from .parallel.mesh import shard_batch
         place_fn = lambda b: shard_batch(b, mesh)
@@ -170,6 +194,24 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
 
     if train_cfg.get("Checkpoint", False):
         save_model(state, log_name)
+
+    if visualizer is not None:
+        # final test-set predictions -> parity/global/error plots + history
+        # (reference: train_validate_test.py:264-311, rank-0 only — here the
+        # single-controller program is already rank-0-equivalent)
+        trues, preds = run_prediction(config, datasets=datasets, state=state,
+                                      model=model)
+        visualizer.create_plot_global(trues, preds, output_names=out_names)
+        visualizer.create_scatter_plots(trues, preds, output_names=out_names)
+        visualizer.create_error_histograms(trues, preds,
+                                           output_names=out_names)
+        for ih, head in enumerate(mcfg.heads):
+            if head.output_dim > 1:
+                visualizer.create_parity_plot_vector(
+                    trues[ih].reshape(-1, head.output_dim),
+                    preds[ih].reshape(-1, head.output_dim),
+                    name=out_names[ih])
+        visualizer.plot_history(history)
     tr.print_timers(os.path.join("./logs", log_name))
     print_peak_memory(verbosity)
     return state, history, model, config

@@ -1,0 +1,60 @@
+"""Visualization wiring: Visualization.create_plots produces the reference's
+artifact set under logs/<run>/postprocess/ (reference:
+hydragnn/train/train_validate_test.py:100-125,264-311 and
+postprocess/visualizer.py)."""
+import glob
+import os
+
+import numpy as np
+
+from hydragnn_tpu.postprocess.visualizer import Visualizer, _err_condmean
+from hydragnn_tpu.run_training import run_training
+from hydragnn_tpu.config import get_log_name_config
+
+from tests.deterministic_data import deterministic_graph_dataset
+from tests.utils import make_config
+
+
+def test_visualizer_artifacts(tmp_path):
+    viz = Visualizer("testrun", num_heads=2, head_dims=[1, 1],
+                     num_nodes_list=[4, 8, 8, 16], plot_dir=str(tmp_path))
+    trues = [np.random.randn(64, 1), np.random.randn(200, 1)]
+    preds = [t + 0.1 * np.random.randn(*t.shape) for t in trues]
+    viz.num_nodes_plot()
+    viz.create_scatter_plots(trues, preds, output_names=["e", "f"])
+    viz.create_scatter_plots(trues, preds, output_names=["e", "f"], iepoch=-1)
+    viz.create_error_histograms(trues, preds, output_names=["e", "f"])
+    viz.create_plot_global(trues, preds, output_names=["e", "f"])
+    viz.create_parity_plot_vector(np.random.randn(40, 3),
+                                  np.random.randn(40, 3), name="forces")
+    viz.plot_history({"train_loss": [1.0, 0.5], "val_loss": [1.1, 0.6],
+                      "task_0": [0.9, 0.4]})
+    out = os.path.join(str(tmp_path), "testrun", "postprocess")
+    for stem in ("num_nodes", "parity_e", "parity_f", "parity_e_epoch-1",
+                 "errorhist_e", "global_analysis", "parity_vector_forces",
+                 "history"):
+        assert os.path.exists(os.path.join(out, stem + ".npz")), stem
+        assert os.path.exists(os.path.join(out, stem + ".png")), stem
+
+
+def test_err_condmean_bins():
+    t = np.linspace(0, 1, 1000)
+    p = t + 0.5  # constant error
+    centers, condmean = _err_condmean(t, p)
+    assert np.allclose(condmean, 0.5)
+    assert centers[0] >= 0 and centers[-1] <= 1
+
+
+def test_run_training_creates_plots():
+    samples = deterministic_graph_dataset(num_configs=32)
+    tr, va, te = samples[:24], samples[24:28], samples[28:]
+    cfg = make_config("GIN", heads=("graph",))
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 2
+    cfg["Visualization"] = {"create_plots": True, "plot_init_solution": True}
+    state, history, model, completed = run_training(
+        cfg, datasets=(tr, va, te), num_shards=1)
+    out = os.path.join("./logs", get_log_name_config(completed), "postprocess")
+    assert glob.glob(os.path.join(out, "parity_*_epoch-1.npz")), "init plots"
+    for stem in ("num_nodes", "global_analysis", "history"):
+        assert os.path.exists(os.path.join(out, stem + ".npz")), stem
+    assert glob.glob(os.path.join(out, "parity_*.png"))
