@@ -183,6 +183,14 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     else:
         place_fn = lambda b: jax.tree_util.tree_map(
             lambda a: None if a is None else jax.device_put(a), b)
+    # epoch-targeted device profiling (reference: `Profile` config section,
+    # run_training via train_validate_test.py:128-130; profile.py:32-42)
+    profiler = None
+    if "Profile" in config:
+        from .utils.profiling import Profiler
+        profiler = Profiler(os.path.join("./logs", log_name))
+        profiler.setup(config["Profile"])
+
     state, history = train_validate_test(
         train_step, eval_step, state, train_loader, val_loader, test_loader,
         num_epochs=int(train_cfg["num_epoch"]), log_name=log_name,
@@ -190,7 +198,7 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         use_early_stopping=bool(train_cfg.get("EarlyStopping", False)),
         checkpoint_warmup=int(train_cfg.get("checkpoint_warmup", 0)),
         checkpoint_fn=ckpt_fn, verbosity=verbosity, tracer=tr.get(),
-        place_fn=place_fn)
+        place_fn=place_fn, profiler=profiler)
 
     if train_cfg.get("Checkpoint", False):
         save_model(state, log_name)

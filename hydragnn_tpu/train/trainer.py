@@ -111,6 +111,7 @@ def train_validate_test(
     tracer: Optional[Tracer] = None,
     keep_best: bool = True,
     place_fn: Optional[Callable] = None,
+    profiler=None,
 ):
     """Returns (final_state, history dict). With `keep_best` the returned
     state is the best-validation one (mirrors the reference's best-val
@@ -133,11 +134,16 @@ def train_validate_test(
     max_num_batch = env_int("HYDRAGNN_MAX_NUM_BATCH")
     run_valtest = env_flag("HYDRAGNN_VALTEST", default=True)
 
+    from ..utils.profiling import Profiler
+    profiler = profiler or Profiler(run_dir, enable=False)
+
     for epoch in range(num_epochs):
         train_loader.set_epoch(epoch)
+        profiler.set_current_epoch(epoch)
         # ---- train pass (reference: train, :449-565) ----
         tot, nb = 0.0, 0
-        with tr.timer("train_epoch"):
+        task_tot: Dict[str, float] = {}
+        with tr.timer("train_epoch"), profiler:
             # double-buffered device prefetch only when the caller supplies
             # a placement (meshes need mesh-aware sharding; committing to a
             # single device would break multi-device shard_map steps)
@@ -149,6 +155,9 @@ def train_validate_test(
                 with tr.timer("train_step"):
                     state, metrics = train_step(state, batch)
                 tot += float(metrics["loss"])
+                for k, v in metrics.items():
+                    if k.startswith("task_") or k.endswith("_loss"):
+                        task_tot[k] = task_tot.get(k, 0.0) + float(v)
                 nb += 1
                 if max_num_batch is not None and nb >= max_num_batch:
                     break
@@ -186,10 +195,16 @@ def train_validate_test(
         history["val_loss"].append(val_loss)
         history["test_loss"].append(test_loss)
         history["lr"].append(lr)
+        # per-task / per-component losses (reference: TensorBoard scalars
+        # per epoch total + per task, train_validate_test.py:196-203)
+        for k, v in task_tot.items():
+            history.setdefault(k, []).append(v / max(nb, 1))
         if tb is not None:
             tb.add_scalar("train/loss", train_loss, epoch)
             tb.add_scalar("val/loss", val_loss, epoch)
             tb.add_scalar("test/loss", test_loss, epoch)
+            for k, v in task_tot.items():
+                tb.add_scalar(f"train/{k}", v / max(nb, 1), epoch)
         log(f"epoch {epoch}: train {train_loss:.5f} val {val_loss:.5f} "
             f"test {test_loss:.5f} lr {lr:.2e}")
 

@@ -144,3 +144,46 @@ def device_profile(log_dir: str):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+class Profiler:
+    """Epoch-targeted device profiler — the torch.profiler wrapper of the
+    reference (profile.py:9-70: `Profile` config section with `enable` 0/1
+    and `target_epoch`; entered around each epoch at
+    train_validate_test.py:128-130,160). Here the capture is a jax.profiler
+    trace of the target epoch, written under <prefix>/profile/ and viewable
+    in TensorBoard/XProf (includes XLA HLO + TPU device timelines)."""
+
+    def __init__(self, prefix: str = "", enable: bool = False,
+                 target_epoch: int = 0):
+        self.prefix = prefix
+        self.enable = enable
+        self.target_epoch = target_epoch
+        self.current_epoch = -1
+        self.done = False
+        self._active = False
+
+    def setup(self, config):
+        """reference: Profiler.setup (profile.py:32-42)."""
+        self.enable = int(config.get("enable", 0)) == 1
+        self.target_epoch = int(config.get("target_epoch", 0))
+
+    def set_current_epoch(self, current_epoch: int):
+        self.current_epoch = current_epoch
+
+    def __enter__(self):
+        if self.enable and not self.done \
+                and self.current_epoch == self.target_epoch:
+            import os
+            out = os.path.join(self.prefix or ".", "profile")
+            os.makedirs(out, exist_ok=True)
+            jax.profiler.start_trace(out)
+            self._active = True
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            self.done = True
+        return False

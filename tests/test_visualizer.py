@@ -58,3 +58,23 @@ def test_run_training_creates_plots():
     for stem in ("num_nodes", "global_analysis", "history"):
         assert os.path.exists(os.path.join(out, stem + ".npz")), stem
     assert glob.glob(os.path.join(out, "parity_*.png"))
+
+
+def test_profile_section_captures_target_epoch(tmp_path):
+    """config["Profile"] = {"enable": 1, "target_epoch": E} captures a
+    jax.profiler trace of epoch E (reference: profile.py:32-42, wired at
+    train_validate_test.py:128-130,160)."""
+    samples = deterministic_graph_dataset(num_configs=16)
+    tr, va, te = samples[:12], samples[12:14], samples[14:]
+    cfg = make_config("GIN", heads=("graph",))
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 2
+    cfg["Profile"] = {"enable": 1, "target_epoch": 1}
+    state, history, model, completed = run_training(
+        cfg, datasets=(tr, va, te), num_shards=1)
+    prof_dir = os.path.join("./logs", get_log_name_config(completed),
+                            "profile")
+    assert os.path.isdir(prof_dir)
+    assert glob.glob(os.path.join(prof_dir, "**", "*.xplane.pb"),
+                     recursive=True), "no trace captured"
+    # per-task losses now recorded alongside totals
+    assert any(k.startswith("task_") for k in history)
