@@ -1,3 +1,4 @@
+from .base import AbstractBaseDataset
 from .gsdataset import GraphStoreDataset, GraphStoreWriter
 from .pickledataset import SimplePickleDataset, SimplePickleWriter
 from .lsmsdataset import LSMSDataset, load_lsms_splits

@@ -1,0 +1,46 @@
+"""User-facing dataset base class.
+
+reference: hydragnn/utils/datasets/abstractbasedataset.py:6-46 — the
+extension point users subclass to feed custom data into training. Same
+contract here (abstract ``get``/``len``, list-backed ``self.dataset``,
+sequence protocol), with items being `GraphSample`s instead of PyG `Data`.
+Any sequence of GraphSamples is accepted by the loaders, so subclassing is
+optional — this class exists so reference users find the identical API.
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+
+class AbstractBaseDataset(ABC):
+    """reference: AbstractBaseDataset (abstractbasedataset.py:6)."""
+
+    def __init__(self):
+        super().__init__()
+        self.dataset = list()
+
+    @abstractmethod
+    def get(self, idx):
+        """Return the sample at idx."""
+
+    @abstractmethod
+    def len(self):
+        """Total number of samples (global total if distributed)."""
+
+    def apply(self, func):
+        for data in self.dataset:
+            func(data)
+
+    def map(self, func):
+        for data in self.dataset:
+            yield func(data)
+
+    def __len__(self):
+        return self.len()
+
+    def __getitem__(self, idx):
+        return self.get(idx)
+
+    def __iter__(self):
+        for idx in range(self.len()):
+            yield self.get(idx)

@@ -85,6 +85,54 @@ def shard_batch(batch, mesh: Mesh, axis: str = "data"):
         lambda a: jax.device_put(a, sh) if a is not None else None, batch)
 
 
+def walltime_deadline(default: Optional[float] = None) -> Optional[float]:
+    """Absolute stop deadline (epoch seconds) for the trainer's walltime
+    guard (reference: check_remaining, distributed.py:331-356 — rank 0 shells
+    out to `squeue -o %L` for the job's remaining time and broadcasts a stop
+    flag). Sources, in order:
+
+    * ``HYDRAGNN_WALLTIME_DEADLINE`` — absolute epoch seconds,
+    * ``SLURM_JOB_END_TIME`` — absolute epoch seconds (set by SLURM),
+    * ``squeue -h -j $SLURM_JOB_ID -o %L`` — remaining [d-]hh:mm:ss.
+
+    Single-controller JAX runs one Python per host executing identical code,
+    so every host derives the same deadline — no broadcast needed (the
+    reference needs one because each rank polls at a different moment).
+    """
+    import time
+    val = os.getenv("HYDRAGNN_WALLTIME_DEADLINE")
+    if val:
+        return float(val)
+    val = os.getenv("SLURM_JOB_END_TIME")
+    if val:
+        return float(val)
+    jobid = os.getenv("SLURM_JOB_ID")
+    if jobid:
+        import subprocess
+        try:
+            out = subprocess.run(
+                ["squeue", "-h", "-j", jobid, "-o", "%L"],
+                stdout=subprocess.PIPE, timeout=30).stdout.decode().strip()
+            return time.time() + _timedelta_parse(out)
+        except Exception:
+            return default
+    return default
+
+
+def _timedelta_parse(timestr: str) -> float:
+    """Parse SLURM's remaining-time format `[days-]hours:minutes:seconds`
+    (reference: timedelta_parse used at distributed.py:344)."""
+    days = 0.0
+    if "-" in timestr:
+        d, timestr = timestr.split("-", 1)
+        days = float(d)
+    parts = [float(p) for p in timestr.split(":")]
+    while len(parts) < 3:
+        parts.insert(0, 0.0)
+    h, m, s = parts[-3:]
+    return days * 86400 + h * 3600 + m * 60 + s
+
+
 def param_sharding_zero(mesh: Mesh, params, axis: str = "data",
                         min_size: int = 2 ** 14):
     """ZeRO-style sharding spec for optimizer state pytrees: shard the

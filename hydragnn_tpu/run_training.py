@@ -191,6 +191,13 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         profiler = Profiler(os.path.join("./logs", log_name))
         profiler.setup(config["Profile"])
 
+    # walltime guard (reference: Training.CheckRemainingTime ->
+    # check_remaining squeue poll, train_validate_test.py:255-262)
+    deadline = None
+    if train_cfg.get("CheckRemainingTime", False):
+        from .parallel.mesh import walltime_deadline
+        deadline = walltime_deadline()
+
     state, history = train_validate_test(
         train_step, eval_step, state, train_loader, val_loader, test_loader,
         num_epochs=int(train_cfg["num_epoch"]), log_name=log_name,
@@ -198,7 +205,7 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         use_early_stopping=bool(train_cfg.get("EarlyStopping", False)),
         checkpoint_warmup=int(train_cfg.get("checkpoint_warmup", 0)),
         checkpoint_fn=ckpt_fn, verbosity=verbosity, tracer=tr.get(),
-        place_fn=place_fn, profiler=profiler)
+        place_fn=place_fn, profiler=profiler, walltime_deadline=deadline)
 
     if train_cfg.get("Checkpoint", False):
         save_model(state, log_name)

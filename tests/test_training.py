@@ -226,3 +226,24 @@ def test_neighbor_format_wired_through_loaders(monkeypatch):
     monkeypatch.setenv("HYDRAGNN_NEIGHBOR_FORMAT", "0")
     loaders_off = create_dataloaders(tr, va, te, batch_size=8)
     assert next(iter(loaders_off[0])).nbr is None
+
+
+def test_walltime_guard_stops_training(monkeypatch):
+    """Training.CheckRemainingTime + an already-expired deadline stops after
+    the first epoch (reference: check_remaining, distributed.py:331-356)."""
+    import time
+    monkeypatch.setenv("HYDRAGNN_WALLTIME_DEADLINE", str(time.time() - 1))
+    samples = deterministic_graph_dataset(num_configs=16)
+    tr, va, te = samples[:12], samples[12:14], samples[14:]
+    cfg = make_config("GIN", heads=("graph",))
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 50
+    cfg["NeuralNetwork"]["Training"]["CheckRemainingTime"] = True
+    _, history, _, _ = run_training(cfg, datasets=(tr, va, te), num_shards=1)
+    assert len(history["train_loss"]) == 1
+
+
+def test_timedelta_parse():
+    from hydragnn_tpu.parallel.mesh import _timedelta_parse
+    assert _timedelta_parse("1:02:03") == 3723
+    assert _timedelta_parse("2-00:00:10") == 2 * 86400 + 10
+    assert _timedelta_parse("05:30") == 330
