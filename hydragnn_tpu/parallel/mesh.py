@@ -34,7 +34,10 @@ def init_distributed(coordinator: Optional[str] = None,
     runtime metadata; env overrides mirror HYDRAGNN_MASTER_ADDR/PORT
     (reference: distributed.py:139-141). Returns (world_size, rank).
     """
-    already = jax.process_count() > 1
+    # must not touch the XLA backend before jax.distributed.initialize
+    # (jax.process_count() would initialise it), so probe the distributed
+    # client state instead
+    already = jax.distributed.is_initialized()
     if not already and (coordinator or os.getenv("HYDRAGNN_MASTER_ADDR")):
         coord = coordinator or (
             os.environ["HYDRAGNN_MASTER_ADDR"] + ":" +

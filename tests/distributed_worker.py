@@ -1,0 +1,93 @@
+"""Worker for the 2-process distributed CPU test (the analogue of the
+reference's `mpirun -n 2 --oversubscribe` CI pass, .github/workflows/
+CI.yml:55-56 — multi-host behavior tested on one box).
+
+Each process: jax.distributed.initialize over localhost (through
+hydragnn_tpu.parallel.mesh.init_distributed's HYDRAGNN_MASTER_ADDR path),
+4 virtual CPU devices per process -> an 8-device global mesh, then one
+SPMD train step on a process-local shard of a deterministic dataset and a
+cross-process metric allgather. Prints one JSON line for the parent to
+compare across ranks.
+"""
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    rank = int(sys.argv[1])
+    nprocs = int(sys.argv[2])
+    os.environ["HYDRAGNN_MASTER_ADDR"] = "127.0.0.1"
+    os.environ["HYDRAGNN_MASTER_PORT"] = os.environ.get("TEST_COORD_PORT", "12399")
+    os.environ["SLURM_NPROCS"] = str(nprocs)
+    os.environ["SLURM_PROCID"] = str(rank)
+
+    from hydragnn_tpu.parallel.mesh import (get_comm_size_and_rank,
+                                            init_distributed, make_mesh)
+    world, got_rank = init_distributed()
+    assert world == nprocs and got_rank == rank, (world, got_rank)
+    assert get_comm_size_and_rank() == (nprocs, rank)
+    ndev = jax.device_count()
+    nlocal = len(jax.local_devices())
+    assert ndev == 4 * nprocs and nlocal == 4, (ndev, nlocal)
+
+    # global 1-D data mesh spanning both processes (ICI/DCN analogue)
+    mesh = make_mesh((("data", ndev),))
+
+    # cross-process collective: psum of a per-process value
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(jax.numpy.asarray([rank + 1.0]))
+    total = float(gathered.sum())
+
+    # SPMD train step over the global mesh, identical data on every process
+    # (single-controller SPMD: all processes execute the same program; each
+    # addresses its local shard of the global batch)
+    import numpy as np
+    from hydragnn_tpu.config import build_model_config, update_config
+    from hydragnn_tpu.models.create import create_model, init_params
+    from hydragnn_tpu.parallel.spmd import make_spmd_train_step
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.train_step import TrainState
+    from hydragnn_tpu.datasets.loader import GraphDataLoader
+    from jax.experimental.multihost_utils import host_local_array_to_global_array
+    from jax.sharding import PartitionSpec as P
+    from tests.deterministic_data import deterministic_graph_dataset
+    from tests.utils import make_config
+
+    samples = deterministic_graph_dataset(num_configs=16)
+    cfg = make_config("GIN", heads=("graph",))
+    cfg = update_config(cfg, samples)
+    mcfg = build_model_config(cfg)
+    model = create_model(mcfg)
+    loader = GraphDataLoader(samples, batch_size=ndev * 2, num_shards=ndev)
+    batch = next(iter(loader))
+    # each process owns its local quarter of the leading device axis
+    local = jax.tree_util.tree_map(
+        lambda a: None if a is None else a[rank * nlocal:(rank + 1) * nlocal],
+        batch)
+    gbatch = jax.tree_util.tree_map(
+        lambda a: None if a is None else host_local_array_to_global_array(
+            a, mesh, P("data")),
+        local)
+    variables = init_params(model, jax.tree_util.tree_map(
+        lambda a: None if a is None else a[0], batch))
+    tx = select_optimizer(cfg["NeuralNetwork"]["Training"])
+    state = TrainState.create(variables, tx)
+    step = make_spmd_train_step(model, mcfg, tx, mesh, "mse")
+    state, metrics = step(state, gbatch)
+    # the loss is replicated over the global mesh; every process reads its
+    # local replica (global arrays can't be fetched whole from one host)
+    loss = float(np.asarray(metrics["loss"].addressable_data(0)))
+
+    print(json.dumps({"rank": rank, "world": world, "devices": ndev,
+                      "psum": total, "loss": round(loss, 6)}))
+
+
+if __name__ == "__main__":
+    main()
