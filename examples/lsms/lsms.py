@@ -16,6 +16,8 @@ import json
 import os
 import sys
 
+import numpy as np
+
 sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
 
 
@@ -73,25 +75,41 @@ def main():
             SerializedWriter(testset, basedir, datasetname, "testset")
         else:
             from hydragnn_tpu.datasets.gsdataset import GraphStoreWriter
+            mm_attrs = {
+                "minmax_node_feature": np.asarray(
+                    total.minmax_node_feature).tolist(),
+                "minmax_graph_feature": np.asarray(
+                    total.minmax_graph_feature).tolist()}
             for label, ds in (("trainset", trainset), ("valset", valset),
                               ("testset", testset)):
                 w = GraphStoreWriter(os.path.join(
-                    here, "dataset", f"{datasetname}_{label}_gs"))
+                    here, "dataset", f"{datasetname}_{label}_gs"),
+                    attrs=mm_attrs if label == "trainset" else None)
                 w.add_all(ds)
                 w.save()
     if args.preonly:
         sys.exit(0)
 
     if args.format == "serialized":
-        splits = tuple(
-            list(SerializedDataset(basedir, datasetname, label))
-            for label in ("trainset", "valset", "testset"))
+        train_ds = SerializedDataset(basedir, datasetname, "trainset")
+        splits = (list(train_ds),
+                  list(SerializedDataset(basedir, datasetname, "valset")),
+                  list(SerializedDataset(basedir, datasetname, "testset")))
     else:
         from hydragnn_tpu.datasets.gsdataset import GraphStoreDataset
-        splits = tuple(
-            list(GraphStoreDataset(os.path.join(
-                here, "dataset", f"{datasetname}_{label}_gs")))
-            for label in ("trainset", "valset", "testset"))
+        train_ds = GraphStoreDataset(os.path.join(
+            here, "dataset", f"{datasetname}_trainset_gs"))
+        splits = (list(train_ds),
+                  *(list(GraphStoreDataset(os.path.join(
+                      here, "dataset", f"{datasetname}_{label}_gs")))
+                    for label in ("valset", "testset")))
+
+    # raw-feature minmax metadata -> config, for output denormalization
+    # (reference: update_config_minmax reads it from the serialized pkl)
+    for key in ("minmax_node_feature", "minmax_graph_feature"):
+        mm = getattr(train_ds, key, None)
+        if mm is not None:
+            config["Dataset"][key] = np.asarray(mm).tolist()
 
     state, history, model, completed = run_training(config, datasets=splits)
     print(json.dumps({"final_train_loss": history["train_loss"][-1],

@@ -136,9 +136,52 @@ def update_config(config: Dict[str, Any], train_data, val_data=None,
     train_cfg.setdefault("conv_checkpointing", False)
     train_cfg.setdefault("compute_grad_energy", False)
 
+    _update_config_minmax(config, train_data)
+
     nn["Architecture"] = arch
     config["NeuralNetwork"] = nn
     return config
+
+
+def _update_config_minmax(config, train_data):
+    """Populate x_minmax/y_minmax for output denormalization
+    (reference: update_config_minmax, config_utils.py:244-269 — reads the
+    raw-feature minmax metadata written by the serialized-dataset pipeline
+    and selects the columns at input/output_index).
+
+    Sources, in order: `Dataset.minmax_node_feature`/`minmax_graph_feature`
+    config keys (examples inject these from their raw loaders), or the same
+    attributes on the train dataset object (SerializedDataset, LSMSDataset,
+    ... carry them). If neither exists while denormalize_output is set, the
+    flag is turned off with a warning instead of failing at predict time.
+    """
+    voi = config["NeuralNetwork"]["Variables_of_interest"]
+    if not voi.get("denormalize_output"):
+        return
+    ds = config.get("Dataset", {})
+    node_mm = ds.get("minmax_node_feature",
+                     getattr(train_data, "minmax_node_feature", None))
+    graph_mm = ds.get("minmax_graph_feature",
+                      getattr(train_data, "minmax_graph_feature", None))
+    node_mm = None if node_mm is None else np.asarray(node_mm, np.float64)
+    graph_mm = None if graph_mm is None else np.asarray(graph_mm, np.float64)
+
+    y_minmax = []
+    for otype, oidx in zip(voi["type"], voi["output_index"]):
+        mm = graph_mm if otype == "graph" else node_mm
+        if mm is None:
+            import logging
+            logging.getLogger("hydragnn_tpu").warning(
+                "denormalize_output set but no minmax metadata available "
+                "(no Dataset.minmax_*_feature keys and the dataset object "
+                "carries none) — disabling denormalization")
+            voi["denormalize_output"] = False
+            return
+        y_minmax.append(mm[:, int(oidx)].tolist())
+    voi["y_minmax"] = y_minmax
+    if node_mm is not None:
+        voi["x_minmax"] = [node_mm[:, int(i)].tolist()
+                           for i in voi["input_node_features"]]
 
 
 def _graph_size_variable(*datasets) -> bool:

@@ -31,6 +31,16 @@ from ..ops.segment import global_mean_pool
 from .layers import MLP, MLPNode, MaskedBatchNorm, node_index_in_graph
 
 
+def _remat_call(conv: nn.Module, *args):
+    """Activation-checkpoint a conv layer's application: recompute its
+    forward during the backward pass instead of storing intermediates
+    (reference: conv checkpointing, Base.py:299-301,310-315 / create.py:424
+    — there via torch.utils.checkpoint; here flax `nn.remat` on the call).
+    Param paths are untouched, so checkpointing is a pure memory/FLOPs
+    trade."""
+    return nn.remat(lambda mdl, *a: mdl(*a))(conv, *args)
+
+
 class BaseStack(nn.Module):
     """Abstract conv stack + multihead decoder. Subclasses override
     `make_conv` (and optionally `conv_args` / `initial_node_features` /
@@ -73,7 +83,10 @@ class BaseStack(nn.Module):
         for i in range(cfg.num_conv_layers):
             conv = self.make_conv(in_dim, cfg.hidden_dim, i,
                                   final=(i == cfg.num_conv_layers - 1))
-            x, pos = conv(x, pos, batch, cargs)
+            if cfg.conv_checkpointing:
+                x, pos = _remat_call(conv, x, pos, batch, cargs)
+            else:
+                x, pos = conv(x, pos, batch, cargs)
             if self.use_batch_norm:
                 x = MaskedBatchNorm(name=f"feature_norm_{i}")(
                     x, batch.node_mask, use_running_average=not train)

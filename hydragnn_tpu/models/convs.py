@@ -29,8 +29,11 @@ class GINConv(nn.Module):
     @nn.compact
     def __call__(self, x, pos, batch, cargs):
         eps = self.param("eps", lambda k: jnp.asarray(self.eps_init, jnp.float32))
-        msgs = x[batch.senders]
-        agg = seg.segment_sum(msgs, batch.receivers, x.shape[0], batch.edge_mask)
+        if batch.nbr is not None:
+            agg = seg.neighbor_sum(x[batch.nbr], batch.nbr_mask)
+        else:
+            agg = seg.segment_sum(x[batch.senders], batch.receivers,
+                                  x.shape[0], batch.edge_mask)
         h = (1.0 + eps) * x + agg
         h = MLP([self.out_dim, self.out_dim], activation=jax.nn.relu)(h)
         return h, pos
@@ -42,8 +45,11 @@ class SAGEConv(nn.Module):
 
     @nn.compact
     def __call__(self, x, pos, batch, cargs):
-        agg = seg.segment_mean(x[batch.senders], batch.receivers, x.shape[0],
-                               batch.edge_mask)
+        if batch.nbr is not None:
+            agg = seg.neighbor_mean(x[batch.nbr], batch.nbr_mask)
+        else:
+            agg = seg.segment_mean(x[batch.senders], batch.receivers,
+                                   x.shape[0], batch.edge_mask)
         h = nn.Dense(self.out_dim, name="lin_l")(agg) + \
             nn.Dense(self.out_dim, name="lin_r")(x)
         return h, pos
@@ -62,17 +68,32 @@ class GATv2Conv(nn.Module):
         H, F = self.heads, self.out_dim
         g_l = nn.Dense(H * F, name="lin_l")(x).reshape(-1, H, F)  # target/self
         g_r = nn.Dense(H * F, name="lin_r")(x).reshape(-1, H, F)  # source
-        e = g_l[batch.receivers] + g_r[batch.senders]             # [E, H, F]
-        if batch.edge_attr is not None and "edge_attr_dim" in cargs:
-            e = e + nn.Dense(H * F, name="lin_edge")(
-                batch.edge_attr).reshape(-1, H, F)
-        e_act = jax.nn.leaky_relu(e, self.negative_slope)
         att = self.param("att", nn.initializers.lecun_normal(), (1, H, F))
-        logits = jnp.sum(e_act * att, axis=-1)                    # [E, H]
-        alpha = seg.segment_softmax(logits, batch.receivers, x.shape[0],
-                                    batch.edge_mask)
-        msgs = g_r[batch.senders] * alpha[..., None]
-        out = seg.segment_sum(msgs, batch.receivers, x.shape[0], batch.edge_mask)
+        use_ea = batch.edge_attr is not None and "edge_attr_dim" in cargs
+        if batch.nbr is not None:
+            # dense layout: attention softmax is a masked reduction over the
+            # K axis — no segment softmax, no scatters
+            e = g_l[:, None] + g_r[batch.nbr]                     # [N, K, H, F]
+            if use_ea:
+                e = e + nn.Dense(H * F, name="lin_edge")(
+                    batch.edge_attr).reshape(-1, H, F)[batch.nbr_edge]
+            e_act = jax.nn.leaky_relu(e, self.negative_slope)
+            logits = jnp.sum(e_act * att, axis=-1)                # [N, K, H]
+            alpha = seg.neighbor_softmax(logits, batch.nbr_mask)
+            out = seg.neighbor_sum(g_r[batch.nbr] * alpha[..., None],
+                                   batch.nbr_mask)               # [N, H, F]
+        else:
+            e = g_l[batch.receivers] + g_r[batch.senders]         # [E, H, F]
+            if use_ea:
+                e = e + nn.Dense(H * F, name="lin_edge")(
+                    batch.edge_attr).reshape(-1, H, F)
+            e_act = jax.nn.leaky_relu(e, self.negative_slope)
+            logits = jnp.sum(e_act * att, axis=-1)                # [E, H]
+            alpha = seg.segment_softmax(logits, batch.receivers, x.shape[0],
+                                        batch.edge_mask)
+            msgs = g_r[batch.senders] * alpha[..., None]
+            out = seg.segment_sum(msgs, batch.receivers, x.shape[0],
+                                  batch.edge_mask)
         if self.concat:
             out = out.reshape(-1, H * F)
         else:
@@ -93,9 +114,14 @@ class MFConv(nn.Module):
     def __call__(self, x, pos, batch, cargs):
         n, fin = x.shape
         d = self.max_degree + 1
-        agg = seg.segment_sum(x[batch.senders], batch.receivers, n, batch.edge_mask)
-        deg = seg.degree(batch.receivers, n, batch.edge_mask).astype(jnp.int32)
-        deg = jnp.clip(deg, 0, self.max_degree)
+        if batch.nbr is not None:
+            agg = seg.neighbor_sum(x[batch.nbr], batch.nbr_mask)
+            deg = jnp.sum(batch.nbr_mask, axis=1)
+        else:
+            agg = seg.segment_sum(x[batch.senders], batch.receivers, n,
+                                  batch.edge_mask)
+            deg = seg.degree(batch.receivers, n, batch.edge_mask)
+        deg = jnp.clip(deg.astype(jnp.int32), 0, self.max_degree)
         w_l = self.param("w_l", nn.initializers.lecun_normal(), (d, fin, self.out_dim))
         b_l = self.param("b_l", nn.initializers.zeros, (d, self.out_dim))
         w_r = self.param("w_r", nn.initializers.lecun_normal(), (d, fin, self.out_dim))
@@ -113,16 +139,25 @@ class CGConv(nn.Module):
 
     @nn.compact
     def __call__(self, x, pos, batch, cargs):
-        xi = x[batch.receivers]
-        xj = x[batch.senders]
-        z = jnp.concatenate([xi, xj], axis=-1)
         ea = cargs.get("edge_attr", batch.edge_attr)
-        if ea is not None:
-            z = jnp.concatenate([z, ea], axis=-1)
-        gate = jax.nn.sigmoid(nn.Dense(x.shape[-1], name="lin_f")(z))
-        core = jax.nn.softplus(nn.Dense(x.shape[-1], name="lin_s")(z))
-        agg = seg.segment_sum(gate * core, batch.receivers, x.shape[0],
-                              batch.edge_mask)
+        if batch.nbr is not None:
+            k = batch.nbr.shape[1]
+            xi = jnp.broadcast_to(x[:, None], (x.shape[0], k, x.shape[-1]))
+            parts = [xi, x[batch.nbr]]
+            if ea is not None:
+                parts.append(ea[batch.nbr_edge])
+            z = jnp.concatenate(parts, axis=-1)                  # [N, K, ·]
+            gate = jax.nn.sigmoid(nn.Dense(x.shape[-1], name="lin_f")(z))
+            core = jax.nn.softplus(nn.Dense(x.shape[-1], name="lin_s")(z))
+            agg = seg.neighbor_sum(gate * core, batch.nbr_mask)
+        else:
+            z = jnp.concatenate([x[batch.receivers], x[batch.senders]], axis=-1)
+            if ea is not None:
+                z = jnp.concatenate([z, ea], axis=-1)
+            gate = jax.nn.sigmoid(nn.Dense(x.shape[-1], name="lin_f")(z))
+            core = jax.nn.softplus(nn.Dense(x.shape[-1], name="lin_s")(z))
+            agg = seg.segment_sum(gate * core, batch.receivers, x.shape[0],
+                                  batch.edge_mask)
         return x + agg, pos
 
 

@@ -138,6 +138,36 @@ def neighbor_aggregate(h, nbr_mask, eps=1e-5):
     return mean, mn, mx, std, cnt
 
 
+def neighbor_sum(h, nbr_mask):
+    """Masked sum over the K axis of [N, K, ...] dense-layout messages."""
+    m = nbr_mask.reshape(nbr_mask.shape + (1,) * (h.ndim - 2))
+    return jnp.sum(jnp.where(m, h, 0.0), axis=1)
+
+
+def neighbor_mean(h, nbr_mask):
+    """Masked mean over the K axis of [N, K, ...] dense-layout messages."""
+    cnt = jnp.sum(nbr_mask.astype(h.dtype), axis=1)
+    cnt = cnt.reshape(cnt.shape + (1,) * (h.ndim - 2))
+    return neighbor_sum(h, nbr_mask) / jnp.maximum(cnt, 1.0)
+
+
+def neighbor_softmax(logits, nbr_mask):
+    """Masked softmax over the K axis ([N, K] or [N, K, H] logits) — the
+    dense-layout equivalent of `segment_softmax`: attention weights over each
+    node's in-edges with padding slots at exactly 0."""
+    m = nbr_mask.reshape(nbr_mask.shape + (1,) * (logits.ndim - 2))
+    neg = jnp.asarray(jnp.finfo(logits.dtype).min, logits.dtype)
+    masked = jnp.where(m, logits, neg)
+    mx = jnp.max(masked, axis=1, keepdims=True)
+    # select BEFORE exp: on all-masked rows mx is finfo.min, and
+    # exp(logits - mx) would overflow to inf — harmless forward, but the
+    # where-gradient multiplies inf by a zero cotangent -> NaN
+    z = jnp.where(m, logits - jax.lax.stop_gradient(mx), 0.0)
+    e = jnp.where(m, jnp.exp(z), 0.0)
+    denom = jnp.sum(e, axis=1, keepdims=True)
+    return e / jnp.maximum(denom, 1e-16)
+
+
 def segment_softmax(logits, segment_ids, num_segments, mask=None):
     """Numerically-stable softmax within segments (GAT attention,
     reference: torch_geometric GATConv used at hydragnn/models/GATStack.py:29)."""

@@ -77,7 +77,7 @@ def run_prediction(config_or_path, datasets: Optional[Tuple] = None,
     preds = [np.concatenate(p) for p in preds]
 
     voi = config["NeuralNetwork"]["Variables_of_interest"]
-    if voi.get("denormalize_output"):
+    if voi.get("denormalize_output") and "y_minmax" in voi:
         trues, preds = output_denormalize(voi["y_minmax"], trues, preds)
 
     # per-head true/pred pickle dump (reference: HYDRAGNN_DUMP_TESTDATA,

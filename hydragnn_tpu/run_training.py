@@ -70,8 +70,12 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     config = load_config(config_or_path)
     verbosity = config.get("Verbosity", {}).get("level", 0)
 
+    from .utils.envflags import env_flag, env_int
     init_distributed()
-    tr.initialize()
+    # TRACE_LEVEL>0 also turns on synchronous region timing (the cudasync
+    # analogue: block_until_ready before closing a span — reference:
+    # tracer.py:106-127)
+    tr.initialize(sync=(env_int("HYDRAGNN_TRACE_LEVEL", 0) or 0) > 0)
 
     if datasets is None:
         datasets = _load_datasets_from_config(config)
@@ -79,6 +83,7 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     trainset = list(trainset)
     valset = list(valset)
     testset = list(testset)
+
     datasets = (trainset, valset, testset)
 
     config = update_config(config, trainset, valset, testset)
@@ -108,11 +113,24 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     from .utils.envflags import env_flag
     nbr_fmt = nn["Architecture"].get(
         "neighbor_format",
-        nn["Architecture"]["model_type"] in ("PNA", "PNAPlus"))
+        nn["Architecture"]["model_type"] in (
+            "GIN", "SAGE", "GAT", "MFC", "CGCNN", "PNA", "PNAPlus"))
     nbr_fmt = env_flag("HYDRAGNN_NEIGHBOR_FORMAT", bool(nbr_fmt))
 
+    # HYDRAGNN_USE_ddstore serves training samples from the C++ DDStore
+    # (reference: the --ddstore path wrapping datasets in DistDataset,
+    # utils/datasets/distdataset.py:22-183). Single-process wiring here (one
+    # local shard); multi-host peer wiring is example-level because it needs
+    # per-host addresses.
+    train_source = trainset
+    if env_flag("HYDRAGNN_USE_ddstore") and trainset:
+        from .datasets.ddstore import DistDataset
+        dd = DistDataset(rank=0, world=1)
+        dd.populate(trainset, 0, len(trainset), [0, len(trainset)])
+        train_source = dd
+
     train_loader, val_loader, test_loader = create_dataloaders(
-        trainset, valset, testset, batch_size, num_shards=num_shards,
+        train_source, valset, testset, batch_size, num_shards=num_shards,
         batch_transform=batch_transform, neighbor_format=nbr_fmt)
 
     mcfg = build_model_config(config)

@@ -133,6 +133,22 @@ def train_validate_test(
     from ..utils.envflags import env_flag, env_int
     max_num_batch = env_int("HYDRAGNN_MAX_NUM_BATCH")
     run_valtest = env_flag("HYDRAGNN_VALTEST", default=True)
+    # HYDRAGNN_TRACE_LEVEL>0 adds a dataload span around every batch fetch
+    # (reference: tr spans at train_validate_test.py:474-545, h2d/sync spans
+    # gated by the same flag); HYDRAGNN_NUM_WORKERS maps the reference's
+    # DataLoader worker count (load_data.py:249-254) onto prefetch depth
+    trace_level = env_int("HYDRAGNN_TRACE_LEVEL", 0)
+    prefetch_depth = max(env_int("HYDRAGNN_NUM_WORKERS", 2), 1)
+
+    def _timed_stream(stream):
+        it = iter(stream)
+        while True:
+            with tr.timer("dataload"):
+                try:
+                    b = next(it)
+                except StopIteration:
+                    return
+            yield b
 
     from ..utils.profiling import Profiler
     profiler = profiler or Profiler(run_dir, enable=False)
@@ -147,8 +163,11 @@ def train_validate_test(
             # double-buffered device prefetch only when the caller supplies
             # a placement (meshes need mesh-aware sharding; committing to a
             # single device would break multi-device shard_map steps)
-            stream = (prefetch_to_device(train_loader, place_fn=place_fn)
+            stream = (prefetch_to_device(train_loader, size=prefetch_depth,
+                                         place_fn=place_fn)
                       if place_fn is not None else train_loader)
+            if trace_level > 0:
+                stream = _timed_stream(stream)
             for batch in iterate_tqdm(stream, verbosity,
                                       desc=f"epoch {epoch} train",
                                       total=len(train_loader)):

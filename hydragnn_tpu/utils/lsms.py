@@ -24,6 +24,53 @@ def convert_total_energy_to_formation_energy(
         s.y_graph[0] = s.y_graph[0] - offset
 
 
+_KB_RYDBERG_PER_KELVIN = 1.380649e-23 * 4.5874208973812e17
+
+
+def compute_formation_enthalpy(total_energy: float, types: np.ndarray,
+                               elements: Sequence[int],
+                               pure_energies: Dict[int, float]):
+    """Binary-alloy formation enthalpy + configurational entropy
+    (reference: compute_formation_enthalpy,
+    convert_total_energy_to_formation_gibbs.py:143-184 — linear mixing
+    energy from per-atom pure-element energies; entropy is
+    k_B ln C(N, n_1) in Rydberg/K, LSMS units).
+
+    Returns (composition, linear_mixing_energy, formation_enthalpy, entropy).
+    """
+    elements = sorted(elements)
+    assert len(elements) == 2, "binary alloys only (as in the reference)"
+    n = len(types)
+    n0 = int(np.sum(types == elements[0]))
+    composition = n0 / n
+    linear_mixing = (pure_energies[elements[0]] * composition
+                     + pure_energies[elements[1]] * (1 - composition)) * n
+    enthalpy = total_energy - linear_mixing
+    # log of the binomial coefficient, numerically via lgamma
+    from math import lgamma
+    log_comb = lgamma(n + 1) - lgamma(n0 + 1) - lgamma(n - n0 + 1)
+    entropy = _KB_RYDBERG_PER_KELVIN * log_comb
+    return composition, linear_mixing, enthalpy, entropy
+
+
+def convert_total_energy_to_formation_gibbs(
+        samples: Sequence[GraphSample], elements: Sequence[int],
+        pure_energies_per_atom: Dict[int, float],
+        temperature_kelvin: float = 0.0, type_column: int = 0) -> None:
+    """In-place y_graph[0]: total energy -> formation Gibbs energy
+    G = H_formation - T * S_config (reference:
+    convert_raw_data_energy_to_gibbs,
+    convert_total_energy_to_formation_gibbs.py:30-140; the reference
+    rewrites LSMS files on disk — here the conversion applies to loaded
+    samples, the natural boundary in this pipeline)."""
+    for s in samples:
+        types = np.round(s.x[:, type_column]).astype(int)
+        _, _, enthalpy, entropy = compute_formation_enthalpy(
+            float(s.y_graph[0]), types, elements, pure_energies_per_atom)
+        s.y_graph = s.y_graph.copy()
+        s.y_graph[0] = enthalpy - temperature_kelvin * entropy
+
+
 def compositional_histogram_cutoff(
         samples: Sequence[GraphSample], num_bins: int = 100,
         cutoff_percentile: float = 95.0, type_column: int = 0,

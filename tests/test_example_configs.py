@@ -50,3 +50,38 @@ def test_example_config_parses_and_builds(path):
     mcfg = build_model_config(completed)
     assert mcfg.model_type == arch["model_type"]
     assert len(mcfg.heads) == len(heads)
+
+
+def test_update_config_minmax_populates_y_minmax():
+    """denormalize_output + Dataset.minmax_*_feature keys -> voi.y_minmax
+    selected by head type/output_index (reference: update_config_minmax,
+    config_utils.py:244-269); without metadata the flag degrades to off."""
+    samples = deterministic_graph_dataset(num_configs=8)
+    cfg = {
+        "Dataset": {"minmax_graph_feature": [[1.0], [3.0]],
+                    "minmax_node_feature": [[0.0], [2.0]]},
+        "NeuralNetwork": {
+            "Architecture": {"model_type": "GIN", "hidden_dim": 8,
+                             "num_conv_layers": 2,
+                             "output_heads": {"graph": {
+                                 "num_sharedlayers": 1, "dim_sharedlayers": 4,
+                                 "num_headlayers": 1, "dim_headlayers": [4]}}},
+            "Variables_of_interest": {
+                "type": ["graph"], "output_names": ["y"],
+                "output_index": [0], "input_node_features": [0],
+                "denormalize_output": True},
+            "Training": {"batch_size": 4, "num_epoch": 1,
+                         "perc_train": 0.7}}}
+    # update_config mutates in place, so snapshot before completing
+    cfg2 = json.loads(json.dumps(cfg))
+    done = update_config(cfg, samples)
+    voi = done["NeuralNetwork"]["Variables_of_interest"]
+    assert voi["y_minmax"] == [[1.0, 3.0]]
+    assert voi["x_minmax"] == [[0.0, 2.0]]
+    del cfg2["Dataset"]["minmax_graph_feature"]
+    del cfg2["Dataset"]["minmax_node_feature"]
+    cfg2["NeuralNetwork"]["Variables_of_interest"]["denormalize_output"] = True
+    done2 = update_config(cfg2, samples)
+    voi2 = done2["NeuralNetwork"]["Variables_of_interest"]
+    assert voi2["denormalize_output"] is False
+    assert "y_minmax" not in voi2

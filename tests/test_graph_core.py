@@ -241,9 +241,11 @@ def test_neighbor_aggregate_matches_segment():
                                    rtol=1e-5, atol=1e-5, err_msg=name)
 
 
-def test_pna_forward_matches_across_layouts():
-    """The PNA stack must produce identical outputs from the edge-list and
-    dense neighbor-list layouts."""
+@pytest.mark.parametrize(
+    "model_type", ["GIN", "SAGE", "GAT", "MFC", "CGCNN", "PNA", "PNAPlus"])
+def test_forward_matches_across_layouts(model_type):
+    """Every dense-layout-capable stack must produce identical outputs from
+    the edge-list and dense neighbor-list layouts (same parameters)."""
     import numpy as np
     from hydragnn_tpu.graphs.batch import with_neighbor_format
     from hydragnn_tpu.models.create import create_model, init_params
@@ -251,7 +253,7 @@ def test_pna_forward_matches_across_layouts():
     from tests.utils import prepare
 
     samples = deterministic_graph_dataset(num_configs=8)
-    cfg, mcfg, batch = prepare("PNA", samples)
+    cfg, mcfg, batch = prepare(model_type, samples)
     model = create_model(mcfg)
     variables = init_params(model, batch)
     out_edges, _ = model.apply(variables, batch, train=False)
@@ -260,3 +262,23 @@ def test_pna_forward_matches_across_layouts():
     for a, b in zip(out_edges, out_nbr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-5, atol=2e-5)
+
+
+def test_neighbor_softmax_grad_finite_with_empty_rows():
+    """Gradient through neighbor_softmax must stay finite when a node has
+    zero real neighbors (the where-around-exp NaN trap)."""
+    import jax
+    logits = jnp.asarray(np.random.RandomState(0).randn(4, 5).astype(np.float32))
+    mask = jnp.asarray(np.array([[1, 1, 0, 0, 0],
+                                 [0, 0, 0, 0, 0],   # empty row
+                                 [1, 1, 1, 1, 1],
+                                 [1, 0, 0, 0, 0]], bool))
+
+    def f(lg):
+        return jnp.sum(seg.neighbor_softmax(lg, mask) ** 2)
+
+    g = jax.grad(f)(logits)
+    assert np.all(np.isfinite(np.asarray(g)))
+    a = seg.neighbor_softmax(logits, mask)
+    np.testing.assert_allclose(np.asarray(a[1]), 0.0)
+    np.testing.assert_allclose(np.asarray(a.sum(1)[0]), 1.0, rtol=1e-5)

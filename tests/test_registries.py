@@ -149,3 +149,35 @@ def test_atomicdescriptors_shapes_and_values():
     assert np.all(np.abs(rest) <= 5.0)
     # distinct elements get distinct descriptor rows
     assert len({tuple(row) for row in feats.tolist()}) == 5
+
+
+def test_formation_gibbs_conversion():
+    """Gibbs = formation enthalpy - T * k_B ln C(N, n1), LSMS Rydberg units
+    (reference: convert_total_energy_to_formation_gibbs.py:30-184)."""
+    import math
+    import numpy as np
+    from hydragnn_tpu.graphs.batch import GraphSample
+    from hydragnn_tpu.utils.lsms import (
+        compute_formation_enthalpy, convert_total_energy_to_formation_gibbs,
+        _KB_RYDBERG_PER_KELVIN)
+
+    # 4 atoms: 3 of type 26, 1 of type 78; pure energies per atom
+    types = np.asarray([26, 26, 26, 78])
+    pure = {26: -1.0, 78: -2.0}
+    total = -5.5
+    comp, linmix, enth, entropy = compute_formation_enthalpy(
+        total, types, [26, 78], pure)
+    assert comp == 0.75
+    assert np.isclose(linmix, (-1.0 * 0.75 + -2.0 * 0.25) * 4)
+    assert np.isclose(enth, total - linmix)
+    assert np.isclose(entropy, _KB_RYDBERG_PER_KELVIN * math.log(4))
+
+    x = np.zeros((4, 2), np.float32)
+    x[:, 0] = types
+    s = GraphSample(x=x, pos=np.zeros((4, 3), np.float32),
+                    senders=np.zeros(0, np.int32),
+                    receivers=np.zeros(0, np.int32),
+                    y_graph=np.asarray([total], np.float32))
+    convert_total_energy_to_formation_gibbs([s], [26, 78], pure,
+                                            temperature_kelvin=300.0)
+    assert np.isclose(float(s.y_graph[0]), enth - 300.0 * entropy, atol=1e-5)
