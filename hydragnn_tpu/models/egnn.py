@@ -49,10 +49,10 @@ class EGCL(nn.Module):
                     "coords_range", nn.initializers.constant(3.0), (1,))
                 phi = jnp.tanh(phi) * coords_range
             trans = jnp.clip(coord_diff * phi, -100.0, 100.0)
-            agg_pos = seg.segment_mean(trans, recv, pos.shape[0], batch.edge_mask)
+            agg_pos = seg.edge_aggregate_mean(trans, batch)
             pos = pos + agg_pos * self.coords_weight
 
-        agg = seg.segment_sum(m, recv, x.shape[0], batch.edge_mask)
+        agg = seg.edge_aggregate_sum(m, batch)
         h = MLP([self.hidden_dim, self.out_dim], activation=jax.nn.relu,
                 name="node_mlp")(jnp.concatenate([x, agg], axis=-1))
         if self.recurrent and h.shape == x.shape:

@@ -57,12 +57,10 @@ class CFConv(nn.Module):
             phi = MLP([self.num_filters, 1], activation=jax.nn.relu,
                       name="coord_mlp")(W)
             trans = jnp.clip(coord_diff * phi, -100.0, 100.0)
-            agg = seg.segment_mean(trans, batch.receivers, pos.shape[0],
-                                   batch.edge_mask)
-            pos = pos + agg
+            pos = pos + seg.edge_aggregate_mean(trans, batch)
 
         msgs = h[batch.senders] * W
-        h = seg.segment_sum(msgs, batch.receivers, x.shape[0], batch.edge_mask)
+        h = seg.edge_aggregate_sum(msgs, batch)
         h = nn.Dense(self.num_filters, name="lin2")(h)
         h = shifted_softplus(h)
         h = nn.Dense(self.out_dim, name="lin_out")(h)

@@ -151,6 +151,25 @@ def neighbor_mean(h, nbr_mask):
     return neighbor_sum(h, nbr_mask) / jnp.maximum(cnt, 1.0)
 
 
+def edge_aggregate_sum(edge_values, batch):
+    """Sum per-edge values into receiver nodes, using the dense
+    neighbor-list layout when the batch carries one (gather by nbr_edge +
+    masked K-axis reduction — no scatter) and the masked segment scatter
+    otherwise. Drop-in for the edge->node aggregation step of any conv."""
+    if batch.nbr_edge is not None:
+        return neighbor_sum(edge_values[batch.nbr_edge], batch.nbr_mask)
+    return segment_sum(edge_values, batch.receivers, batch.num_nodes,
+                       batch.edge_mask)
+
+
+def edge_aggregate_mean(edge_values, batch):
+    """Mean counterpart of `edge_aggregate_sum`."""
+    if batch.nbr_edge is not None:
+        return neighbor_mean(edge_values[batch.nbr_edge], batch.nbr_mask)
+    return segment_mean(edge_values, batch.receivers, batch.num_nodes,
+                        batch.edge_mask)
+
+
 def neighbor_softmax(logits, nbr_mask):
     """Masked softmax over the K axis ([N, K] or [N, K, H] logits) — the
     dense-layout equivalent of `segment_softmax`: attention weights over each

@@ -114,7 +114,8 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     nbr_fmt = nn["Architecture"].get(
         "neighbor_format",
         nn["Architecture"]["model_type"] in (
-            "GIN", "SAGE", "GAT", "MFC", "CGCNN", "PNA", "PNAPlus"))
+            "GIN", "SAGE", "GAT", "MFC", "CGCNN", "PNA", "PNAPlus",
+            "SchNet", "EGNN"))
     nbr_fmt = env_flag("HYDRAGNN_NEIGHBOR_FORMAT", bool(nbr_fmt))
 
     # HYDRAGNN_USE_ddstore serves training samples from the C++ DDStore

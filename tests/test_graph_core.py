@@ -242,7 +242,8 @@ def test_neighbor_aggregate_matches_segment():
 
 
 @pytest.mark.parametrize(
-    "model_type", ["GIN", "SAGE", "GAT", "MFC", "CGCNN", "PNA", "PNAPlus"])
+    "model_type", ["GIN", "SAGE", "GAT", "MFC", "CGCNN", "PNA",
+                   "PNAPlus", "SchNet", "EGNN"])
 def test_forward_matches_across_layouts(model_type):
     """Every dense-layout-capable stack must produce identical outputs from
     the edge-list and dense neighbor-list layouts (same parameters)."""
