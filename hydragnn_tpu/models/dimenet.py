@@ -85,7 +85,7 @@ class OutputPPBlock(nn.Module):
     @nn.compact
     def __call__(self, e, rbf, batch, num_nodes):
         g = nn.Dense(self.hidden, use_bias=False, name="lin_rbf")(rbf)
-        x = seg.segment_sum(g * e, batch.receivers, num_nodes, batch.edge_mask)
+        x = seg.edge_aggregate_sum(g * e, batch)
         x = nn.Dense(self.out_emb, use_bias=False, name="lin_up")(x)
         for i in range(self.num_layers):
             x = jax.nn.silu(nn.Dense(self.out_emb, name=f"lin_{i}")(x))

@@ -81,8 +81,8 @@ class MACEInteraction(nn.Module):
         h_e = {l: f[send] for l, f in h.items()}
         sh_e = {l: f[:, None, :] for l, f in sh.items()}   # mul-broadcast
         msgs = tensor_product(h_e, sh_e, self.lmax_out, weights)
-        agg = {l: seg.segment_sum(m, recv, feats[0].shape[0], batch.edge_mask)
-               / self.avg_num_neighbors for l, m in msgs.items()}
+        agg = {l: seg.edge_aggregate_sum(m, batch) / self.avg_num_neighbors
+               for l, m in msgs.items()}
         return LinearIrreps(self.mul, name="lin_out")(agg)
 
 

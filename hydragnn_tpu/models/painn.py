@@ -45,8 +45,8 @@ class PainnMessage(nn.Module):
         direction = norm_diff / jnp.maximum(dist, 1e-9)[:, None]
         msg_v = v[send] * gate_v[:, None, :] + \
             gate_e[:, None, :] * direction[:, :, None]
-        ds = seg.segment_sum(msg_s, recv, s.shape[0], batch.edge_mask)
-        dv = seg.segment_sum(msg_v, recv, s.shape[0], batch.edge_mask)
+        ds = seg.edge_aggregate_sum(msg_s, batch)
+        dv = seg.edge_aggregate_sum(msg_v, batch)
         return s + ds, v + dv
 
 

@@ -25,10 +25,17 @@ from .layers import MLP
 def degree_scaler_aggregation(h, recv, num_nodes, edge_mask, deg_hist,
                               scalers=("identity", "amplification",
                                        "attenuation", "linear",
-                                       "inverse_linear")):
+                                       "inverse_linear"), batch=None):
     """PyG DegreeScalerAggregation semantics: concat 4 aggregators, then
-    concat one scaled copy per scaler."""
-    mean, mn, mx, sd, deg = seg.pna_aggregate(h, recv, num_nodes, edge_mask)
+    concat one scaled copy per scaler. With a dense-layout `batch` the
+    statistics come from masked K-axis reductions instead of segment
+    scatters."""
+    if batch is not None and batch.nbr_edge is not None:
+        mean, mn, mx, sd, deg = seg.neighbor_aggregate(
+            h[batch.nbr_edge], batch.nbr_mask)
+    else:
+        mean, mn, mx, sd, deg = seg.pna_aggregate(h, recv, num_nodes,
+                                                  edge_mask)
     aggs = jnp.concatenate([mean, mn, mx, sd], axis=-1)
     avg_lin, avg_log = pna_degree_stats(deg_hist)
     logd = jnp.log(deg + 1.0)
@@ -73,10 +80,11 @@ class PNAEqMessage(nn.Module):
 
         msg_v = v[send] * gate_v[:, None, :] + \
             gate_e[:, None, :] * edge_vec[:, :, None]
-        dv = seg.segment_sum(msg_v, recv, x.shape[0], batch.edge_mask)
+        dv = seg.edge_aggregate_sum(msg_v, batch)
 
         agg = degree_scaler_aggregation(msg_s, recv, x.shape[0],
-                                        batch.edge_mask, self.deg_hist)
+                                        batch.edge_mask, self.deg_hist,
+                                        batch=batch)
         dx = nn.Dense(F, name="post_nn")(jnp.concatenate([x, agg], axis=-1))
         return x + dx, v + dv
 

@@ -40,9 +40,14 @@ def run_prediction(config_or_path, datasets: Optional[Tuple] = None,
     from .graphs.triplets import maybe_triplet_transform
     batch_transform = maybe_triplet_transform(
         mcfg.model_type, trainset + valset + testset, batch_size)
+    from .utils.envflags import env_flag
+    arch = config["NeuralNetwork"]["Architecture"]
+    nbr_fmt = env_flag("HYDRAGNN_NEIGHBOR_FORMAT",
+                       bool(arch.get("neighbor_format", True)))
     _, _, test_loader = create_dataloaders(trainset, valset, testset,
                                            batch_size, num_shards=1,
-                                           batch_transform=batch_transform)
+                                           batch_transform=batch_transform,
+                                           neighbor_format=nbr_fmt)
     if model is None:
         model = create_model(mcfg)
     if state is None:

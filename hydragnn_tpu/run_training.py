@@ -106,17 +106,13 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         nn["Architecture"]["model_type"], trainset + valset + testset,
         max(batch_size // max(num_shards, 1), 1))
 
-    # dense neighbor-list layout (zero-scatter aggregation): default-on for
-    # the PNA family, whose convs consume it when present; K pinned across
+    # dense neighbor-list layout (zero-scatter aggregation): default-on —
+    # every stack consumes it when present (cross-layout equivalence is
+    # tested for all 13 in tests/test_graph_core.py); K pinned across
     # splits by create_dataloaders. Architecture.neighbor_format or
     # HYDRAGNN_NEIGHBOR_FORMAT overrides.
-    from .utils.envflags import env_flag
-    nbr_fmt = nn["Architecture"].get(
-        "neighbor_format",
-        nn["Architecture"]["model_type"] in (
-            "GIN", "SAGE", "GAT", "MFC", "CGCNN", "PNA", "PNAPlus",
-            "SchNet", "EGNN"))
-    nbr_fmt = env_flag("HYDRAGNN_NEIGHBOR_FORMAT", bool(nbr_fmt))
+    nbr_fmt = bool(nn["Architecture"].get("neighbor_format", True))
+    nbr_fmt = env_flag("HYDRAGNN_NEIGHBOR_FORMAT", nbr_fmt)
 
     # HYDRAGNN_USE_ddstore serves training samples from the C++ DDStore
     # (reference: the --ddstore path wrapping datasets in DistDataset,

@@ -243,10 +243,11 @@ def test_neighbor_aggregate_matches_segment():
 
 @pytest.mark.parametrize(
     "model_type", ["GIN", "SAGE", "GAT", "MFC", "CGCNN", "PNA",
-                   "PNAPlus", "SchNet", "EGNN"])
+                   "PNAPlus", "SchNet", "EGNN", "PAINN", "PNAEq",
+                   "DimeNet", "MACE"])
 def test_forward_matches_across_layouts(model_type):
-    """Every dense-layout-capable stack must produce identical outputs from
-    the edge-list and dense neighbor-list layouts (same parameters)."""
+    """Every stack must produce identical outputs from the edge-list and
+    dense neighbor-list layouts (same parameters)."""
     import numpy as np
     from hydragnn_tpu.graphs.batch import with_neighbor_format
     from hydragnn_tpu.models.create import create_model, init_params
@@ -255,6 +256,9 @@ def test_forward_matches_across_layouts(model_type):
 
     samples = deterministic_graph_dataset(num_configs=8)
     cfg, mcfg, batch = prepare(model_type, samples)
+    if model_type == "DimeNet":
+        from hydragnn_tpu.graphs.triplets import add_triplets, triplet_budget
+        batch = add_triplets(batch, triplet_budget(samples[:8], 8))
     model = create_model(mcfg)
     variables = init_params(model, batch)
     out_edges, _ = model.apply(variables, batch, train=False)
