@@ -45,8 +45,31 @@ def synth_samples(num, rng):
     return samples
 
 
+def _probe_device_backend(timeout_s: int = 150):
+    """The axon TPU tunnel can be down; jax.devices() then hangs forever
+    inside this process. Probe it in a subprocess with a timeout and fall
+    back to CPU so the bench always emits its JSON line (the fallback is
+    visible in the metric's `backend` field)."""
+    import subprocess
+    import sys
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            timeout=timeout_s, capture_output=True, text=True)
+        if r.returncode == 0:
+            return r.stdout.strip() or "unknown"
+    except subprocess.TimeoutExpired:
+        pass
+    return None
+
+
 def main():
     import jax
+    backend = _probe_device_backend()
+    if backend is None:
+        jax.config.update("jax_platforms", "cpu")
+        backend = "cpu_fallback_tunnel_down"
     from hydragnn_tpu.config import build_model_config, update_config
     from hydragnn_tpu.graphs.batch import collate
     from hydragnn_tpu.models.create import create_model, init_params
@@ -103,6 +126,7 @@ def main():
         "value": round(gps, 2),
         "unit": "graphs/s",
         "vs_baseline": round(gps / REF_BASELINE_GPS, 4),
+        "backend": backend,
     }))
 
 
