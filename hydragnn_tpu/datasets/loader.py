@@ -61,6 +61,7 @@ class GraphDataLoader:
         self.n_edge = n_edge_per_shard
         self.n_graph = self.graphs_per_shard + 1
         self.batch_transform = batch_transform
+        self._cache: Optional[List[GraphBatch]] = None
         # dense neighbor-list layout: K is pinned ONCE from dataset-level
         # max in-degree so every batch shares one [N, K] shape (one compile)
         self.neighbor_k = None
@@ -133,6 +134,19 @@ class GraphDataLoader:
                        n_graph=self.n_graph, np_out=True)
 
     def __iter__(self) -> Iterator[GraphBatch]:
+        # non-shuffled loaders (val/test) produce identical batches every
+        # epoch — collate once and replay (the reference's DataLoader
+        # re-collates every epoch because PyG batches are cheap; padded
+        # batches are not, and they are static here)
+        from ..utils.envflags import env_flag
+        if not self.shuffle and env_flag("HYDRAGNN_CACHE_BATCHES", True):
+            if self._cache is None:
+                self._cache = list(self._iter_uncached())
+            yield from self._cache
+            return
+        yield from self._iter_uncached()
+
+    def _iter_uncached(self) -> Iterator[GraphBatch]:
         order = self._order()
         nb = len(self)
         for ib in range(nb):

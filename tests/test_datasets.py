@@ -247,3 +247,28 @@ def test_abstract_base_dataset_contract():
     from hydragnn_tpu.datasets.loader import GraphDataLoader
     loader = GraphDataLoader(ds, batch_size=4)
     assert sum(1 for _ in loader) == len(loader)
+
+
+def test_nonshuffled_loader_caches_batches(monkeypatch):
+    """Non-shuffled loaders collate once and replay identical batches each
+    epoch; HYDRAGNN_CACHE_BATCHES=0 opts out."""
+    import numpy as np
+    from hydragnn_tpu.datasets.loader import GraphDataLoader
+    from tests.deterministic_data import deterministic_graph_dataset
+
+    ds = deterministic_graph_dataset(num_configs=12)
+    loader = GraphDataLoader(ds, batch_size=4)
+    e1 = list(loader)
+    e2 = list(loader)
+    assert all(a is b for a, b in zip(e1, e2))  # replayed objects
+    np.testing.assert_array_equal(np.asarray(e1[0].x), np.asarray(e2[0].x))
+
+    monkeypatch.setenv("HYDRAGNN_CACHE_BATCHES", "0")
+    loader2 = GraphDataLoader(ds, batch_size=4)
+    f1, f2 = list(loader2), list(loader2)
+    assert all(a is not b for a, b in zip(f1, f2))
+
+    shuf = GraphDataLoader(ds, batch_size=4, shuffle=True)
+    shuf.set_epoch(0); s0 = [np.asarray(b.x).copy() for b in shuf]
+    shuf.set_epoch(1); s1 = [np.asarray(b.x).copy() for b in shuf]
+    assert any(not np.array_equal(a, b) for a, b in zip(s0, s1))
