@@ -13,7 +13,7 @@ import numpy as np
 def output_denormalize(y_minmax: Sequence[Sequence[float]],
                        true_values: List[np.ndarray],
                        predicted_values: List[np.ndarray]):
-    """Invert min-max normalization per head (reference: postprocess.py:13-54)."""
+    """Invert min-max normalization per head (reference: postprocess.py:13-26)."""
     out_t, out_p = [], []
     for ih, (t, p) in enumerate(zip(true_values, predicted_values)):
         ymin, ymax = float(y_minmax[ih][0]), float(y_minmax[ih][1])
@@ -21,3 +21,38 @@ def output_denormalize(y_minmax: Sequence[Sequence[float]],
         out_t.append(t * scale + ymin)
         out_p.append(p * scale + ymin)
     return out_t, out_p
+
+
+def unscale_features_by_num_nodes(datasets_list, scaled_index_list,
+                                  nodes_num_list):
+    """Multiply per-sample values of the selected heads by that sample's
+    node count (reference: postprocess.py:29-39 — extensive quantities
+    trained per-atom, reported per-structure)."""
+    nodes = np.asarray(nodes_num_list, np.float64)
+    out = []
+    for dataset in datasets_list:
+        scaled = list(dataset)
+        for idx in scaled_index_list:
+            head = np.asarray(scaled[idx], np.float64)
+            assert head.shape[0] == nodes.shape[0], (
+                "num-nodes unscaling applies to per-structure (graph) heads: "
+                f"head has {head.shape[0]} rows, {nodes.shape[0]} structures")
+            head = head * nodes.reshape((-1,) + (1,) * (head.ndim - 1))
+            scaled[idx] = head
+        out.append(scaled)
+    return out
+
+
+def unscale_features_by_num_nodes_config(config, datasets_list,
+                                         nodes_num_list):
+    """Heads named `*_scaled_num_nodes` are unscaled by node count
+    (reference: postprocess.py:42-55); requires denormalize_output."""
+    voi = config["NeuralNetwork"]["Variables_of_interest"]
+    names = voi["output_names"]
+    scaled_idx = [i for i, n in enumerate(names) if "_scaled_num_nodes" in n]
+    if scaled_idx:
+        assert voi.get("denormalize_output"), (
+            "Cannot unscale features without 'denormalize_output'")
+        datasets_list = unscale_features_by_num_nodes(
+            datasets_list, scaled_idx, nodes_num_list)
+    return datasets_list

@@ -181,3 +181,31 @@ def test_formation_gibbs_conversion():
     convert_total_energy_to_formation_gibbs([s], [26, 78], pure,
                                             temperature_kelvin=300.0)
     assert np.isclose(float(s.y_graph[0]), enth - 300.0 * entropy, atol=1e-5)
+
+
+def test_unscale_features_by_num_nodes():
+    """Heads named *_scaled_num_nodes are multiplied back by structure size
+    (reference: postprocess.py:29-55)."""
+    import numpy as np
+    import pytest
+    from hydragnn_tpu.postprocess.postprocess import (
+        unscale_features_by_num_nodes, unscale_features_by_num_nodes_config)
+
+    trues = [np.ones((3, 1)), np.full((3, 2), 2.0)]
+    preds = [np.ones((3, 1)) * 0.5, np.full((3, 2), 4.0)]
+    nodes = [2, 4, 8]
+    out_t, out_p = unscale_features_by_num_nodes([trues, preds], [1], nodes)
+    np.testing.assert_array_equal(np.asarray(out_t[0]), trues[0])  # untouched
+    np.testing.assert_array_equal(np.asarray(out_t[1])[:, 0], [4.0, 8.0, 16.0])
+    np.testing.assert_array_equal(np.asarray(out_p[1])[:, 0], [8.0, 16.0, 32.0])
+
+    cfg = {"NeuralNetwork": {"Variables_of_interest": {
+        "output_names": ["energy_scaled_num_nodes"],
+        "denormalize_output": True}}}
+    (t2,) = unscale_features_by_num_nodes_config(cfg, [[np.ones((3, 1))]],
+                                                 nodes)
+    np.testing.assert_array_equal(np.asarray(t2[0])[:, 0], [2.0, 4.0, 8.0])
+
+    cfg["NeuralNetwork"]["Variables_of_interest"]["denormalize_output"] = False
+    with pytest.raises(AssertionError):
+        unscale_features_by_num_nodes_config(cfg, [[np.ones((3, 1))]], nodes)
