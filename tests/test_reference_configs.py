@@ -1,0 +1,78 @@
+"""Reference-config compatibility: the upstream HydraGNN JSON configs must
+load, complete, and train UNCHANGED (the README's compatibility claim; the
+schema is reference tests/inputs/*.json + config_utils.py:24-135). These
+tests read the configs straight from the reference checkout and skip when it
+is absent (end-user installs)."""
+import glob
+import json
+import os
+
+import pytest
+
+REF_INPUTS = "/root/reference/tests/inputs"
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF_INPUTS), reason="reference checkout not present")
+
+
+def _load(name):
+    with open(os.path.join(REF_INPUTS, name)) as f:
+        return json.load(f)
+
+
+def _configs():
+    if not os.path.isdir(REF_INPUTS):
+        return []
+    return sorted(os.path.basename(p)
+                  for p in glob.glob(os.path.join(REF_INPUTS, "ci*.json")))
+
+
+@pytest.mark.parametrize("name", _configs())
+def test_reference_config_completes(name):
+    """Every upstream CI config parses and completes into a buildable model
+    config without modification."""
+    from hydragnn_tpu.config import build_model_config, update_config
+    from tests.deterministic_data import deterministic_graph_dataset
+
+    from hydragnn_tpu.config import merge_config
+
+    cfg = _load(name)
+    if "NeuralNetwork" not in cfg:
+        # overlay fragments (ci_periodic, ci_rotational_invariance hold just
+        # an Architecture section) are deep-merged over the base config, the
+        # way the reference tests consume them (merge_config,
+        # config_utils.py:338-346)
+        base = _load("ci.json")
+        cfg = merge_config(base, {"NeuralNetwork": {"Architecture":
+                                                    cfg["Architecture"]}})
+    arch = cfg["NeuralNetwork"]["Architecture"]
+    if arch.get("equivariance") and arch["model_type"] == "PNA":
+        # the reference's equivariant sweep swaps in an equivariance-capable
+        # stack at runtime (tests/test_graphs.py:230-233)
+        arch["model_type"] = "EGNN"
+    voi = cfg["NeuralNetwork"]["Variables_of_interest"]
+    heads = tuple("graph" if t == "graph" else "node" for t in voi["type"])
+    # the unit_test format generates x/x2/x3 node features + their sum as the
+    # graph target — our deterministic generator mirrors it (SURVEY.md §4)
+    samples = deterministic_graph_dataset(num_configs=12, heads=heads)
+    completed = update_config(cfg, samples)
+    mcfg = build_model_config(completed)
+    assert mcfg.model_type == arch["model_type"]
+    assert len(mcfg.heads) == len(heads)
+
+
+def test_reference_ci_config_trains_unchanged():
+    """The upstream ci.json trains end-to-end with only the epoch count
+    reduced (100 epochs -> 4 for CI speed; same schema, same keys)."""
+    from hydragnn_tpu.run_training import run_training
+    from tests.deterministic_data import deterministic_graph_dataset
+
+    cfg = _load("ci.json")
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 4
+    samples = deterministic_graph_dataset(num_configs=48, heads=("graph",))
+    tr, va, te = samples[:32], samples[32:40], samples[40:]
+    state, history, model, completed = run_training(
+        cfg, datasets=(tr, va, te), num_shards=1)
+    assert len(history["train_loss"]) <= 4
+    assert history["train_loss"][-1] < history["train_loss"][0] * 5
+    import numpy as np
+    assert all(np.isfinite(v) for v in history["train_loss"])
