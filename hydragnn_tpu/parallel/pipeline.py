@@ -1,0 +1,120 @@
+"""Pipeline (layer) parallelism for deep GNN conv stacks — GPipe over a
+``pipe`` mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.6: "NOT present");
+the technique comes from the retrieved GNNPipe work (PAPERS.md: pipelined
+model parallelism for deep GNNs). It matters when the conv stack is deep
+enough that one chip can't hold all layer parameters + activations, or to
+scale layer compute across chips without replicating every layer everywhere.
+
+Layout:
+
+* the stack's `num_layers` homogeneous conv layers are split into
+  `S = mesh.shape[axis]` contiguous stages; stage parameters are stacked on
+  a leading axis sharded over ``pipe`` (each device holds only its stage's
+  layers),
+* a batch is split into M microbatches; activations flow stage->stage with
+  `ppermute` (one ICI hop per tick) in the standard GPipe schedule:
+  `M + S - 1` ticks, stage s works on microbatch (t - s),
+* graph structure (senders/receivers/masks) for ALL microbatches is
+  replicated to every stage — index arrays are tiny next to features; only
+  the node-feature activation rides the ring.
+
+`pipeline_apply` is jit-able and differentiable (the schedule is a
+`lax.scan`), so the same function serves training. Equivalence to the
+sequential stack is tested in tests/test_pipeline.py.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def stack_stage_params(per_layer_params, num_stages: int):
+    """[L] pytrees -> pytree with leading [S, L/S] axes (stage-major), ready
+    to shard over ``pipe``. L must divide evenly into S stages."""
+    L = len(per_layer_params)
+    assert L % num_stages == 0, (
+        f"{L} layers do not split into {num_stages} equal stages")
+    per_stage = L // num_stages
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                     *per_layer_params)
+    return jax.tree_util.tree_map(
+        lambda a: a.reshape((num_stages, per_stage) + a.shape[1:]), stacked)
+
+
+def make_pipeline_apply(mesh: Mesh, layer_fn: Callable, num_layers: int,
+                        axis: str = "pipe"):
+    """Build `apply(stage_params, x_micro, structure) -> y_micro`.
+
+    layer_fn(layer_params, x, structure) -> x' applies ONE conv layer;
+    activations must keep one shape across layers (hidden_dim stacks).
+
+    * stage_params: pytree with leading [S, L/S] axes (stack_stage_params),
+      sharded over ``pipe``,
+    * x_micro: [M, ...] microbatched node features (replicated),
+    * structure: pytree of [M, ...] graph-structure arrays (replicated).
+
+    Returns [M, ...] outputs after all `num_layers` layers.
+    """
+    S = mesh.shape[axis]
+    per_stage = num_layers // S
+    assert per_stage * S == num_layers
+
+    def stage_apply(params_1stage, x, structure_t):
+        def body(h, layer_params):
+            return layer_fn(layer_params, h, structure_t), None
+        out, _ = lax.scan(body, x, params_1stage)
+        return out
+
+    def pipelined(stage_params, x_micro, structure):
+        # inside shard_map: stage_params leads with the local [1, L/S, ...]
+        my_params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+        M = x_micro.shape[0]
+        s_idx = lax.axis_index(axis)
+        right = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            inflight, outputs = carry
+            # stage 0 injects microbatch t (when valid), others take the
+            # ppermuted activation from the previous stage
+            mb = jnp.clip(t, 0, M - 1)
+            injected = x_micro[mb]
+            h = jnp.where(s_idx == 0, injected, inflight)
+            # microbatch index this stage works on at tick t
+            my_mb = jnp.clip(t - s_idx, 0, M - 1)
+            structure_t = jax.tree_util.tree_map(
+                lambda a: a[my_mb], structure)
+            h_out = stage_apply(my_params, h, structure_t)
+            valid = jnp.logical_and(t - s_idx >= 0, t - s_idx <= M - 1)
+            # last stage banks finished microbatches
+            is_last = s_idx == S - 1
+            outputs = outputs.at[my_mb].set(
+                jnp.where(jnp.logical_and(valid, is_last), h_out,
+                          outputs[my_mb]))
+            inflight = lax.ppermute(h_out, axis, right)
+            return (inflight, outputs), None
+
+        inflight0 = jnp.zeros_like(x_micro[0])
+        outputs0 = jnp.zeros_like(x_micro)
+        (_, outputs), _ = lax.scan(tick, (inflight0, outputs0),
+                                   jnp.arange(M + S - 1))
+        # outputs live on the last stage; share them with every stage so the
+        # result is replicated (one hop over ICI)
+        outputs = lax.psum(
+            jnp.where(s_idx == S - 1, outputs, jnp.zeros_like(outputs)),
+            axis)
+        return outputs
+
+    in_specs = (P(axis), P(), P())
+    return shard_map(pipelined, mesh=mesh, in_specs=in_specs, out_specs=P(),
+                     check_vma=False)

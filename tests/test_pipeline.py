@@ -1,0 +1,89 @@
+"""Pipeline (layer) parallelism: GPipe schedule over a ``pipe`` mesh axis
+must reproduce the sequential stack exactly (technique from the retrieved
+GNNPipe paper, PAPERS.md; no reference analogue — SURVEY.md §2.6 lists
+pipeline parallelism as absent upstream)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hydragnn_tpu.ops import segment as seg
+from hydragnn_tpu.parallel.mesh import make_mesh
+from hydragnn_tpu.parallel.pipeline import (make_pipeline_apply,
+                                            stack_stage_params)
+
+N, E, F = 24, 96, 8
+L = 8          # conv layers
+S = 4          # pipeline stages
+M = 6          # microbatches
+
+
+def _layer_fn(params, x, structure):
+    send, recv, mask = structure
+    agg = seg.segment_sum(x[send], recv, x.shape[0], mask)
+    return jax.nn.relu((x + agg) @ params["w"] + params["b"])
+
+
+def _random_problem(seed=0):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(M, N, F).astype(np.float32))
+    send = jnp.asarray(rng.randint(0, N, (M, E)).astype(np.int32))
+    recv = jnp.asarray(rng.randint(0, N, (M, E)).astype(np.int32))
+    mask = jnp.asarray(rng.rand(M, E) < 0.9)
+    params = [{"w": jnp.asarray(rng.randn(F, F).astype(np.float32) * 0.2),
+               "b": jnp.asarray(rng.randn(F).astype(np.float32) * 0.01)}
+              for _ in range(L)]
+    return x, (send, recv, mask), params
+
+
+def _sequential(params, x_micro, structure):
+    outs = []
+    for m in range(M):
+        h = x_micro[m]
+        st = jax.tree_util.tree_map(lambda a: a[m], structure)
+        for p in params:
+            h = _layer_fn(p, h, st)
+        outs.append(h)
+    return jnp.stack(outs)
+
+
+def test_pipeline_matches_sequential():
+    x, structure, params = _random_problem()
+    expect = _sequential(params, x, structure)
+
+    mesh = make_mesh((("pipe", S),), devices=jax.devices()[:S])
+    apply_fn = make_pipeline_apply(mesh, _layer_fn, L)
+    stacked = stack_stage_params(params, S)
+    got = apply_fn(stacked, x, structure)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_differentiable():
+    x, structure, params = _random_problem(1)
+    mesh = make_mesh((("pipe", S),), devices=jax.devices()[:S])
+    apply_fn = make_pipeline_apply(mesh, _layer_fn, L)
+    stacked = stack_stage_params(params, S)
+
+    def loss_pipe(sp):
+        return jnp.sum(apply_fn(sp, x, structure) ** 2)
+
+    def loss_seq(ps):
+        return jnp.sum(_sequential(ps, x, structure) ** 2)
+
+    g_pipe = jax.grad(loss_pipe)(stacked)
+    g_seq = jax.grad(loss_seq)(params)
+    g_seq_stacked = stack_stage_params(g_seq, S)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                    jax.tree_util.tree_leaves(g_seq_stacked)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_stack_stage_params_shape():
+    _, _, params = _random_problem(2)
+    stacked = stack_stage_params(params, S)
+    assert stacked["w"].shape == (S, L // S, F, F)
+    with pytest.raises(AssertionError):
+        stack_stage_params(params, 3)
