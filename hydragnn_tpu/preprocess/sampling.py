@@ -1,0 +1,172 @@
+"""Fixed-fanout neighbor sampling — minibatch training on one large graph.
+
+Technique from the retrieved scalable-GNN-training work (PAPERS.md: "The
+Case for Sampling", DistGNN); the reference has no analogue (its graphs are
+small molecules/supercells — SURVEY.md §5.7). For node-level tasks on a
+graph with millions of nodes, full-graph message passing cannot fit one
+chip; GraphSAGE-style sampling trains on k-hop subgraphs around seed nodes.
+
+TPU-first property: the fanout is FIXED per hop, so every sampled subgraph
+has identical array shapes — one XLA compilation for the whole run, no
+bucketing needed. The sampled layout is exactly the dense neighbor-list
+format (`GraphBatch.nbr`): hop h's table is [n_h, fanout_h] with masks,
+aggregations are masked K-axis reductions, and padding slots point at a
+sentinel node.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..graphs.batch import GraphBatch
+
+
+class CSRGraph:
+    """In-neighbor CSR adjacency for sampling: for node i,
+    senders[indptr[i]:indptr[i+1]] are its in-edge sources."""
+
+    def __init__(self, senders: np.ndarray, receivers: np.ndarray,
+                 num_nodes: int):
+        order = np.argsort(receivers, kind="stable")
+        self.senders = np.asarray(senders)[order].astype(np.int32)
+        self.indptr = np.zeros(num_nodes + 1, np.int64)
+        counts = np.bincount(receivers, minlength=num_nodes)
+        np.cumsum(counts, out=self.indptr[1:])
+        self.num_nodes = num_nodes
+
+    def sample_in_neighbors(self, nodes: np.ndarray, fanout: int,
+                            rng: np.random.RandomState):
+        """[B] nodes -> ([B, fanout] sampled senders, [B, fanout] mask).
+        Nodes with degree <= fanout take all neighbors (no replacement);
+        higher-degree nodes are subsampled uniformly."""
+        B = len(nodes)
+        nbr = np.zeros((B, fanout), np.int32)
+        mask = np.zeros((B, fanout), bool)
+        for b, n in enumerate(nodes):
+            lo, hi = self.indptr[n], self.indptr[n + 1]
+            deg = int(hi - lo)
+            if deg == 0:
+                continue
+            if deg <= fanout:
+                take = self.senders[lo:hi]
+            else:
+                take = self.senders[lo + rng.choice(deg, fanout,
+                                                    replace=False)]
+            nbr[b, :len(take)] = take
+            mask[b, :len(take)] = True
+        return nbr, mask
+
+
+def sample_khop_subgraph(csr: CSRGraph, seeds: np.ndarray,
+                         fanouts: Sequence[int],
+                         rng: np.random.RandomState):
+    """Sample the k-hop computation graph of `seeds` with fixed fanouts.
+
+    Returns (node_ids [n_total], hop_tables): layer-wise frontier expansion;
+    hop_tables[h] = (nbr_local [B_h, fanout_h], mask) with LOCAL indices
+    into node_ids, where B_h is the hop-h frontier size
+    (B_0 = len(seeds), B_{h+1} = B_h * fanout_h — fixed shapes).
+    node_ids may repeat (a node reached twice appears twice); features are
+    gathered per occurrence, which keeps shapes static without dedup maps.
+    """
+    frontiers = [np.asarray(seeds, np.int32)]
+    tables = []
+    for f in fanouts:
+        cur = frontiers[-1]
+        nbr, mask = csr.sample_in_neighbors(cur, f, rng)
+        # sampled senders join the node list after the current nodes
+        tables.append((nbr, mask))
+        frontiers.append(nbr.reshape(-1))
+    node_ids = np.concatenate([fr.reshape(-1) for fr in frontiers])
+    # local index of hop h's frontier block within node_ids
+    offsets = np.cumsum([0] + [fr.size for fr in frontiers])
+    hop_tables = []
+    for h, (nbr, mask) in enumerate(tables):
+        B = nbr.shape[0]
+        # occurrence j of hop-(h+1) block corresponds to flat position j
+        local = (offsets[h + 1]
+                 + np.arange(nbr.size, dtype=np.int32).reshape(nbr.shape))
+        hop_tables.append((local, mask))
+    return node_ids, hop_tables
+
+
+class NeighborSamplingLoader:
+    """Minibatch stream of fixed-shape k-hop subgraph batches for node-level
+    training on one big graph.
+
+    Yields (features [n_total, F], hop_tables, seed_targets [B, T]) per
+    batch; aggregation at hop h is a masked reduction over
+    features[hop_tables[h][0]] — the dense neighbor-list layout.
+    """
+
+    def __init__(self, x: np.ndarray, senders: np.ndarray,
+                 receivers: np.ndarray, y_node: np.ndarray,
+                 batch_size: int, fanouts: Sequence[int] = (8, 8),
+                 shuffle: bool = True, seed: int = 0,
+                 train_nodes: Optional[np.ndarray] = None):
+        self.x = np.asarray(x)
+        self.y = np.asarray(y_node)
+        self.csr = CSRGraph(senders, receivers, len(x))
+        self.batch_size = batch_size
+        self.fanouts = tuple(fanouts)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.train_nodes = (np.arange(len(x), dtype=np.int32)
+                            if train_nodes is None
+                            else np.asarray(train_nodes, np.int32))
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self):
+        return max(len(self.train_nodes) // self.batch_size, 1)
+
+    def __iter__(self):
+        rng = np.random.RandomState(self.seed + self.epoch)
+        order = self.train_nodes.copy()
+        if self.shuffle:
+            rng.shuffle(order)
+        for ib in range(len(self)):
+            seeds = order[ib * self.batch_size:(ib + 1) * self.batch_size]
+            if len(seeds) < self.batch_size:   # keep shapes fixed
+                seeds = np.concatenate(
+                    [seeds, order[:self.batch_size - len(seeds)]])
+            node_ids, tables = sample_khop_subgraph(
+                self.csr, seeds, self.fanouts, rng)
+            yield (self.x[node_ids], tables, self.y[seeds])
+
+
+def sage_subgraph_forward(apply_layer, params_per_hop, feats: np.ndarray,
+                          hop_tables):
+    """Reference forward for k-hop subgraph batches: aggregate the deepest
+    frontier inward until only the seed block remains (the standard
+    GraphSAGE minibatch computation). `apply_layer(params, h_self,
+    h_nbr_agg) -> h'`.
+
+    feats is [n_total, F] laid out [seeds | hop1 | hop2 | ...]; by
+    construction hop b's sampled neighbors ARE block b+1 in order, so the
+    neighbor gather is a reshape — zero indexing on device.
+    """
+    import jax.numpy as jnp
+
+    k = len(hop_tables)
+    sizes = [hop_tables[0][0].shape[0]]
+    for local, _ in hop_tables:
+        sizes.append(local.size)
+    offsets = np.cumsum([0] + sizes)
+    feats = jnp.asarray(feats)
+    hs = [feats[offsets[b]:offsets[b + 1]] for b in range(k + 1)]
+    for layer in range(k):
+        new = []
+        for b in range(k - layer):
+            _, mask = hop_tables[b]
+            B, fanout = mask.shape
+            m = jnp.asarray(mask)[..., None]
+            nbr = hs[b + 1].reshape(B, fanout, hs[b + 1].shape[-1])
+            agg = jnp.sum(jnp.where(m, nbr, 0.0), axis=1) / \
+                jnp.maximum(jnp.sum(m, axis=1), 1.0)
+            new.append(apply_layer(params_per_hop[layer], hs[b], agg))
+        hs = new
+    return hs[0]

@@ -272,3 +272,37 @@ def test_nonshuffled_loader_caches_batches(monkeypatch):
     shuf.set_epoch(0); s0 = [np.asarray(b.x).copy() for b in shuf]
     shuf.set_epoch(1); s1 = [np.asarray(b.x).copy() for b in shuf]
     assert any(not np.array_equal(a, b) for a, b in zip(s0, s1))
+
+
+def test_training_through_custom_dataset_class():
+    """End-to-end training through an AbstractBaseDataset subclass — the
+    reference's dataset-class inheritance path
+    (tests/test_datasetclass_inheritance.py)."""
+    import numpy as np
+    from hydragnn_tpu.datasets import AbstractBaseDataset
+    from hydragnn_tpu.run_training import run_training
+    from tests.deterministic_data import deterministic_graph_dataset
+    from tests.utils import make_config
+
+    class InMemoryDataset(AbstractBaseDataset):
+        def __init__(self, samples):
+            super().__init__()
+            self.dataset.extend(samples)
+
+        def get(self, idx):
+            return self.dataset[idx]
+
+        def len(self):
+            return len(self.dataset)
+
+    samples = deterministic_graph_dataset(num_configs=24)
+    ds = InMemoryDataset(samples)
+    tr = InMemoryDataset(samples[:16])
+    va = InMemoryDataset(samples[16:20])
+    te = InMemoryDataset(samples[20:])
+    cfg = make_config("SAGE", heads=("graph",))
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 2
+    _, history, _, _ = run_training(cfg, datasets=(tr, va, te), num_shards=1)
+    assert len(history["train_loss"]) == 2
+    assert all(np.isfinite(v) for v in history["train_loss"])
+    assert ds.len() == 24
