@@ -1,4 +1,5 @@
 from .base import AbstractBaseDataset
+from .rawdataset import AbstractRawDataset, RawSample
 from .gsdataset import GraphStoreDataset, GraphStoreWriter
 from .pickledataset import SimplePickleDataset, SimplePickleWriter
 from .lsmsdataset import LSMSDataset, load_lsms_splits

@@ -1,0 +1,125 @@
+"""`AbstractRawDataset` — the user-extensible raw→graph dataset pipeline.
+
+reference: hydragnn/utils/datasets/abstractrawdataset.py:29-404 — users
+implement one hook, `transform_input_to_data_object_base(filepath)`, and the
+base class handles: per-split directory scanning (with optional distributed
+file sharding and subsampling), dataset-wide min-max feature normalization
+(recording `minmax_node_feature`/`minmax_graph_feature` for later
+denormalization), optional per-num-nodes scaling of extensive graph targets,
+and radius-graph/PBC edge construction with configured descriptors.
+
+Here the hook returns a `RawSample` (features + positions + targets, no
+edges); edge building runs through `preprocess.transforms.build_graph_sample`
+(the same path every other loader uses), so samples land in the standard
+`GraphSample` layout ready for the padded batcher.
+"""
+from __future__ import annotations
+
+import os
+import random
+from abc import abstractmethod
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..graphs.batch import GraphSample
+from .base import AbstractBaseDataset
+
+
+@dataclass
+class RawSample:
+    """What the user hook returns: one structure before graph construction
+    (the analogue of the reference hook's torch_geometric Data with x/pos/y
+    but no edges)."""
+    node_features: np.ndarray              # [n, C_node]
+    pos: np.ndarray                        # [n, 3]
+    graph_features: Optional[np.ndarray] = None   # [C_graph]
+    cell: Optional[np.ndarray] = None      # [3, 3] for PBC
+    forces: Optional[np.ndarray] = None    # [n, 3]
+    energy: Optional[float] = None
+
+
+class AbstractRawDataset(AbstractBaseDataset):
+    """reference: AbstractRawDataset (abstractrawdataset.py:29)."""
+
+    def __init__(self, config: Dict, dist: bool = False,
+                 sampling: Optional[float] = None):
+        super().__init__()
+        self.config = config
+        ds = config["Dataset"]
+        self.normalize = bool(ds.get("normalize_features", False))
+        self.minmax_node_feature = None
+        self.minmax_graph_feature = None
+        raws: List[RawSample] = []
+        path_dict = ds["path"]
+        if isinstance(path_dict, str):
+            path_dict = {"total": path_dict}
+        for _split, raw_path in sorted(path_dict.items()):
+            if not os.path.isabs(raw_path):
+                raw_path = os.path.join(os.getcwd(), raw_path)
+            if not os.path.isdir(raw_path):
+                raise ValueError(f"Folder not found: {raw_path}")
+            filelist = sorted(os.listdir(raw_path))
+            assert filelist, f"No data files provided in {raw_path}!"
+            if dist:
+                # deterministic shuffle then per-process shard
+                # (reference: :158-176 — seed 43, nsplit over world)
+                random.Random(43).shuffle(filelist)
+                if sampling is not None:
+                    filelist = filelist[:max(int(len(filelist) * sampling), 1)]
+                import jax
+                world, rank = jax.process_count(), jax.process_index()
+                filelist = filelist[rank::world]
+            for name in filelist:
+                fp = os.path.join(raw_path, name)
+                if not os.path.isfile(fp) or name == ".DS_Store":
+                    continue
+                raw = self.transform_input_to_data_object_base(filepath=fp)
+                if raw is not None:
+                    raws.append(raw)
+        if self.normalize:
+            self._normalize(raws)
+        for raw in raws:
+            self.dataset.append(self._build(raw))
+
+    # ------------------------------------------------------------- hook --
+    @abstractmethod
+    def transform_input_to_data_object_base(
+            self, filepath: str) -> Optional[RawSample]:
+        """Parse one raw file into a RawSample (or None to skip it)
+        (reference: abstractrawdataset.py:292-294)."""
+
+    # -------------------------------------------------------- pipeline --
+    def _normalize(self, raws: List[RawSample]):
+        """Dataset-wide column min-max to [0, 1], recording the ranges
+        (reference: __normalize_dataset, abstractrawdataset.py:207-289)."""
+        node_all = np.concatenate([r.node_features for r in raws], axis=0)
+        nmin, nmax = node_all.min(0), node_all.max(0)
+        self.minmax_node_feature = np.stack([nmin, nmax])
+        nscale = np.where(nmax > nmin, nmax - nmin, 1.0)
+        for r in raws:
+            r.node_features = ((r.node_features - nmin) / nscale).astype(
+                np.float32)
+        if raws[0].graph_features is not None:
+            g_all = np.stack([r.graph_features for r in raws])
+            gmin, gmax = g_all.min(0), g_all.max(0)
+            self.minmax_graph_feature = np.stack([gmin, gmax])
+            gscale = np.where(gmax > gmin, gmax - gmin, 1.0)
+            for r in raws:
+                r.graph_features = ((r.graph_features - gmin) / gscale
+                                    ).astype(np.float32)
+
+    def _build(self, raw: RawSample) -> GraphSample:
+        from ..preprocess.transforms import build_graph_sample
+        return build_graph_sample(
+            np.asarray(raw.node_features, np.float32),
+            np.asarray(raw.pos, np.float32), self.config,
+            graph_feats=raw.graph_features, cell=raw.cell,
+            forces=raw.forces, energy=raw.energy)
+
+    def get(self, idx):
+        return self.dataset[idx]
+
+    def len(self):
+        return len(self.dataset)
