@@ -52,6 +52,7 @@ class AbstractRawDataset(AbstractBaseDataset):
         self.minmax_node_feature = None
         self.minmax_graph_feature = None
         raws: List[RawSample] = []
+        parse_err: Optional[Exception] = None
         path_dict = ds["path"]
         if isinstance(path_dict, str):
             path_dict = {"total": path_dict}
@@ -60,7 +61,10 @@ class AbstractRawDataset(AbstractBaseDataset):
                 raw_path = os.path.join(os.getcwd(), raw_path)
             if not os.path.isdir(raw_path):
                 raise ValueError(f"Folder not found: {raw_path}")
-            filelist = sorted(os.listdir(raw_path))
+            filelist = sorted(
+                name for name in os.listdir(raw_path)
+                if os.path.isfile(os.path.join(raw_path, name))
+                and name != ".DS_Store")
             assert filelist, f"No data files provided in {raw_path}!"
             if dist:
                 # deterministic shuffle then per-process shard
@@ -70,14 +74,44 @@ class AbstractRawDataset(AbstractBaseDataset):
                     filelist = filelist[:max(int(len(filelist) * sampling), 1)]
                 import jax
                 world, rank = jax.process_count(), jax.process_index()
+                # every rank sees the same listing, so this raises (or not)
+                # consistently across ranks — an empty shard would otherwise
+                # deadlock the min-max collective below
+                if len(filelist) < world:
+                    raise ValueError(
+                        f"{raw_path}: {len(filelist)} raw files (after "
+                        f"sampling) for {world} processes; every rank needs "
+                        "at least one file — reduce the process count or "
+                        "raise the sampling fraction")
                 filelist = filelist[rank::world]
             for name in filelist:
                 fp = os.path.join(raw_path, name)
-                if not os.path.isfile(fp) or name == ".DS_Store":
+                if not os.path.isfile(fp):  # deleted since the listdir
                     continue
-                raw = self.transform_input_to_data_object_base(filepath=fp)
+                try:
+                    raw = self.transform_input_to_data_object_base(
+                        filepath=fp)
+                except Exception as exc:  # noqa: BLE001
+                    if not dist:
+                        raise  # single process: fail fast
+                    # dist: defer so the failure is exchanged with the
+                    # peers before any collective (see _validate) instead
+                    # of stranding them in it
+                    parse_err = parse_err or ValueError(
+                        f"transform_input_to_data_object_base failed on "
+                        f"{fp}: {type(exc).__name__}: {exc}")
+                    continue
                 if raw is not None:
+                    if raw.graph_features is not None:
+                        # enforce the documented 1-D [C_graph] contract —
+                        # a 2-D array would alias whole rows in the
+                        # per-num-nodes column scaling below
+                        raw.graph_features = np.asarray(
+                            raw.graph_features, np.float32).ravel()
                     raws.append(raw)
+        self._dist = dist
+        self._validate(raws, sorted(path_dict.values()), parse_err)
+        self._scale_features_by_num_nodes(raws)
         if self.normalize:
             self._normalize(raws)
         for raw in raws:
@@ -91,11 +125,151 @@ class AbstractRawDataset(AbstractBaseDataset):
         (reference: abstractrawdataset.py:292-294)."""
 
     # -------------------------------------------------------- pipeline --
+    def _validate(self, raws: List[RawSample], paths,
+                  parse_err: Optional[Exception] = None):
+        """Empty-shard / parse-failure / mixed-graph-features / feature-width
+        checks. Under dist with multiple processes the statuses are
+        allgathered first so every rank raises (or not) together — a
+        rank-local raise around the min-max collectives below would leave
+        the peer processes hanging in them."""
+        n, n_with_graph = len(raws), sum(
+            r.graph_features is not None for r in raws)
+        node_ws = {r.node_features.shape[1] for r in raws}
+        graph_ws = {int(np.size(r.graph_features)) for r in raws
+                    if r.graph_features is not None}
+        if parse_err is None:
+            for what, ws in (("node_features", node_ws),
+                             ("graph_features", graph_ws)):
+                if len(ws) > 1:
+                    parse_err = ValueError(
+                        f"{what} width differs between samples "
+                        f"({sorted(ws)}) — the hook must return the same "
+                        "feature layout for every file")
+        node_w = node_ws.pop() if len(node_ws) == 1 else -1
+        graph_w = graph_ws.pop() if len(graph_ws) == 1 else -1
+        import jax
+        if self._dist and jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            status = multihost_utils.process_allgather(np.asarray(
+                [n, n_with_graph, node_w, graph_w, parse_err is not None],
+                np.int32))
+            bad = [int(p) for p in np.nonzero(status[:, 4])[0]]
+            if bad:
+                raise parse_err if parse_err is not None else ValueError(
+                    f"raw parsing failed on process(es) {bad} — see their "
+                    "logs for the underlying error")
+            n_min = int(status[:, 0].min())
+            n, n_with_graph = int(status[:, 0].sum()), int(status[:, 1].sum())
+            # disagreeing feature widths would desync the min-max
+            # collectives below (and any later rank-local width raise);
+            # fail consistently on every rank instead
+            for col, what in ((2, "node_features"), (3, "graph_features")):
+                # -1 = rank with no samples / no graph features; those are
+                # diagnosed by the clearer checks below
+                widths = {int(w) for w in status[:, col] if w >= 0}
+                if len(widths) > 1:
+                    raise ValueError(
+                        f"{what} width differs across processes "
+                        f"({sorted(widths)}) — the hook must return the "
+                        "same feature layout everywhere")
+        else:
+            if parse_err is not None:
+                raise parse_err
+            n_min = n
+        if n == 0 or n_min == 0:
+            raise ValueError(
+                f"no samples parsed from {paths}"
+                + (" on at least one process" if n else "")
+                + " — every transform_input_to_data_object_base call "
+                "returned None or the directories held no regular files")
+        if n_with_graph not in (0, n):
+            raise ValueError(
+                f"{n_with_graph}/{n} raw samples carry graph_features; all "
+                "or none must (check the "
+                "transform_input_to_data_object_base hook)")
+
+    def _feature_blocks(self, key: str):
+        """(name, start, end) column blocks from Dataset.<key>.{name,dim}.
+        Falls back to dim=1 per listed name when dims are absent."""
+        spec = self.config["Dataset"].get(key) or {}
+        names = list(spec.get("name") or [])
+        if not names:  # unnamed features: nothing can ask for scaling
+            return []
+        dims = list(spec.get("dim") or [1] * len(names))
+        if len(dims) != len(names):
+            raise ValueError(
+                f"Dataset.{key}: {len(names)} names but {len(dims)} dims — "
+                "the lists must align")
+        blocks, start = [], 0
+        for name, d in zip(names, dims):
+            blocks.append((name, start, start + int(d)))
+            start += int(d)
+        return blocks
+
+    def _scale_features_by_num_nodes(self, raws: List[RawSample]):
+        """Features named `*_scaled_num_nodes` are divided by the sample's
+        node count before normalization (reference:
+        __scale_features_by_num_nodes, abstractrawdataset.py:296-319; the
+        reference indexes by feature position, which only matches columns
+        for dim-1 features — here the full column block is scaled).
+        Postprocess undoes this via unscale_features_by_num_nodes."""
+        gblocks = [b for b in self._feature_blocks("graph_features")
+                   if "_scaled_num_nodes" in b[0]]
+        nblocks = [b for b in self._feature_blocks("node_features")
+                   if "_scaled_num_nodes" in b[0]]
+        if not gblocks and not nblocks:
+            return
+        first = raws[0]
+        g_declared = max((e for _, _, e in gblocks), default=0)
+        if (gblocks and first.graph_features is not None
+                and g_declared > np.size(first.graph_features)):
+            raise ValueError(
+                f"Dataset.graph_features declares columns up to "
+                f"{g_declared} but the hook returns "
+                f"{np.size(first.graph_features)} — a *_scaled_num_nodes "
+                "block would be silently skipped")
+        n_declared = max((e for _, _, e in nblocks), default=0)
+        if nblocks and n_declared > first.node_features.shape[1]:
+            raise ValueError(
+                f"Dataset.node_features declares columns up to "
+                f"{n_declared} but the hook returns "
+                f"{first.node_features.shape[1]} — a *_scaled_num_nodes "
+                "block would be silently skipped")
+        for r in raws:
+            num_nodes = r.node_features.shape[0]
+            if gblocks and r.graph_features is not None:
+                gf = np.array(r.graph_features, np.float32)
+                for _, s, e in gblocks:
+                    gf[s:e] /= num_nodes
+                r.graph_features = gf
+            if nblocks:
+                nf = np.array(r.node_features, np.float32)
+                for _, s, e in nblocks:
+                    nf[:, s:e] /= num_nodes
+                r.node_features = nf
+
+    def _host_minmax_reduce(self, mn: np.ndarray, mx: np.ndarray):
+        """Global min/max across jax processes (reference: the dist
+        comm_reduce MIN/MAX calls in __normalize_dataset,
+        abstractrawdataset.py:247-261); no-op single-process."""
+        import jax
+        if not self._dist or jax.process_count() == 1:
+            return mn, mx
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(
+            np.stack([mn, mx]).astype(np.float32))
+        return gathered[:, 0].min(0), gathered[:, 1].max(0)
+
     def _normalize(self, raws: List[RawSample]):
         """Dataset-wide column min-max to [0, 1], recording the ranges
-        (reference: __normalize_dataset, abstractrawdataset.py:207-289)."""
-        node_all = np.concatenate([r.node_features for r in raws], axis=0)
-        nmin, nmax = node_all.min(0), node_all.max(0)
+        (reference: __normalize_dataset, abstractrawdataset.py:207-289 —
+        the reference reduces per feature *block*; per-column is identical
+        for the common dim-1 features and strictly tighter otherwise).
+        With dist=True the ranges are reduced across all processes so every
+        rank normalizes identically."""
+        nmin = np.min([r.node_features.min(0) for r in raws], axis=0)
+        nmax = np.max([r.node_features.max(0) for r in raws], axis=0)
+        nmin, nmax = self._host_minmax_reduce(nmin, nmax)
         self.minmax_node_feature = np.stack([nmin, nmax])
         nscale = np.where(nmax > nmin, nmax - nmin, 1.0)
         for r in raws:
@@ -103,7 +277,7 @@ class AbstractRawDataset(AbstractBaseDataset):
                 np.float32)
         if raws[0].graph_features is not None:
             g_all = np.stack([r.graph_features for r in raws])
-            gmin, gmax = g_all.min(0), g_all.max(0)
+            gmin, gmax = self._host_minmax_reduce(g_all.min(0), g_all.max(0))
             self.minmax_graph_feature = np.stack([gmin, gmax])
             gscale = np.where(gmax > gmin, gmax - gmin, 1.0)
             for r in raws:

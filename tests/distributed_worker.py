@@ -85,8 +85,51 @@ def main():
     # local replica (global arrays can't be fetched whole from one host)
     loss = float(np.asarray(metrics["loss"].addressable_data(0)))
 
+    # AbstractRawDataset dist=True: each process loads its file shard but
+    # the min-max ranges must be reduced across processes so normalization
+    # is identical everywhere (reference: abstractrawdataset.py:247-261)
+    import tempfile
+    from hydragnn_tpu.datasets import AbstractRawDataset, RawSample
+    base = os.path.join(tempfile.gettempdir(),
+                        f"rawds_{os.environ['TEST_COORD_PORT']}")
+    stage = base + f"-stage{rank}"  # staging outside the scanned dir: a
+    os.makedirs(base, exist_ok=True)  # half-written .npz must never be
+    os.makedirs(stage, exist_ok=True)  # visible to the other rank's listdir
+    rng2 = np.random.RandomState(7)
+    for i in range(6):
+        n = 5 + (i % 3)
+        payload = dict(pos=rng2.rand(n, 3) * 2,
+                       feat=rng2.rand(n, 2) * 10 + 3 * i,
+                       y=np.asarray([9.0 * i], np.float32))
+        tmpf = os.path.join(stage, f"s{i}")
+        np.savez(tmpf, **payload)  # both ranks write identical bytes
+        os.replace(tmpf + ".npz", os.path.join(base, f"s{i}.npz"))
+    multihost_utils.sync_global_devices("rawds_files_written")
+
+    class NpzDS(AbstractRawDataset):
+        def transform_input_to_data_object_base(self, filepath):
+            if not filepath.endswith(".npz"):
+                return None
+            d = np.load(filepath)
+            return RawSample(node_features=d["feat"], pos=d["pos"],
+                             graph_features=np.asarray(d["y"], np.float32))
+
+    rcfg = make_config("GIN", heads=("graph",), radius=1.5)
+    rcfg["Dataset"] = {
+        "path": {"total": base},
+        "normalize_features": True,
+        "node_features": {"dim": [2], "column_index": [0]},
+        "graph_features": {"dim": [1], "column_index": [0]},
+    }
+    rds = NpzDS(rcfg, dist=True)
+
     print(json.dumps({"rank": rank, "world": world, "devices": ndev,
-                      "psum": total, "loss": round(loss, 6)}))
+                      "psum": total, "loss": round(loss, 6),
+                      "raw_len": rds.len(),
+                      "raw_minmax_node":
+                          np.round(rds.minmax_node_feature, 5).tolist(),
+                      "raw_minmax_graph":
+                          np.round(rds.minmax_graph_feature, 5).tolist()}))
 
 
 if __name__ == "__main__":

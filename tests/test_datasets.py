@@ -354,3 +354,147 @@ def test_abstract_raw_dataset_pipeline(tmp_path):
     splits = split_dataset(list(ds), 0.7)
     _, history, _, _ = run_training(cfg, datasets=splits, num_shards=1)
     assert all(np.isfinite(v) for v in history["train_loss"])
+
+
+def test_abstract_raw_dataset_scaling_and_validation(tmp_path):
+    """Per-num-nodes forward scaling of `*_scaled_num_nodes` features
+    (reference: __scale_features_by_num_nodes, abstractrawdataset.py:296-319)
+    plus the clear errors for empty / inconsistent hook output."""
+    import numpy as np
+    import pytest
+    from hydragnn_tpu.datasets import AbstractRawDataset, RawSample
+    from tests.utils import make_config
+
+    rng = np.random.RandomState(1)
+    rawdir = tmp_path / "raw"
+    rawdir.mkdir()
+    sizes = [5, 7, 9, 6]
+    for i, n in enumerate(sizes):
+        np.savez(rawdir / f"s{i}.npz", pos=rng.rand(n, 3) * 2,
+                 feat=rng.rand(n, 1), y=[100.0 * (i + 1)])
+
+    class NpzDataset(AbstractRawDataset):
+        def transform_input_to_data_object_base(self, filepath):
+            if not filepath.endswith(".npz"):
+                return None
+            d = np.load(filepath)
+            return RawSample(node_features=d["feat"], pos=d["pos"],
+                             graph_features=np.asarray(d["y"], np.float32))
+
+    cfg = make_config("GIN", heads=("graph",), radius=1.5)
+    cfg["Dataset"] = {
+        "path": {"total": str(rawdir)},
+        "normalize_features": False,
+        "node_features": {"name": ["f"], "dim": [1], "column_index": [0]},
+        "graph_features": {"name": ["energy_scaled_num_nodes"], "dim": [1],
+                           "column_index": [0]},
+    }
+    ds = NpzDataset(cfg)
+    got = sorted(float(s.y_graph[0]) for s in ds)
+    want = sorted(100.0 * (i + 1) / n for i, n in enumerate(sizes))
+    assert np.allclose(got, want), (got, want)
+
+    # unscaled when the name doesn't ask for it
+    cfg["Dataset"]["graph_features"]["name"] = ["energy"]
+    ds2 = NpzDataset(cfg)
+    assert sorted(float(s.y_graph[0]) for s in ds2) == [100.0, 200.0,
+                                                        300.0, 400.0]
+
+    # mixed graph_features presence -> clear error
+    class MixedDataset(NpzDataset):
+        def transform_input_to_data_object_base(self, filepath):
+            raw = super().transform_input_to_data_object_base(filepath)
+            if raw is not None and filepath.endswith("s0.npz"):
+                raw.graph_features = None
+            return raw
+
+    with pytest.raises(ValueError, match="all or none"):
+        MixedDataset(cfg)
+
+    # every hook call returning None -> clear error
+    class EmptyDataset(AbstractRawDataset):
+        def transform_input_to_data_object_base(self, filepath):
+            return None
+
+    with pytest.raises(ValueError, match="no samples parsed"):
+        EmptyDataset(cfg)
+
+
+def test_raw_dataset_feature_block_mismatch(tmp_path):
+    """Misaligned Dataset name/dim lists raise instead of silently dropping
+    trailing features from per-num-nodes scaling."""
+    import numpy as np
+    import pytest
+    from hydragnn_tpu.datasets import AbstractRawDataset, RawSample
+    from tests.utils import make_config
+
+    rawdir = tmp_path / "raw"
+    rawdir.mkdir()
+    np.savez(rawdir / "s0.npz", pos=np.random.rand(5, 3),
+             feat=np.random.rand(5, 1), y=[1.0])
+
+    class NpzDataset(AbstractRawDataset):
+        def transform_input_to_data_object_base(self, filepath):
+            d = np.load(filepath)
+            return RawSample(node_features=d["feat"], pos=d["pos"],
+                             graph_features=np.asarray(d["y"], np.float32))
+
+    cfg = make_config("GIN", heads=("graph",), radius=1.5)
+    cfg["Dataset"] = {
+        "path": {"total": str(rawdir)}, "normalize_features": False,
+        "node_features": {"name": ["f"], "dim": [1], "column_index": [0]},
+        "graph_features": {"name": ["a", "b_scaled_num_nodes"], "dim": [1],
+                           "column_index": [0]},
+    }
+    with pytest.raises(ValueError, match="must align"):
+        NpzDataset(cfg)
+
+
+def test_raw_dataset_2d_graph_features_and_width_divergence(tmp_path):
+    """2-D graph_features from the hook are flattened to the documented
+    [C_graph] layout (column scaling must not alias rows), and
+    within-dataset feature-width divergence raises the layout error."""
+    import numpy as np
+    import pytest
+    from hydragnn_tpu.datasets import AbstractRawDataset, RawSample
+    from tests.utils import make_config
+
+    rawdir = tmp_path / "raw"
+    rawdir.mkdir()
+    rng = np.random.RandomState(2)
+    for i, n in enumerate([5, 7]):
+        np.savez(rawdir / f"s{i}.npz", pos=rng.rand(n, 3),
+                 feat=rng.rand(n, 1), y=[[10.0 * n, 3.0]])  # note: 2-D y
+
+    class TwoDDataset(AbstractRawDataset):
+        def transform_input_to_data_object_base(self, filepath):
+            d = np.load(filepath)
+            return RawSample(node_features=d["feat"], pos=d["pos"],
+                             graph_features=np.asarray(d["y"], np.float32))
+
+    cfg = make_config("GIN", heads=("graph",), radius=1.5)
+    cfg["Dataset"] = {
+        "path": {"total": str(rawdir)}, "normalize_features": False,
+        "node_features": {"name": ["f"], "dim": [1], "column_index": [0]},
+        "graph_features": {"name": ["e_scaled_num_nodes", "gap"],
+                           "dim": [1, 1], "column_index": [0]},
+    }
+    ds = TwoDDataset(cfg)
+    # column 0 scaled by num_nodes (10n/n = 10)
+    assert [float(s.y_graph[0]) for s in ds] == [10.0, 10.0]
+    # column 1 ("gap") untouched — row-aliasing would have divided it too
+    import copy
+    cfg1 = copy.deepcopy(cfg)
+    cfg1["NeuralNetwork"]["Variables_of_interest"]["output_index"] = [1]
+    ds1 = TwoDDataset(cfg1)
+    assert [float(s.y_graph[0]) for s in ds1] == [3.0, 3.0]
+
+    class DivergentDataset(TwoDDataset):
+        def transform_input_to_data_object_base(self, filepath):
+            raw = super().transform_input_to_data_object_base(filepath)
+            if filepath.endswith("s1.npz"):
+                raw.node_features = np.tile(raw.node_features, (1, 2))
+            return raw
+
+    with pytest.raises(ValueError, match="width differs between samples"):
+        DivergentDataset(cfg)

@@ -42,3 +42,9 @@ def test_two_process_distributed():
         assert o["psum"] == 3.0  # (0+1) + (1+1)
     # single-controller SPMD: both processes computed the same global loss
     assert outs[0]["loss"] == outs[1]["loss"]
+    # raw-dataset sharding: 6 files split across 2 ranks, but the min-max
+    # normalization ranges are globally reduced -> identical on both
+    assert outs[0]["raw_len"] + outs[1]["raw_len"] == 6
+    assert 0 < outs[0]["raw_len"] < 6
+    assert outs[0]["raw_minmax_node"] == outs[1]["raw_minmax_node"]
+    assert outs[0]["raw_minmax_graph"] == outs[1]["raw_minmax_graph"]
