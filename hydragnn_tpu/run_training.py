@@ -24,7 +24,7 @@ from .train.train_step import TrainState, make_eval_step, make_train_step
 from .train.trainer import train_validate_test
 from .utils import profiling as tr
 from .utils.checkpoint import save_model
-from .utils.print_utils import print_peak_memory, setup_log
+from .utils.print_utils import log, print_peak_memory, setup_log
 
 
 def _load_datasets_from_config(config):
@@ -165,6 +165,22 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         eval_step = make_eval_step(model, mcfg, loss_name,
                                    compute_grad_energy=cge)
 
+    # steps-per-call dispatch batching: scan S optimizer steps per device
+    # call (Training.steps_per_call / HYDRAGNN_STEPS_PER_CALL). Identical
+    # math to the per-batch loop; amortizes host dispatch latency.
+    multi_step = None
+    spc_env = env_int("HYDRAGNN_STEPS_PER_CALL")
+    steps_per_call = (spc_env if spc_env is not None  # env overrides config
+                      else int(train_cfg.get("steps_per_call", 1)))
+    if num_shards == 1 and steps_per_call > 1:
+        from .train.train_step import make_multi_train_step
+        multi_step = make_multi_train_step(model, mcfg, tx,
+                                           loss_name=loss_name,
+                                           compute_grad_energy=cge)
+    elif steps_per_call > 1:
+        log(f"steps_per_call={steps_per_call} ignored: dispatch batching "
+            "is not yet available on the SPMD multi-shard path")
+
     ckpt_fn = None
     if train_cfg.get("Checkpoint", False):
         ckpt_fn = lambda s, e, v: save_model(s, log_name)
@@ -220,7 +236,8 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         use_early_stopping=bool(train_cfg.get("EarlyStopping", False)),
         checkpoint_warmup=int(train_cfg.get("checkpoint_warmup", 0)),
         checkpoint_fn=ckpt_fn, verbosity=verbosity, tracer=tr.get(),
-        place_fn=place_fn, profiler=profiler, walltime_deadline=deadline)
+        place_fn=place_fn, profiler=profiler, walltime_deadline=deadline,
+        multi_train_step=multi_step, steps_per_call=steps_per_call)
 
     if train_cfg.get("Checkpoint", False):
         save_model(state, log_name)

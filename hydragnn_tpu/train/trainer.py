@@ -14,6 +14,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..datasets.loader import prefetch_to_device
@@ -112,6 +113,8 @@ def train_validate_test(
     keep_best: bool = True,
     place_fn: Optional[Callable] = None,
     profiler=None,
+    multi_train_step: Optional[Callable] = None,
+    steps_per_call: int = 1,
 ):
     """Returns (final_state, history dict). With `keep_best` the returned
     state is the best-validation one (mirrors the reference's best-val
@@ -140,6 +143,21 @@ def train_validate_test(
     trace_level = env_int("HYDRAGNN_TRACE_LEVEL", 0)
     prefetch_depth = max(env_int("HYDRAGNN_NUM_WORKERS", 2), 1)
 
+    def _group_batches(loader, size):
+        """Group the loader's fixed-shape batches into [S, ...]-stacked
+        pytrees for the scanned multi-step (datasets.loader._stack_batches
+        handles Optional GraphBatch fields); the remainder group keeps its
+        own (smaller) leading size."""
+        from ..datasets.loader import _stack_batches
+        buf = []
+        for b in loader:
+            buf.append(b)
+            if len(buf) == size:
+                yield _stack_batches(buf)
+                buf = []
+        if buf:
+            yield _stack_batches(buf)
+
     def _timed_stream(stream):
         it = iter(stream)
         while True:
@@ -163,21 +181,65 @@ def train_validate_test(
             # double-buffered device prefetch only when the caller supplies
             # a placement (meshes need mesh-aware sharding; committing to a
             # single device would break multi-device shard_map steps)
-            stream = (prefetch_to_device(train_loader, size=prefetch_depth,
+            source = train_loader
+            group = (multi_train_step is not None and steps_per_call > 1)
+            if group:
+                # steps-per-call batching: stack S host batches on the
+                # leading axis; one device dispatch then scans S optimizer
+                # steps (train_step.make_multi_train_step) — amortizes
+                # per-dispatch latency that the reference's per-batch loop
+                # pays every batch (train_validate_test.py:483-545)
+                source = _group_batches(train_loader, steps_per_call)
+            # prefetch depth is sized in single batches; a queued group
+            # holds S of them, so scale down to keep device memory flat
+            depth = (max(1, prefetch_depth // steps_per_call) if group
+                     else prefetch_depth)
+            stream = (prefetch_to_device(source, size=depth,
                                          place_fn=place_fn)
-                      if place_fn is not None else train_loader)
+                      if place_fn is not None else source)
             if trace_level > 0:
                 stream = _timed_stream(stream)
+            n_items = len(train_loader)
+            if group:
+                n_items = -(-n_items // steps_per_call)  # stacked groups
             for batch in iterate_tqdm(stream, verbosity,
                                       desc=f"epoch {epoch} train",
-                                      total=len(train_loader)):
+                                      total=n_items):
+                full_group = (group
+                              and batch.x.shape[0] == steps_per_call
+                              and (max_num_batch is None
+                                   or nb + steps_per_call <= max_num_batch))
                 with tr.timer("train_step"):
-                    state, metrics = train_step(state, batch)
-                tot += float(metrics["loss"])
-                for k, v in metrics.items():
-                    if k.startswith("task_") or k.endswith("_loss"):
-                        task_tot[k] = task_tot.get(k, 0.0) + float(v)
-                nb += 1
+                    if full_group:
+                        state, metrics = multi_train_step(state, batch)
+                        metrics = {k: float(jnp.sum(v))
+                                   for k, v in metrics.items()}
+                        nb += steps_per_call
+                    elif group:
+                        # remainder group, or a max_num_batch cap inside
+                        # this group: single steps (a smaller scan would
+                        # trigger one more long compile)
+                        nsteps = batch.x.shape[0]
+                        acc: Dict[str, float] = {}
+                        for i in range(nsteps):
+                            if (max_num_batch is not None
+                                    and nb >= max_num_batch):
+                                break
+                            b_i = jax.tree_util.tree_map(
+                                lambda a, i=i: a[i], batch)
+                            state, m = train_step(state, b_i)
+                            for k, v in m.items():
+                                acc[k] = acc.get(k, 0.0) + float(v)
+                            nb += 1
+                        metrics = acc
+                    else:
+                        state, metrics = train_step(state, batch)
+                        nb += 1
+                if metrics:  # empty when the cap zeroed a remainder group
+                    tot += float(metrics["loss"])
+                    for k, v in metrics.items():
+                        if k.startswith("task_") or k.endswith("_loss"):
+                            task_tot[k] = task_tot.get(k, 0.0) + float(v)
                 if max_num_batch is not None and nb >= max_num_batch:
                     break
         train_loss = tot / max(nb, 1)
