@@ -75,7 +75,8 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(batch, mesh: Mesh, axis: str = "data"):
+def shard_batch(batch, mesh: Mesh, axis: str = "data",
+                spec: Optional[P] = None):
     """Place a GraphBatch with every leading dim sharded over `axis`.
 
     All GraphBatch arrays lead with a padded N/E/G dim that is a multiple of
@@ -83,9 +84,16 @@ def shard_batch(batch, mesh: Mesh, axis: str = "data"):
     each device gets an equal contiguous shard — the DistributedSampler
     analogue (reference: preprocess/load_data.py:236-244) at array level.
     """
-    sh = data_sharding(mesh, axis)
+    sh = NamedSharding(mesh, spec if spec is not None else P(axis))
     return jax.tree_util.tree_map(
         lambda a: jax.device_put(a, sh) if a is not None else None, batch)
+
+
+def shard_stacked_batch(batch, mesh: Mesh, axis: str = "data"):
+    """Place a steps-per-call stack of device-stacked batches ([S, D, ...]
+    leaves): the scan axis S stays replicated, the device axis D shards
+    over `axis` (see train.trainer steps_per_call grouping)."""
+    return shard_batch(batch, mesh, axis, spec=P(None, axis))
 
 
 def walltime_deadline(default: Optional[float] = None) -> Optional[float]:

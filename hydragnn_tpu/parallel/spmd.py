@@ -41,7 +41,7 @@ def _batch_spec(batch: GraphBatch):
     return jax.tree_util.tree_map(lambda _: P("data"), batch)
 
 
-def make_spmd_train_step(model, cfg: ModelConfig,
+def _make_spmd_step_body(model, cfg: ModelConfig,
                          tx: optax.GradientTransformation, mesh: Mesh,
                          loss_name: str = "mse",
                          compute_grad_energy: bool = False,
@@ -49,7 +49,8 @@ def make_spmd_train_step(model, cfg: ModelConfig,
                          force_weight: float = 1.0,
                          zero_opt: bool = False,
                          zero_min_size: int = 2 ** 14):
-    """Build train_step(state, device_stacked_batch) -> (state, metrics).
+    """Pure (un-jitted) SPMD step body shared by make_spmd_train_step
+    (direct jit) and make_spmd_multi_train_step (lax.scan).
 
     With ``zero_opt=True`` (reference: ZeroRedundancyOptimizer
     utils/optimizer/optimizer.py:43-101, DeepSpeed ZeRO stages
@@ -102,8 +103,7 @@ def make_spmd_train_step(model, cfg: ModelConfig,
     if zero_opt:
         from .mesh import param_sharding_zero
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def train_step(state: TrainState, batch: GraphBatch):
+        def step_body(state: TrainState, batch: GraphBatch):
             mapped = shard_map(
                 grads_per_device, mesh=mesh,
                 in_specs=(P(), P(), _batch_spec(batch)),
@@ -124,22 +124,46 @@ def make_spmd_train_step(model, cfg: ModelConfig,
             return state.replace(params=new_params, batch_stats=new_bs,
                                  opt_state=new_opt,
                                  step=state.step + 1), metrics
+    else:
+        def step_body(state: TrainState, batch: GraphBatch):
+            mapped = shard_map(
+                per_device, mesh=mesh,
+                in_specs=(P(), P(), P(), _batch_spec(batch)),
+                out_specs=(P(), P(), P(), P()),
+                )
+            new_params, new_bs, new_opt, metrics = mapped(
+                state.params, state.batch_stats, state.opt_state, batch)
+            return state.replace(params=new_params, batch_stats=new_bs,
+                                 opt_state=new_opt,
+                                 step=state.step + 1), metrics
 
-        return train_step
+    return step_body
+
+
+def make_spmd_train_step(model, cfg: ModelConfig,
+                         tx: optax.GradientTransformation, mesh: Mesh,
+                         loss_name: str = "mse", **kwargs):
+    """Build train_step(state, device_stacked_batch) -> (state, metrics);
+    see _make_spmd_step_body for the zero_opt semantics."""
+    return jax.jit(
+        _make_spmd_step_body(model, cfg, tx, mesh, loss_name, **kwargs),
+        donate_argnums=(0,))
+
+
+def make_spmd_multi_train_step(model, cfg: ModelConfig,
+                               tx: optax.GradientTransformation, mesh: Mesh,
+                               **kwargs):
+    """`lax.scan` of the SPMD train step over a leading steps axis: the
+    stacked batch leaves are [S, D, ...] with the device axis sharded over
+    the mesh (mesh.shard_stacked_batch) and the scan axis replicated. Same
+    dispatch-amortization as train_step.make_multi_train_step, per shard."""
+    body = _make_spmd_step_body(model, cfg, tx, mesh, **kwargs)
 
     @functools.partial(jax.jit, donate_argnums=(0,))
-    def train_step(state: TrainState, batch: GraphBatch):
-        mapped = shard_map(
-            per_device, mesh=mesh,
-            in_specs=(P(), P(), P(), _batch_spec(batch)),
-            out_specs=(P(), P(), P(), P()),
-            )
-        new_params, new_bs, new_opt, metrics = mapped(
-            state.params, state.batch_stats, state.opt_state, batch)
-        return state.replace(params=new_params, batch_stats=new_bs,
-                             opt_state=new_opt, step=state.step + 1), metrics
+    def multi_step(state: TrainState, stacked: GraphBatch):
+        return jax.lax.scan(body, state, stacked)
 
-    return train_step
+    return multi_step
 
 
 def make_spmd_eval_step(model, cfg: ModelConfig, mesh: Mesh,

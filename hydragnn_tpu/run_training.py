@@ -24,7 +24,7 @@ from .train.train_step import TrainState, make_eval_step, make_train_step
 from .train.trainer import train_validate_test
 from .utils import profiling as tr
 from .utils.checkpoint import save_model
-from .utils.print_utils import log, print_peak_memory, setup_log
+from .utils.print_utils import print_peak_memory, setup_log
 
 
 def _load_datasets_from_config(config):
@@ -178,8 +178,11 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
                                            loss_name=loss_name,
                                            compute_grad_energy=cge)
     elif steps_per_call > 1:
-        log(f"steps_per_call={steps_per_call} ignored: dispatch batching "
-            "is not yet available on the SPMD multi-shard path")
+        from .parallel.spmd import make_spmd_multi_train_step
+        multi_step = make_spmd_multi_train_step(
+            model, mcfg, tx, mesh, loss_name=loss_name,
+            compute_grad_energy=cge, zero_opt=zero_opt,
+            zero_min_size=zero_min)
 
     ckpt_fn = None
     if train_cfg.get("Checkpoint", False):
@@ -208,9 +211,12 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
             visualizer.create_scatter_plots(t0, p0, output_names=out_names,
                                             iepoch=-1)
 
+    place_group_fn = None
     if num_shards > 1:
-        from .parallel.mesh import shard_batch
+        from .parallel.mesh import shard_batch, shard_stacked_batch
         place_fn = lambda b: shard_batch(b, mesh)
+        if steps_per_call > 1:  # [S, D, ...] stacks: S replicated, D sharded
+            place_group_fn = lambda b: shard_stacked_batch(b, mesh)
     else:
         place_fn = lambda b: jax.tree_util.tree_map(
             lambda a: None if a is None else jax.device_put(a), b)
@@ -237,7 +243,8 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         checkpoint_warmup=int(train_cfg.get("checkpoint_warmup", 0)),
         checkpoint_fn=ckpt_fn, verbosity=verbosity, tracer=tr.get(),
         place_fn=place_fn, profiler=profiler, walltime_deadline=deadline,
-        multi_train_step=multi_step, steps_per_call=steps_per_call)
+        multi_train_step=multi_step, steps_per_call=steps_per_call,
+        place_group_fn=place_group_fn)
 
     if train_cfg.get("Checkpoint", False):
         save_model(state, log_name)

@@ -85,15 +85,12 @@ def _resolve_compute_dtype(cfg: ModelConfig, compute_dtype):
     return jnp.dtype(name)
 
 
-def make_train_step(model, cfg: ModelConfig, tx: optax.GradientTransformation,
+def _make_step_body(model, cfg: ModelConfig, tx: optax.GradientTransformation,
                     loss_name: str = "mse", compute_grad_energy: bool = False,
                     energy_weight: float = 1.0, force_weight: float = 1.0,
-                    donate: bool = True, compute_dtype: Optional[str] = None):
-    """Build the jitted SPMD train step.
-
-    `compute_grad_energy` selects the energy-force path
-    (reference: Training.compute_grad_energy, train_validate_test.py:515-521).
-    """
+                    compute_dtype: Optional[str] = None):
+    """Pure (un-jitted) train-step body shared by make_train_step (direct
+    jit) and make_multi_train_step (lax.scan)."""
     cdtype = _resolve_compute_dtype(cfg, compute_dtype)
     mixed = cdtype != jnp.float32
 
@@ -147,10 +144,21 @@ def make_train_step(model, cfg: ModelConfig, tx: optax.GradientTransformation,
                                   opt_state=new_opt, step=state.step + 1)
         return new_state, metrics
 
-    train_step = jax.jit(step_body,
-                         donate_argnums=(0,) if donate else ())
-    train_step.step_body = step_body  # for make_multi_train_step
-    return train_step
+    return step_body
+
+
+def make_train_step(model, cfg: ModelConfig, tx: optax.GradientTransformation,
+                    loss_name: str = "mse", compute_grad_energy: bool = False,
+                    energy_weight: float = 1.0, force_weight: float = 1.0,
+                    donate: bool = True, compute_dtype: Optional[str] = None):
+    """Build the jitted SPMD train step.
+
+    `compute_grad_energy` selects the energy-force path
+    (reference: Training.compute_grad_energy, train_validate_test.py:515-521).
+    """
+    body = _make_step_body(model, cfg, tx, loss_name, compute_grad_energy,
+                           energy_weight, force_weight, compute_dtype)
+    return jax.jit(body, donate_argnums=(0,) if donate else ())
 
 
 def make_multi_train_step(model, cfg: ModelConfig,
@@ -168,9 +176,8 @@ def make_multi_train_step(model, cfg: ModelConfig,
     This is the throughput path the reference cannot express: its
     per-batch Python loop (train_validate_test.py:483-545) re-enters the
     framework every batch by construction."""
-    donate = kwargs.get("donate", True)
-    kwargs["donate"] = False  # inner body never donates; the scan carry does
-    body = make_train_step(model, cfg, tx, **kwargs).step_body
+    donate = kwargs.pop("donate", True)
+    body = _make_step_body(model, cfg, tx, **kwargs)
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def multi_step(state: TrainState, stacked: GraphBatch):

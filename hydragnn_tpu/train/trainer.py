@@ -115,6 +115,7 @@ def train_validate_test(
     profiler=None,
     multi_train_step: Optional[Callable] = None,
     steps_per_call: int = 1,
+    place_group_fn: Optional[Callable] = None,
 ):
     """Returns (final_state, history dict). With `keep_best` the returned
     state is the best-validation one (mirrors the reference's best-val
@@ -194,9 +195,10 @@ def train_validate_test(
             # holds S of them, so scale down to keep device memory flat
             depth = (max(1, prefetch_depth // steps_per_call) if group
                      else prefetch_depth)
-            stream = (prefetch_to_device(source, size=depth,
-                                         place_fn=place_fn)
-                      if place_fn is not None else source)
+            pf = (place_group_fn if (group and place_group_fn is not None)
+                  else place_fn)
+            stream = (prefetch_to_device(source, size=depth, place_fn=pf)
+                      if pf is not None else source)
             if trace_level > 0:
                 stream = _timed_stream(stream)
             n_items = len(train_loader)
