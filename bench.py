@@ -45,22 +45,31 @@ def synth_samples(num, rng):
     return samples
 
 
-def _probe_device_backend(timeout_s: int = 150):
+def _probe_device_backend(timeout_s: int = 90, attempts: int = 2,
+                          retry_wait_s: int = 30):
     """The axon TPU tunnel can be down; jax.devices() then hangs forever
-    inside this process. Probe it in a subprocess with a timeout and fall
-    back to CPU so the bench always emits its JSON line (the fallback is
-    visible in the metric's `backend` field)."""
+    inside this process. Probe it in a subprocess with a timeout — running
+    a real op, not just device enumeration, since a wedged tunnel can list
+    the device yet hang on dispatch — and retry a couple of times (outages
+    are often transient) before falling back to CPU so the bench always
+    emits its JSON line (the fallback is visible in `backend`)."""
     import subprocess
     import sys
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            timeout=timeout_s, capture_output=True, text=True)
-        if r.returncode == 0:
-            return r.stdout.strip() or "unknown"
-    except subprocess.TimeoutExpired:
-        pass
+    probe = ("import jax, jax.numpy as jnp; "
+             "x = jnp.ones((128, 128)); float((x @ x).sum()); "
+             "print(jax.devices()[0].platform)")
+    for attempt in range(attempts):
+        try:
+            r = subprocess.run([sys.executable, "-c", probe],
+                               timeout=timeout_s, capture_output=True,
+                               text=True)
+            if r.returncode == 0:
+                lines = r.stdout.strip().splitlines()
+                return lines[-1] if lines else "unknown"
+        except subprocess.TimeoutExpired:
+            pass
+        if attempt < attempts - 1:
+            time.sleep(retry_wait_s)
     return None
 
 

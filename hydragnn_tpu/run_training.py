@@ -172,11 +172,15 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     spc_env = env_int("HYDRAGNN_STEPS_PER_CALL")
     steps_per_call = (spc_env if spc_env is not None  # env overrides config
                       else int(train_cfg.get("steps_per_call", 1)))
+    multi_eval = None
     if num_shards == 1 and steps_per_call > 1:
-        from .train.train_step import make_multi_train_step
+        from .train.train_step import (make_multi_eval_step,
+                                       make_multi_train_step)
         multi_step = make_multi_train_step(model, mcfg, tx,
                                            loss_name=loss_name,
                                            compute_grad_energy=cge)
+        multi_eval = make_multi_eval_step(model, mcfg, loss_name=loss_name,
+                                          compute_grad_energy=cge)
     elif steps_per_call > 1:
         from .parallel.spmd import make_spmd_multi_train_step
         multi_step = make_spmd_multi_train_step(
@@ -244,7 +248,7 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         checkpoint_fn=ckpt_fn, verbosity=verbosity, tracer=tr.get(),
         place_fn=place_fn, profiler=profiler, walltime_deadline=deadline,
         multi_train_step=multi_step, steps_per_call=steps_per_call,
-        place_group_fn=place_group_fn)
+        place_group_fn=place_group_fn, multi_eval_step=multi_eval)
 
     if train_cfg.get("Checkpoint", False):
         save_model(state, log_name)

@@ -186,16 +186,15 @@ def make_multi_train_step(model, cfg: ModelConfig,
     return multi_step
 
 
-def make_eval_step(model, cfg: ModelConfig, loss_name: str = "mse",
-                   compute_grad_energy: bool = False,
-                   energy_weight: float = 1.0, force_weight: float = 1.0,
-                   compute_dtype: Optional[str] = None):
-    """Jitted validation/test step returning (metrics, outputs)
-    (reference: validate/test, train_validate_test.py:568-746)."""
+def _make_eval_body(model, cfg: ModelConfig, loss_name: str = "mse",
+                    compute_grad_energy: bool = False,
+                    energy_weight: float = 1.0, force_weight: float = 1.0,
+                    compute_dtype: Optional[str] = None):
+    """Pure (un-jitted) eval body shared by make_eval_step (direct jit) and
+    make_multi_eval_step (lax.scan)."""
     cdtype = _resolve_compute_dtype(cfg, compute_dtype)
     mixed = cdtype != jnp.float32
 
-    @jax.jit
     def eval_step(state: TrainState, batch: GraphBatch):
         variables = {"params": state.params, "batch_stats": state.batch_stats}
         if mixed:
@@ -227,3 +226,32 @@ def make_eval_step(model, cfg: ModelConfig, loss_name: str = "mse",
         return metrics, outputs
 
     return eval_step
+
+
+def make_eval_step(model, cfg: ModelConfig, loss_name: str = "mse",
+                   compute_grad_energy: bool = False,
+                   energy_weight: float = 1.0, force_weight: float = 1.0,
+                   compute_dtype: Optional[str] = None):
+    """Jitted validation/test step returning (metrics, outputs)
+    (reference: validate/test, train_validate_test.py:568-746)."""
+    return jax.jit(_make_eval_body(model, cfg, loss_name,
+                                   compute_grad_energy, energy_weight,
+                                   force_weight, compute_dtype))
+
+
+def make_multi_eval_step(model, cfg: ModelConfig, **kwargs):
+    """Metrics-only `lax.scan` of the eval step over stacked batches — the
+    val/test analogue of make_multi_train_step. Per-sample outputs are
+    dropped in the scan body (XLA dead-code-eliminates their gathering), so
+    use the single eval step where predictions are needed (run_prediction/
+    test dumps)."""
+    body = _make_eval_body(model, cfg, **kwargs)
+
+    @jax.jit
+    def multi_eval(state: TrainState, stacked: GraphBatch):
+        def scan_body(st, b):
+            metrics, _ = body(st, b)
+            return st, metrics
+        return jax.lax.scan(scan_body, state, stacked)[1]
+
+    return multi_eval

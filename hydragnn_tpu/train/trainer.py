@@ -116,6 +116,7 @@ def train_validate_test(
     multi_train_step: Optional[Callable] = None,
     steps_per_call: int = 1,
     place_group_fn: Optional[Callable] = None,
+    multi_eval_step: Optional[Callable] = None,
 ):
     """Returns (final_state, history dict). With `keep_best` the returned
     state is the best-validation one (mirrors the reference's best-val
@@ -143,21 +144,6 @@ def train_validate_test(
     # DataLoader worker count (load_data.py:249-254) onto prefetch depth
     trace_level = env_int("HYDRAGNN_TRACE_LEVEL", 0)
     prefetch_depth = max(env_int("HYDRAGNN_NUM_WORKERS", 2), 1)
-
-    def _group_batches(loader, size):
-        """Group the loader's fixed-shape batches into [S, ...]-stacked
-        pytrees for the scanned multi-step (datasets.loader._stack_batches
-        handles Optional GraphBatch fields); the remainder group keeps its
-        own (smaller) leading size."""
-        from ..datasets.loader import _stack_batches
-        buf = []
-        for b in loader:
-            buf.append(b)
-            if len(buf) == size:
-                yield _stack_batches(buf)
-                buf = []
-        if buf:
-            yield _stack_batches(buf)
 
     def _timed_stream(stream):
         it = iter(stream)
@@ -249,9 +235,10 @@ def train_validate_test(
         # ---- val/test passes ----
         if run_valtest:
             val_loss = _eval_epoch(eval_step, state, val_loader, tr,
-                                   "validate")
+                                   "validate", multi_eval_step,
+                                   steps_per_call)
             test_loss = _eval_epoch(eval_step, state, test_loader, tr,
-                                    "test")
+                                    "test", multi_eval_step, steps_per_call)
         else:
             val_loss = test_loss = float("nan")
 
@@ -310,11 +297,43 @@ def train_validate_test(
     return state, history
 
 
-def _eval_epoch(eval_step, state, loader, tr, name: str) -> float:
+def _group_batches(loader, size):
+    """Group fixed-shape batches into [S, ...]-stacked pytrees for the
+    scanned multi-steps (datasets.loader._stack_batches handles Optional
+    GraphBatch fields); the remainder group keeps its own (smaller)
+    leading size."""
+    from ..datasets.loader import _stack_batches
+    buf = []
+    for b in loader:
+        buf.append(b)
+        if len(buf) == size:
+            yield _stack_batches(buf)
+            buf = []
+    if buf:
+        yield _stack_batches(buf)
+
+
+def _eval_epoch(eval_step, state, loader, tr, name: str,
+                multi_eval_step=None, steps_per_call: int = 1) -> float:
     if loader is None:
         return float("nan")
     tot, nb = 0.0, 0
     with tr.timer(name):
+        if multi_eval_step is not None and steps_per_call > 1:
+            for stacked in _group_batches(loader, steps_per_call):
+                n = stacked.x.shape[0]
+                if n == steps_per_call:
+                    m = multi_eval_step(state, stacked)
+                    tot += float(jnp.sum(m["loss"]))
+                else:  # remainder: single steps, no second scan compile
+                    for i in range(n):
+                        b = jax.tree_util.tree_map(
+                            lambda a, i=i: a[i], stacked)
+                        out = eval_step(state, b)
+                        metrics = out[0] if isinstance(out, tuple) else out
+                        tot += float(metrics["loss"])
+                nb += n
+            return tot / max(nb, 1)
         for batch in loader:
             out = eval_step(state, batch)
             metrics = out[0] if isinstance(out, tuple) else out

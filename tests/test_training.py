@@ -346,6 +346,15 @@ def test_steps_per_call_multi_step_equivalence():
     s_scan, m_scan = multi(state, stacked)
     np.testing.assert_allclose(np.asarray(m_scan["loss"]), loop_losses,
                                rtol=1e-6)
+
+    # metrics-only scanned eval matches per-batch eval
+    from hydragnn_tpu.train.train_step import (make_eval_step,
+                                               make_multi_eval_step)
+    estep = make_eval_step(model, mcfg)
+    eval_losses = [float(estep(s_scan, b)[0]["loss"]) for b in batches]
+    meval = make_multi_eval_step(model, mcfg)
+    np.testing.assert_allclose(np.asarray(meval(s_scan, stacked)["loss"]),
+                               eval_losses, rtol=1e-6)
     for a, b in zip(jax.tree_util.tree_leaves(s_loop.params),
                     jax.tree_util.tree_leaves(s_scan.params)):
         # the scan body and the standalone step are compiled separately;
