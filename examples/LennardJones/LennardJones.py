@@ -20,7 +20,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model_type", default="SchNet",
                    choices=["SchNet", "EGNN", "PAINN", "PNAEq", "MACE",
-                            "DimeNet"])
+                            "DimeNet", "PNAPlus"])
     p.add_argument("--num_configs", type=int, default=200)
     p.add_argument("--num_epoch", type=int, default=20)
     p.add_argument("--batch_size", type=int, default=16)

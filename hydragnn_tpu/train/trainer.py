@@ -313,13 +313,23 @@ def _group_batches(loader, size):
         yield _stack_batches(buf)
 
 
+def _eval_one(eval_step, state, batch) -> float:
+    out = eval_step(state, batch)
+    metrics = out[0] if isinstance(out, tuple) else out
+    return float(metrics["loss"])
+
+
 def _eval_epoch(eval_step, state, loader, tr, name: str,
                 multi_eval_step=None, steps_per_call: int = 1) -> float:
     if loader is None:
         return float("nan")
     tot, nb = 0.0, 0
+    # grouping only pays off when at least one full group exists; a loader
+    # shorter than S would stack and immediately re-slice for nothing
+    grouped = (multi_eval_step is not None and steps_per_call > 1
+               and len(loader) >= steps_per_call)
     with tr.timer(name):
-        if multi_eval_step is not None and steps_per_call > 1:
+        if grouped:
             for stacked in _group_batches(loader, steps_per_call):
                 n = stacked.x.shape[0]
                 if n == steps_per_call:
@@ -327,17 +337,13 @@ def _eval_epoch(eval_step, state, loader, tr, name: str,
                     tot += float(jnp.sum(m["loss"]))
                 else:  # remainder: single steps, no second scan compile
                     for i in range(n):
-                        b = jax.tree_util.tree_map(
-                            lambda a, i=i: a[i], stacked)
-                        out = eval_step(state, b)
-                        metrics = out[0] if isinstance(out, tuple) else out
-                        tot += float(metrics["loss"])
+                        tot += _eval_one(eval_step, state,
+                                         jax.tree_util.tree_map(
+                                             lambda a, i=i: a[i], stacked))
                 nb += n
             return tot / max(nb, 1)
         for batch in loader:
-            out = eval_step(state, batch)
-            metrics = out[0] if isinstance(out, tuple) else out
-            tot += float(metrics["loss"])
+            tot += _eval_one(eval_step, state, batch)
             nb += 1
     return tot / max(nb, 1)
 
