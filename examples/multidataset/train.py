@@ -95,6 +95,8 @@ def main():
     p.add_argument("--preonly", action="store_true")
     p.add_argument("--num_epoch", type=int, default=None)
     p.add_argument("--batch_size", type=int, default=None)
+    p.add_argument("--steps_per_call", type=int, default=None,
+                   help="scan S optimizer steps per device dispatch")
     p.add_argument("--cpu", action="store_true")
     args = p.parse_args()
 
@@ -108,6 +110,12 @@ def main():
                                  num_epoch=args.num_epoch,
                                  batch_size=args.batch_size)
     train_cfg = config["NeuralNetwork"]["Training"]
+    if args.steps_per_call is not None:
+        train_cfg["steps_per_call"] = args.steps_per_call
+    from hydragnn_tpu.utils.envflags import env_int
+    spc_env = env_int("HYDRAGNN_STEPS_PER_CALL")
+    if spc_env is not None:  # env overrides config/CLI, as in run_training
+        train_cfg["steps_per_call"] = spc_env
 
     import jax
     import numpy as np
@@ -197,12 +205,23 @@ def main():
     eval_step = make_spmd_eval_step(model, mcfg, mesh, loss_name)
 
     from hydragnn_tpu.parallel.mesh import shard_batch
+    # steps-per-call dispatch batching (scan S steps per device call)
+    steps_per_call = int(train_cfg.get("steps_per_call", 1))
+    multi_step = place_group = None
+    if steps_per_call > 1:
+        from hydragnn_tpu.parallel.mesh import shard_stacked_batch
+        from hydragnn_tpu.parallel.spmd import make_spmd_multi_train_step
+        multi_step = make_spmd_multi_train_step(model, mcfg, tx, mesh,
+                                                loss_name=loss_name)
+        place_group = lambda b: shard_stacked_batch(b, mesh)
     state, history = train_validate_test(
         train_step, eval_step, state, loader, val_loader, test_loader,
         num_epochs=train_cfg["num_epoch"], log_name="gfm_multidataset",
         use_early_stopping=bool(train_cfg.get("EarlyStopping", False)),
         verbosity=config.get("Verbosity", {}).get("level", 0),
-        place_fn=lambda b: shard_batch(b, mesh))
+        place_fn=lambda b: shard_batch(b, mesh),
+        multi_train_step=multi_step, steps_per_call=steps_per_call,
+        place_group_fn=place_group)
     print(json.dumps({"final_train_loss": history["train_loss"][-1],
                       "final_val_loss": history["val_loss"][-1],
                       "num_datasets": len(modellist),
