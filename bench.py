@@ -113,30 +113,66 @@ def main():
                                  compute_grad_energy=True, donate=False,
                                  compute_dtype="float32")
 
-    # warmup/compile (value fetch, not block_until_ready — the axon tunnel's
-    # block_until_ready returns before remote execution finishes)
-    state, metrics = train_step(state, batch)
-    float(metrics["loss"])
+    # BENCH_STEPS_PER_CALL>1: scan S optimizer steps per device dispatch
+    # (train_step.make_multi_train_step) — amortizes the ~2.4 ms per-call
+    # tunnel dispatch latency. Same training math; throughput counts the
+    # same BATCH_GRAPHS * STEPS graphs. Off by default until the scanned
+    # step is validated through the axon tunnel.
+    spc = min(int(os.environ.get("BENCH_STEPS_PER_CALL", "0") or 0), STEPS)
+    multi_step = None
+    if spc > 1:
+        from hydragnn_tpu.datasets.loader import _stack_batches
+        from hydragnn_tpu.train.train_step import make_multi_train_step
+        multi_step = make_multi_train_step(
+            model, mcfg, tx, loss_name="mae", compute_grad_energy=True,
+            donate=False, compute_dtype="float32")
+        stacked = _stack_batches([batch] * spc)
+
+    def run_steps(state, n_steps):
+        if multi_step is not None:
+            for _ in range(n_steps // spc):
+                state, metrics = multi_step(state, stacked)
+            for _ in range(n_steps % spc):
+                state, metrics = train_step(state, batch)
+        else:
+            for _ in range(n_steps):
+                state, metrics = train_step(state, batch)
+        return state, metrics
+
+    def sync(metrics):
+        # value fetch, not block_until_ready — the axon tunnel's
+        # block_until_ready returns before remote execution finishes;
+        # multi-step metrics carry a leading [S] axis
+        return float(np.asarray(metrics["loss"]).ravel()[-1])
+
+    # warmup/compile both paths that the timed loop will use
+    state, metrics = run_steps(state, spc if spc > 1 else 1)
+    sync(metrics)
+    if spc > 1 and STEPS % spc:
+        state, metrics = train_step(state, batch)
+        sync(metrics)
 
     # best of 3 repetitions: the tunneled chip occasionally stalls a burst,
     # and throughput is the min-latency statistic of interest
     best_dt = None
     for _ in range(3):
         t0 = time.perf_counter()
-        for _ in range(STEPS):
-            state, metrics = train_step(state, batch)
-        float(metrics["loss"])  # forces the whole dependency chain
+        state, metrics = run_steps(state, STEPS)
+        sync(metrics)  # forces the whole dependency chain
         dt = time.perf_counter() - t0
         best_dt = dt if best_dt is None else min(best_dt, dt)
 
     gps = BATCH_GRAPHS * STEPS / best_dt
-    print(json.dumps({
+    out = {
         "metric": "graphs_per_sec_per_chip_oc20like_pna_ef_train",
         "value": round(gps, 2),
         "unit": "graphs/s",
         "vs_baseline": round(gps / REF_BASELINE_GPS, 4),
         "backend": backend,
-    }))
+    }
+    if spc > 1:
+        out["steps_per_call"] = spc
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -112,10 +112,6 @@ def main():
     train_cfg = config["NeuralNetwork"]["Training"]
     if args.steps_per_call is not None:
         train_cfg["steps_per_call"] = args.steps_per_call
-    from hydragnn_tpu.utils.envflags import env_int
-    spc_env = env_int("HYDRAGNN_STEPS_PER_CALL")
-    if spc_env is not None:  # env overrides config/CLI, as in run_training
-        train_cfg["steps_per_call"] = spc_env
 
     import jax
     import numpy as np
@@ -205,15 +201,13 @@ def main():
     eval_step = make_spmd_eval_step(model, mcfg, mesh, loss_name)
 
     from hydragnn_tpu.parallel.mesh import shard_batch
-    # steps-per-call dispatch batching (scan S steps per device call)
-    steps_per_call = int(train_cfg.get("steps_per_call", 1))
-    multi_step = place_group = None
-    if steps_per_call > 1:
-        from hydragnn_tpu.parallel.mesh import shard_stacked_batch
-        from hydragnn_tpu.parallel.spmd import make_spmd_multi_train_step
-        multi_step = make_spmd_multi_train_step(model, mcfg, tx, mesh,
-                                                loss_name=loss_name)
-        place_group = lambda b: shard_stacked_batch(b, mesh)
+    # steps-per-call dispatch batching (scan S steps per device call);
+    # env-over-config precedence + wiring shared with run_training
+    from hydragnn_tpu.parallel.spmd import make_spmd_dispatch_group
+    from hydragnn_tpu.utils.envflags import resolve_steps_per_call
+    steps_per_call = resolve_steps_per_call(train_cfg)
+    multi_step, place_group = make_spmd_dispatch_group(
+        model, mcfg, tx, mesh, steps_per_call, loss_name=loss_name)
     state, history = train_validate_test(
         train_step, eval_step, state, loader, val_loader, test_loader,
         num_epochs=train_cfg["num_epoch"], log_name="gfm_multidataset",

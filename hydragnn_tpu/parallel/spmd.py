@@ -209,3 +209,16 @@ def make_spmd_eval_step(model, cfg: ModelConfig, mesh: Mesh,
         return mapped(state.params, state.batch_stats, batch)
 
     return eval_step
+
+
+def make_spmd_dispatch_group(model, cfg: ModelConfig,
+                             tx: optax.GradientTransformation, mesh: Mesh,
+                             steps_per_call: int, **kwargs):
+    """(multi_train_step, place_group_fn) pair for trainer steps-per-call
+    grouping on an SPMD mesh, or (None, None) when grouping is off —
+    shared by run_training and the multidataset driver."""
+    if steps_per_call <= 1:
+        return None, None
+    from .mesh import shard_stacked_batch
+    multi = make_spmd_multi_train_step(model, cfg, tx, mesh, **kwargs)
+    return multi, (lambda b: shard_stacked_batch(b, mesh))

@@ -168,11 +168,9 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     # steps-per-call dispatch batching: scan S optimizer steps per device
     # call (Training.steps_per_call / HYDRAGNN_STEPS_PER_CALL). Identical
     # math to the per-batch loop; amortizes host dispatch latency.
-    multi_step = None
-    spc_env = env_int("HYDRAGNN_STEPS_PER_CALL")
-    steps_per_call = (spc_env if spc_env is not None  # env overrides config
-                      else int(train_cfg.get("steps_per_call", 1)))
-    multi_eval = None
+    from .utils.envflags import resolve_steps_per_call
+    multi_step = multi_eval = place_group_fn = None
+    steps_per_call = resolve_steps_per_call(train_cfg)
     if num_shards == 1 and steps_per_call > 1:
         from .train.train_step import (make_multi_eval_step,
                                        make_multi_train_step)
@@ -182,9 +180,9 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         multi_eval = make_multi_eval_step(model, mcfg, loss_name=loss_name,
                                           compute_grad_energy=cge)
     elif steps_per_call > 1:
-        from .parallel.spmd import make_spmd_multi_train_step
-        multi_step = make_spmd_multi_train_step(
-            model, mcfg, tx, mesh, loss_name=loss_name,
+        from .parallel.spmd import make_spmd_dispatch_group
+        multi_step, place_group_fn = make_spmd_dispatch_group(
+            model, mcfg, tx, mesh, steps_per_call, loss_name=loss_name,
             compute_grad_energy=cge, zero_opt=zero_opt,
             zero_min_size=zero_min)
 
@@ -215,12 +213,9 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
             visualizer.create_scatter_plots(t0, p0, output_names=out_names,
                                             iepoch=-1)
 
-    place_group_fn = None
     if num_shards > 1:
-        from .parallel.mesh import shard_batch, shard_stacked_batch
+        from .parallel.mesh import shard_batch
         place_fn = lambda b: shard_batch(b, mesh)
-        if steps_per_call > 1:  # [S, D, ...] stacks: S replicated, D sharded
-            place_group_fn = lambda b: shard_stacked_batch(b, mesh)
     else:
         place_fn = lambda b: jax.tree_util.tree_map(
             lambda a: None if a is None else jax.device_put(a), b)

@@ -22,3 +22,13 @@ def env_int(name: str, default=None):
     if val is None or not val.strip():
         return default
     return int(val)
+
+
+def resolve_steps_per_call(train_cfg) -> int:
+    """Steps-per-call dispatch batching knob: HYDRAGNN_STEPS_PER_CALL env
+    overrides Training.steps_per_call (default 1). Shared by run_training
+    and the example drivers so the precedence can't drift."""
+    spc_env = env_int("HYDRAGNN_STEPS_PER_CALL")
+    if spc_env is not None:
+        return spc_env
+    return int(train_cfg.get("steps_per_call", 1))
