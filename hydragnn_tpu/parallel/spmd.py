@@ -152,12 +152,12 @@ def make_spmd_train_step(model, cfg: ModelConfig,
 
 def make_spmd_multi_train_step(model, cfg: ModelConfig,
                                tx: optax.GradientTransformation, mesh: Mesh,
-                               **kwargs):
+                               loss_name: str = "mse", **kwargs):
     """`lax.scan` of the SPMD train step over a leading steps axis: the
     stacked batch leaves are [S, D, ...] with the device axis sharded over
     the mesh (mesh.shard_stacked_batch) and the scan axis replicated. Same
     dispatch-amortization as train_step.make_multi_train_step, per shard."""
-    body = _make_spmd_step_body(model, cfg, tx, mesh, **kwargs)
+    body = _make_spmd_step_body(model, cfg, tx, mesh, loss_name, **kwargs)
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def multi_step(state: TrainState, stacked: GraphBatch):

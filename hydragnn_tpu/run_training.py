@@ -70,7 +70,8 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     config = load_config(config_or_path)
     verbosity = config.get("Verbosity", {}).get("level", 0)
 
-    from .utils.envflags import env_flag, env_int
+    from .utils.envflags import (env_flag, env_int,
+                             resolve_steps_per_call)
     init_distributed()
     # TRACE_LEVEL>0 also turns on synchronous region timing (the cudasync
     # analogue: block_until_ready before closing a span — reference:
@@ -168,7 +169,6 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     # steps-per-call dispatch batching: scan S optimizer steps per device
     # call (Training.steps_per_call / HYDRAGNN_STEPS_PER_CALL). Identical
     # math to the per-batch loop; amortizes host dispatch latency.
-    from .utils.envflags import resolve_steps_per_call
     multi_step = multi_eval = place_group_fn = None
     steps_per_call = resolve_steps_per_call(train_cfg)
     if num_shards == 1 and steps_per_call > 1:

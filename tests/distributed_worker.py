@@ -78,12 +78,28 @@ def main():
     variables = init_params(model, jax.tree_util.tree_map(
         lambda a: None if a is None else a[0], batch))
     tx = select_optimizer(cfg["NeuralNetwork"]["Training"])
+    # host snapshot: the donating step below deletes the device buffers
+    # that `variables` aliases, and the multi-step check needs them again
+    variables_init = jax.tree_util.tree_map(np.array, variables)
     state = TrainState.create(variables, tx)
     step = make_spmd_train_step(model, mcfg, tx, mesh, "mse")
     state, metrics = step(state, gbatch)
     # the loss is replicated over the global mesh; every process reads its
     # local replica (global arrays can't be fetched whole from one host)
     loss = float(np.asarray(metrics["loss"].addressable_data(0)))
+
+    # steps-per-call across hosts: scan 2 SPMD steps in one dispatch on the
+    # cross-process mesh; losses must match the sequential path everywhere
+    from hydragnn_tpu.parallel.spmd import make_spmd_multi_train_step
+    import jax.numpy as jnp
+    multi = make_spmd_multi_train_step(model, mcfg, tx, mesh,
+                                       loss_name="mse")
+    fresh = TrainState.create(
+        jax.tree_util.tree_map(jnp.asarray, variables_init), tx)
+    gstacked = jax.tree_util.tree_map(
+        lambda a: None if a is None else jnp.stack([a, a]), gbatch)
+    _, mm = multi(fresh, gstacked)
+    multi_loss0 = float(np.asarray(mm["loss"].addressable_data(0))[0])
 
     # AbstractRawDataset dist=True: each process loads its file shard but
     # the min-max ranges must be reduced across processes so normalization
@@ -125,6 +141,7 @@ def main():
 
     print(json.dumps({"rank": rank, "world": world, "devices": ndev,
                       "psum": total, "loss": round(loss, 6),
+                      "multi_loss0": round(multi_loss0, 6),
                       "raw_len": rds.len(),
                       "raw_minmax_node":
                           np.round(rds.minmax_node_feature, 5).tolist(),

@@ -42,6 +42,10 @@ def test_two_process_distributed():
         assert o["psum"] == 3.0  # (0+1) + (1+1)
     # single-controller SPMD: both processes computed the same global loss
     assert outs[0]["loss"] == outs[1]["loss"]
+    # scanned multi-step on the cross-process mesh: step 0 of the scan
+    # reproduces the sequential step's loss on every process
+    assert outs[0]["multi_loss0"] == outs[1]["multi_loss0"]
+    assert abs(outs[0]["multi_loss0"] - outs[0]["loss"]) < 1e-5
     # raw-dataset sharding: 6 files split across 2 ranks, but the min-max
     # normalization ranges are globally reduced -> identical on both
     assert outs[0]["raw_len"] + outs[1]["raw_len"] == 6
