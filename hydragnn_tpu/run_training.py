@@ -188,7 +188,9 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
 
     ckpt_fn = None
     if train_cfg.get("Checkpoint", False):
-        ckpt_fn = lambda s, e, v: save_model(s, log_name)
+        # mid-training best-val saves run async so the epoch loop never
+        # blocks on filesystem writes; the final save below synchronizes
+        ckpt_fn = lambda s, e, v: save_model(s, log_name, use_async=True)
 
     # visualization wiring (reference: run_training.py:76-78 reads the
     # Visualization section; train_validate_test.py:100-125,264-311 builds
@@ -246,6 +248,16 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         place_group_fn=place_group_fn, multi_eval_step=multi_eval)
 
     if train_cfg.get("Checkpoint", False):
+        from .utils.checkpoint import wait_for_checkpoints
+        # drain async best-val saves first: the final state can share its
+        # step dir with an in-flight save of the same (best) state. A
+        # failed optional mid-training save must not discard the run.
+        try:
+            wait_for_checkpoints()
+        except Exception as exc:  # noqa: BLE001
+            import logging
+            logging.getLogger("hydragnn_tpu").warning(
+                "async checkpoint failed: %s", exc)
         save_model(state, log_name)
 
     if visualizer is not None:

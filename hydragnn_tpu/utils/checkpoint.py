@@ -21,19 +21,47 @@ def _ckpt_dir(log_name: str, path: str = "./logs") -> str:
     return os.path.abspath(os.path.join(path, log_name, "checkpoint"))
 
 
-def save_model(state: TrainState, log_name: str, path: str = "./logs") -> str:
+_ASYNC_STATE: dict = {}
+
+
+def save_model(state: TrainState, log_name: str, path: str = "./logs",
+               use_async: bool = False) -> str:
     """Rank-0-coordinated atomic save (reference: save_model,
-    utils/model/model.py:63-77)."""
+    utils/model/model.py:63-77).
+
+    ``use_async=True`` hands the host copy to a background orbax
+    AsyncCheckpointer so the train loop isn't blocked on filesystem writes
+    (SURVEY.md §5.3: mid-training best-val checkpoints); call
+    `wait_for_checkpoints()` before reading the files or exiting."""
     d = _ckpt_dir(log_name, path)
-    ckptr = ocp.StandardCheckpointer()
     target = os.path.join(d, f"step_{int(state.step)}")
-    ckptr.save(target, jax.device_get(state), force=True)
-    ckptr.wait_until_finished()
-    # mark latest
+    host_state = jax.device_get(state)
+    if use_async:
+        if "ckptr" not in _ASYNC_STATE:  # setdefault would rebuild (and
+            # leak) the checkpointer's thread machinery on every call
+            _ASYNC_STATE["ckptr"] = ocp.AsyncCheckpointer(
+                ocp.StandardCheckpointHandler())
+        ckptr = _ASYNC_STATE["ckptr"]
+        ckptr.save(target, args=ocp.args.StandardSave(host_state),
+                   force=True)
+    else:
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(target, host_state, force=True)
+        ckptr.wait_until_finished()
+    # mark latest (for async saves the marker is written immediately; the
+    # tmp-dir atomic-rename protocol means a reader either sees the
+    # finalized step dir or falls back to the previous checkpoint)
     if jax.process_index() == 0:
         with open(os.path.join(d, "LATEST"), "w") as f:
             f.write(os.path.basename(target))
     return target
+
+
+def wait_for_checkpoints():
+    """Block until every async save has been finalized on disk."""
+    ckptr = _ASYNC_STATE.get("ckptr")
+    if ckptr is not None:
+        ckptr.wait_until_finished()
 
 
 def load_existing_model(state_like: TrainState, log_name: str,
@@ -48,5 +76,17 @@ def load_existing_model(state_like: TrainState, log_name: str,
         return None
     with open(latest) as f:
         target = os.path.join(d, f.read().strip())
+    if not os.path.isdir(target):
+        # LATEST can point at an async save still being finalized (orbax
+        # writes to a tmp dir and renames); fall back to the newest
+        # completed step dir
+        done = sorted((p for p in os.listdir(d)
+                       if p.startswith("step_")
+                       and os.path.isdir(os.path.join(d, p))
+                       and p.split("_")[-1].isdigit()),
+                      key=lambda p: int(p.split("_")[-1]))
+        if not done:
+            return None
+        target = os.path.join(d, done[-1])
     ckptr = ocp.StandardCheckpointer()
     return ckptr.restore(target, state_like)

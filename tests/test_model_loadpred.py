@@ -48,3 +48,35 @@ def test_load_missing_returns_none(tmp_path):
     state, _, _, _ = run_training(cfg, datasets=splits)
     assert load_existing_model(state, "no_such_run",
                                path=str(tmp_path)) is None
+
+
+def test_async_checkpoint_roundtrip(tmp_path):
+    """use_async=True saves in the background; wait_for_checkpoints
+    finalizes; LATEST pointing at an in-flight dir falls back to the newest
+    completed step."""
+    import jax
+    import os
+    from hydragnn_tpu.utils.checkpoint import wait_for_checkpoints
+
+    samples = deterministic_graph_dataset(num_configs=24)
+    splits = split_dataset(samples, 0.7)
+    cfg = make_config("GIN")
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 1
+    state, _, _, _ = run_training(cfg, datasets=splits)
+
+    log_name = "async_ckpt_test"
+    target = save_model(state, log_name, path=str(tmp_path), use_async=True)
+    wait_for_checkpoints()
+    restored = load_existing_model(state, log_name, path=str(tmp_path))
+    assert restored is not None and int(restored.step) == int(state.step)
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # LATEST pointing at a not-yet-finalized step -> newest completed wins
+    later = state.replace(step=state.step + 100)
+    d = os.path.dirname(target)
+    with open(os.path.join(d, "LATEST"), "w") as f:
+        f.write(f"step_{int(later.step)}")  # dir does not exist
+    restored2 = load_existing_model(state, log_name, path=str(tmp_path))
+    assert restored2 is not None and int(restored2.step) == int(state.step)
