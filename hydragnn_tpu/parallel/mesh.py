@@ -62,8 +62,27 @@ def make_mesh(axes: Sequence[Tuple[str, int]] = None,
         axes = (("data", len(devices)),)
     names = tuple(n for n, _ in axes)
     sizes = tuple(s for _, s in axes)
-    arr = np.asarray(devices).reshape(sizes)
+    need = int(np.prod(sizes))
+    if need > len(devices):
+        raise ValueError(f"mesh {dict(axes)} needs {need} devices, "
+                         f"have {len(devices)}")
+    arr = np.asarray(devices[:need]).reshape(sizes)
     return Mesh(arr, names)
+
+
+def resolve_num_shards(num_shards: Optional[int], batch_size: int,
+                       use_spmd: Optional[bool] = None) -> int:
+    """Shared shard-count policy for run_training/run_prediction: default
+    to all devices when more than one, fall back to single-program when the
+    batch doesn't divide or the request exceeds the device count."""
+    ndev = jax.device_count()
+    if num_shards is None:
+        num_shards = ndev if (use_spmd or (use_spmd is None and ndev > 1)) \
+            else 1
+    num_shards = max(int(num_shards), 1)
+    if num_shards > ndev or batch_size % num_shards != 0:
+        return 1
+    return num_shards
 
 
 def data_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:

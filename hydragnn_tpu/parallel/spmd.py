@@ -222,3 +222,30 @@ def make_spmd_dispatch_group(model, cfg: ModelConfig,
     from .mesh import shard_stacked_batch
     multi = make_spmd_multi_train_step(model, cfg, tx, mesh, **kwargs)
     return multi, (lambda b: shard_stacked_batch(b, mesh))
+
+
+def make_spmd_predict_step(model, mesh: Mesh):
+    """Per-head predictions over a device-stacked batch: each device runs
+    the forward on its shard, outputs concatenate over the data axis
+    (device-major — matching a [D, ...] -> [D*..., ...] flatten of the
+    batch). The SPMD half of run_prediction (reference: run_prediction
+    evaluates under the same DDP layout as training, run_prediction.py:62-97,
+    with per-rank gathers at train_validate_test.py:709-737)."""
+
+    def per_device(params, batch_stats, batch: GraphBatch):
+        local = jax.tree_util.tree_map(
+            lambda a: None if a is None else a[0], batch)
+        variables = {"params": params, "batch_stats": batch_stats}
+        outputs, _ = model.apply(variables, local, train=False)
+        return outputs
+
+    @jax.jit
+    def predict_step(state: TrainState, batch: GraphBatch):
+        mapped = shard_map(
+            per_device, mesh=mesh,
+            in_specs=(P(), P(), _batch_spec(batch)),
+            out_specs=P("data"),
+            )
+        return mapped(state.params, state.batch_stats, batch)
+
+    return predict_step

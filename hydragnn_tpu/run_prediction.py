@@ -23,10 +23,15 @@ from .utils.checkpoint import load_existing_model
 
 
 def run_prediction(config_or_path, datasets: Optional[Tuple] = None,
-                   state: Optional[TrainState] = None, model=None):
+                   state: Optional[TrainState] = None, model=None,
+                   num_shards: Optional[int] = None):
     """Returns (true_values, predicted_values) per head
     (reference: run_prediction.py:48-107, test() gathering at
-    train_validate_test.py:709-737)."""
+    train_validate_test.py:709-737).
+
+    `num_shards > 1` evaluates the test set SPMD over a data mesh (the
+    reference predicts under the same DDP layout as training); default is
+    single-program."""
     config = load_config(config_or_path)
     if datasets is None:
         from .run_training import _load_datasets_from_config
@@ -37,15 +42,19 @@ def run_prediction(config_or_path, datasets: Optional[Tuple] = None,
 
     train_cfg = config["NeuralNetwork"]["Training"]
     batch_size = int(train_cfg["batch_size"])
+    from .parallel.mesh import resolve_num_shards
+    num_shards = resolve_num_shards(num_shards or 1, batch_size)
     from .graphs.triplets import maybe_triplet_transform
     batch_transform = maybe_triplet_transform(
-        mcfg.model_type, trainset + valset + testset, batch_size)
+        mcfg.model_type, trainset + valset + testset,
+        max(batch_size // max(num_shards, 1), 1))
     from .utils.envflags import env_flag
     arch = config["NeuralNetwork"]["Architecture"]
     nbr_fmt = env_flag("HYDRAGNN_NEIGHBOR_FORMAT",
                        bool(arch.get("neighbor_format", True)))
     _, _, test_loader = create_dataloaders(trainset, valset, testset,
-                                           batch_size, num_shards=1,
+                                           batch_size,
+                                           num_shards=num_shards,
                                            batch_transform=batch_transform,
                                            neighbor_format=nbr_fmt)
     if model is None:
@@ -64,16 +73,35 @@ def run_prediction(config_or_path, datasets: Optional[Tuple] = None,
         state = load_existing_model(template, log_name)
         assert state is not None, f"no checkpoint found for run '{log_name}'"
 
-    eval_step = make_eval_step(model, mcfg,
-                               train_cfg.get("loss_function_type", "mse"))
+    if num_shards > 1:
+        from .parallel.mesh import make_mesh, shard_batch
+        from .parallel.spmd import make_spmd_predict_step
+        mesh = make_mesh((("data", num_shards),))
+        predict = make_spmd_predict_step(model, mesh)
+
+        def step(state, batch):
+            outputs = predict(state, shard_batch(batch, mesh))
+            # device-major flatten: [D, X, ...] batch <-> [D*X, ...] outputs
+            flat = jax.tree_util.tree_map(
+                lambda a: None if a is None else np.asarray(a).reshape(
+                    (-1,) + a.shape[2:]), batch)
+            return outputs, flat
+    else:
+        eval_step = make_eval_step(model, mcfg,
+                                   train_cfg.get("loss_function_type",
+                                                 "mse"))
+
+        def step(state, batch):
+            _, outputs = eval_step(state, batch)
+            return outputs, batch
 
     trues = [[] for _ in mcfg.heads]
     preds = [[] for _ in mcfg.heads]
     for batch in test_loader:
-        _, outputs = eval_step(state, batch)
-        targets = head_targets(mcfg, batch)
-        gm = np.asarray(batch.graph_mask)
-        nm = np.asarray(batch.node_mask)
+        outputs, flat = step(state, batch)
+        targets = head_targets(mcfg, flat)
+        gm = np.asarray(flat.graph_mask)
+        nm = np.asarray(flat.node_mask)
         for ih, head in enumerate(mcfg.heads):
             mask = gm if head.head_type == "graph" else nm
             trues[ih].append(np.asarray(targets[ih])[mask])

@@ -96,11 +96,8 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     train_cfg = nn["Training"]
     batch_size = int(train_cfg["batch_size"])
 
-    ndev = jax.device_count()
-    if num_shards is None:
-        num_shards = ndev if (use_spmd or (use_spmd is None and ndev > 1)) else 1
-    if batch_size % max(num_shards, 1) != 0:
-        num_shards = 1  # fall back to single-program
+    from .parallel.mesh import resolve_num_shards
+    num_shards = resolve_num_shards(num_shards, batch_size, use_spmd)
 
     from .graphs.triplets import maybe_triplet_transform
     batch_transform = maybe_triplet_transform(
@@ -189,8 +186,16 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     ckpt_fn = None
     if train_cfg.get("Checkpoint", False):
         # mid-training best-val saves run async so the epoch loop never
-        # blocks on filesystem writes; the final save below synchronizes
-        ckpt_fn = lambda s, e, v: save_model(s, log_name, use_async=True)
+        # blocks on filesystem writes; the final save below synchronizes.
+        # A failed optional save (the error surfaces on the NEXT save, when
+        # orbax drains the previous one) must not abort training.
+        def ckpt_fn(s, e, v):
+            try:
+                save_model(s, log_name, use_async=True)
+            except Exception as exc:  # noqa: BLE001
+                import logging
+                logging.getLogger("hydragnn_tpu").warning(
+                    "async checkpoint failed: %s", exc)
 
     # visualization wiring (reference: run_training.py:76-78 reads the
     # Visualization section; train_validate_test.py:100-125,264-311 builds

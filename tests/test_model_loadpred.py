@@ -80,3 +80,28 @@ def test_async_checkpoint_roundtrip(tmp_path):
         f.write(f"step_{int(later.step)}")  # dir does not exist
     restored2 = load_existing_model(state, log_name, path=str(tmp_path))
     assert restored2 is not None and int(restored2.step) == int(state.step)
+
+
+def test_spmd_prediction_matches_single_shard():
+    """run_prediction(num_shards=8) must produce the same (true, pred)
+    pairs as the single-program path (order may differ: the sharded loader
+    partitions graphs device-major)."""
+    samples = deterministic_graph_dataset(num_configs=64,
+                                          heads=("graph",))
+    splits = split_dataset(samples, 0.7)
+    cfg = make_config("GIN")
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 2
+    state, _, model, completed = run_training(cfg, datasets=splits,
+                                              num_shards=1)
+    t1, p1 = run_prediction(completed, datasets=splits, state=state,
+                            model=model)
+    t8, p8 = run_prediction(completed, datasets=splits, state=state,
+                            model=model, num_shards=8)
+
+    def rows(t, p):
+        import numpy as np
+        return sorted(map(tuple, np.round(np.concatenate([t, p], 1), 5)))
+
+    for a, b, c, d in zip(t1, p1, t8, p8):
+        assert len(a) == len(c)
+        assert rows(a, b) == rows(c, d)
