@@ -14,7 +14,6 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..datasets.loader import prefetch_to_device
@@ -162,8 +161,8 @@ def train_validate_test(
         train_loader.set_epoch(epoch)
         profiler.set_current_epoch(epoch)
         # ---- train pass (reference: train, :449-565) ----
-        tot, nb = 0.0, 0
-        task_tot: Dict[str, float] = {}
+        acc_train: Dict[str, float] = {}
+        nb = 0
         with tr.timer("train_epoch"), profiler:
             # double-buffered device prefetch only when the caller supplies
             # a placement (meshes need mesh-aware sharding; committing to a
@@ -200,47 +199,41 @@ def train_validate_test(
                 with tr.timer("train_step"):
                     if full_group:
                         state, metrics = multi_train_step(state, batch)
-                        metrics = {k: float(jnp.sum(v))
-                                   for k, v in metrics.items()}
+                        _accumulate_metrics(acc_train, metrics, summed=True)
                         nb += steps_per_call
                     elif group:
                         # remainder group, or a max_num_batch cap inside
                         # this group: single steps (a smaller scan would
                         # trigger one more long compile)
-                        nsteps = batch.x.shape[0]
-                        acc: Dict[str, float] = {}
-                        for i in range(nsteps):
+                        for i in range(batch.x.shape[0]):
                             if (max_num_batch is not None
                                     and nb >= max_num_batch):
                                 break
                             b_i = jax.tree_util.tree_map(
                                 lambda a, i=i: a[i], batch)
                             state, m = train_step(state, b_i)
-                            for k, v in m.items():
-                                acc[k] = acc.get(k, 0.0) + float(v)
+                            _accumulate_metrics(acc_train, m)
                             nb += 1
-                        metrics = acc
                     else:
                         state, metrics = train_step(state, batch)
+                        _accumulate_metrics(acc_train, metrics)
                         nb += 1
-                if metrics:  # empty when the cap zeroed a remainder group
-                    tot += float(metrics["loss"])
-                    for k, v in metrics.items():
-                        if k.startswith("task_") or k.endswith("_loss"):
-                            task_tot[k] = task_tot.get(k, 0.0) + float(v)
                 if max_num_batch is not None and nb >= max_num_batch:
                     break
-        train_loss = tot / max(nb, 1)
+        train_loss = acc_train.pop("loss", 0.0) / max(nb, 1)
+        task_tot = acc_train
 
         # ---- val/test passes ----
         if run_valtest:
-            val_loss = _eval_epoch(eval_step, state, val_loader, tr,
-                                   "validate", multi_eval_step,
-                                   steps_per_call)
-            test_loss = _eval_epoch(eval_step, state, test_loader, tr,
-                                    "test", multi_eval_step, steps_per_call)
+            val_loss, val_tasks = _eval_epoch(
+                eval_step, state, val_loader, tr, "validate",
+                multi_eval_step, steps_per_call)
+            test_loss, test_tasks = _eval_epoch(
+                eval_step, state, test_loader, tr, "test",
+                multi_eval_step, steps_per_call)
         else:
             val_loss = test_loss = float("nan")
+            val_tasks = test_tasks = {}
 
         if keep_best and val_loss == val_loss and val_loss < best_val:
             best_val = val_loss
@@ -265,16 +258,23 @@ def train_validate_test(
         history["val_loss"].append(val_loss)
         history["test_loss"].append(test_loss)
         history["lr"].append(lr)
-        # per-task / per-component losses (reference: TensorBoard scalars
-        # per epoch total + per task, train_validate_test.py:196-203)
+        # per-task / per-component losses for all three passes (reference:
+        # task_loss_train/val/test tracking + TensorBoard scalars,
+        # train_validate_test.py:93-96,196-203)
         for k, v in task_tot.items():
             history.setdefault(k, []).append(v / max(nb, 1))
+        for prefix, tasks in (("val", val_tasks), ("test", test_tasks)):
+            for k, v in tasks.items():
+                history.setdefault(f"{prefix}_{k}", []).append(v)
         if tb is not None:
             tb.add_scalar("train/loss", train_loss, epoch)
             tb.add_scalar("val/loss", val_loss, epoch)
             tb.add_scalar("test/loss", test_loss, epoch)
             for k, v in task_tot.items():
                 tb.add_scalar(f"train/{k}", v / max(nb, 1), epoch)
+            for prefix, tasks in (("val", val_tasks), ("test", test_tasks)):
+                for k, v in tasks.items():
+                    tb.add_scalar(f"{prefix}/{k}", v, epoch)
         log(f"epoch {epoch}: train {train_loss:.5f} val {val_loss:.5f} "
             f"test {test_loss:.5f} lr {lr:.2e}")
 
@@ -313,17 +313,32 @@ def _group_batches(loader, size):
         yield _stack_batches(buf)
 
 
-def _eval_one(eval_step, state, batch) -> float:
+def _accumulate_metrics(acc: Dict[str, float], metrics, summed=False):
+    """Accumulate the loss/per-task scalars from one step (or one stacked
+    multi-step, `summed=True`) into `acc` — one host transfer for the whole
+    metrics dict, not one per key."""
+    vals = jax.device_get(metrics)
+    for k, v in vals.items():
+        if k == "loss" or k.startswith("task_") or k.endswith("_loss"):
+            acc[k] = acc.get(k, 0.0) + (float(np.sum(v)) if summed
+                                        else float(v))
+
+
+def _eval_one(eval_step, state, batch, acc: Dict[str, float]):
     out = eval_step(state, batch)
     metrics = out[0] if isinstance(out, tuple) else out
-    return float(metrics["loss"])
+    _accumulate_metrics(acc, metrics)
 
 
 def _eval_epoch(eval_step, state, loader, tr, name: str,
-                multi_eval_step=None, steps_per_call: int = 1) -> float:
+                multi_eval_step=None, steps_per_call: int = 1):
+    """Returns (mean loss, {metric: mean}) over the loader — per-task
+    losses included (reference: task_loss_val/test tracking,
+    train_validate_test.py:93-96,180-187)."""
     if loader is None:
-        return float("nan")
-    tot, nb = 0.0, 0
+        return float("nan"), {}
+    acc: Dict[str, float] = {}
+    nb = 0
     # grouping only pays off when at least one full group exists; a loader
     # shorter than S would stack and immediately re-slice for nothing
     grouped = (multi_eval_step is not None and steps_per_call > 1
@@ -333,19 +348,20 @@ def _eval_epoch(eval_step, state, loader, tr, name: str,
             for stacked in _group_batches(loader, steps_per_call):
                 n = stacked.x.shape[0]
                 if n == steps_per_call:
-                    m = multi_eval_step(state, stacked)
-                    tot += float(jnp.sum(m["loss"]))
+                    _accumulate_metrics(
+                        acc, multi_eval_step(state, stacked), summed=True)
                 else:  # remainder: single steps, no second scan compile
                     for i in range(n):
-                        tot += _eval_one(eval_step, state,
-                                         jax.tree_util.tree_map(
-                                             lambda a, i=i: a[i], stacked))
+                        _eval_one(eval_step, state,
+                                  jax.tree_util.tree_map(
+                                      lambda a, i=i: a[i], stacked), acc)
                 nb += n
-            return tot / max(nb, 1)
-        for batch in loader:
-            tot += _eval_one(eval_step, state, batch)
-            nb += 1
-    return tot / max(nb, 1)
+        else:
+            for batch in loader:
+                _eval_one(eval_step, state, batch, acc)
+                nb += 1
+    means = {k: v / max(nb, 1) for k, v in acc.items()}
+    return means.pop("loss", float("nan")), means
 
 
 def _tensorboard_writer(run_dir: str):

@@ -456,3 +456,18 @@ def test_spmd_steps_per_call_equivalence():
         num_shards=ndev)
     assert len(history["train_loss"]) == 2
     assert all(np.isfinite(v) for v in history["train_loss"])
+
+
+def test_per_task_val_test_history():
+    """val/test per-task losses recorded every epoch (reference:
+    task_loss_val/test, train_validate_test.py:93-96)."""
+    samples = deterministic_graph_dataset(num_configs=32,
+                                          heads=("graph", "node"))
+    splits = split_dataset(samples, 0.7)
+    cfg = make_config("GIN", heads=("graph", "node"))
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 2
+    _, history, _, _ = run_training(cfg, datasets=splits, num_shards=1)
+    for key in ("task_0", "task_1", "val_task_0", "val_task_1",
+                "test_task_0", "test_task_1"):
+        assert key in history and len(history[key]) == 2, key
+        assert all(np.isfinite(v) for v in history[key]), key
