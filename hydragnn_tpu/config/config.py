@@ -93,6 +93,15 @@ def update_config(config: Dict[str, Any], train_data, val_data=None,
     train_cfg = nn["Training"]
     voi = nn["Variables_of_interest"]
 
+    # ds_config compat: the reference's only gradient-accumulation knob is
+    # DeepSpeed's (parse_deepspeed_config, config_utils.py:319-336); map it
+    # onto Training.gradient_accumulation_steps (optax.MultiSteps)
+    ds_cfg = nn.get("ds_config", {})
+    if ("gradient_accumulation_steps" in ds_cfg
+            and "gradient_accumulation_steps" not in train_cfg):
+        train_cfg["gradient_accumulation_steps"] = int(
+            ds_cfg["gradient_accumulation_steps"])
+
     sample0 = train_data[0]
     graph_size_variable = _graph_size_variable(train_data, val_data, test_data)
     env = os.getenv("HYDRAGNN_USE_VARIABLE_GRAPH_SIZE")

@@ -142,6 +142,16 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     tx = select_optimizer(train_cfg)
     state = TrainState.create(variables, tx)
 
+    accum = int(train_cfg.get("gradient_accumulation_steps", 1) or 1)
+    if accum > 1 and len(train_loader) % accum:
+        import logging
+        logging.getLogger("hydragnn_tpu").warning(
+            "gradient_accumulation_steps=%d does not divide the %d train "
+            "batches/epoch: the trailing micro-batch's gradient carries "
+            "into the next epoch's first update (and is dropped after the "
+            "last epoch) — same micro-step counting as DeepSpeed's",
+            accum, len(train_loader))
+
     loss_name = train_cfg.get("loss_function_type", "mse")
     cge = bool(train_cfg.get("compute_grad_energy", False))
     if num_shards > 1:

@@ -29,7 +29,14 @@ _FACTORIES = {
 
 
 def select_optimizer(train_config: Dict[str, Any]) -> optax.GradientTransformation:
-    """reference: select_optimizer (optimizer.py:104-113)."""
+    """reference: select_optimizer (optimizer.py:104-113).
+
+    `Training.gradient_accumulation_steps > 1` wraps the transform in
+    optax.MultiSteps: each loader batch becomes a micro-batch whose
+    gradients accumulate (averaged) and apply every k-th call — the
+    reference only offers this through DeepSpeed's ds_config
+    (gradient_accumulation_steps, config_utils.py:326-330); update_config
+    maps that key here for reference configs."""
     opt_cfg = train_config.get("Optimizer", {"type": "AdamW"})
     name = opt_cfg.get("type", "AdamW")
     lr = float(opt_cfg.get("learning_rate", 1e-3))
@@ -45,21 +52,38 @@ def select_optimizer(train_config: Dict[str, Any]) -> optax.GradientTransformati
             tx = optax.chain(optax.clip_by_global_norm(float(clip)), tx)
         return tx
 
-    return make(learning_rate=lr)
+    tx = make(learning_rate=lr)
+    accum = int(train_config.get("gradient_accumulation_steps", 1) or 1)
+    if accum > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=accum) \
+            .gradient_transformation()
+    return tx
+
+
+def _lr_state(opt_state):
+    """The InjectHyperparamsState, descending through a MultiSteps wrapper
+    (gradient accumulation) when present."""
+    if hasattr(opt_state, "hyperparams"):
+        return opt_state
+    inner = getattr(opt_state, "inner_opt_state", None)
+    if inner is not None and hasattr(inner, "hyperparams"):
+        return inner
+    return None
 
 
 def get_learning_rate(opt_state) -> float:
-    return float(opt_state.hyperparams["learning_rate"])
+    return float(_lr_state(opt_state).hyperparams["learning_rate"])
 
 
 def set_learning_rate(opt_state, lr: float):
     import jax.numpy as jnp
-    old = opt_state.hyperparams["learning_rate"]
-    opt_state.hyperparams["learning_rate"] = jnp.asarray(
+    target = _lr_state(opt_state)
+    old = target.hyperparams["learning_rate"]
+    target.hyperparams["learning_rate"] = jnp.asarray(
         lr, dtype=getattr(old, "dtype", jnp.float32))
     return opt_state
 
 
 def supports_lr_schedule(opt_state) -> bool:
-    return hasattr(opt_state, "hyperparams") and \
-        "learning_rate" in opt_state.hyperparams
+    state = _lr_state(opt_state)
+    return state is not None and "learning_rate" in state.hyperparams

@@ -471,3 +471,59 @@ def test_per_task_val_test_history():
                 "test_task_0", "test_task_1"):
         assert key in history and len(history[key]) == 2, key
         assert all(np.isfinite(v) for v in history[key]), key
+
+
+def test_gradient_accumulation_matches_large_batch():
+    """gradient_accumulation_steps=2 with batch B/2 must match one step at
+    batch B (equal-size micro-batches -> mean of means == combined grad);
+    the LR plateau schedule must still see the injected hyperparams through
+    the MultiSteps wrapper (reference: DeepSpeed
+    gradient_accumulation_steps, config_utils.py:326-330)."""
+    import jax
+    import jax.numpy as jnp
+    from hydragnn_tpu.config import build_model_config, update_config
+    from hydragnn_tpu.graphs.batch import collate
+    from hydragnn_tpu.models.create import create_model, init_params
+    from hydragnn_tpu.train.optimizer import (select_optimizer,
+                                              supports_lr_schedule,
+                                              get_learning_rate)
+    from hydragnn_tpu.train.train_step import TrainState, make_train_step
+
+    samples = deterministic_graph_dataset(num_configs=8)
+    # EGNN (equivariant): identity feature layers, no BatchNorm — batch
+    # statistics would otherwise legitimately differ between one big batch
+    # and two micro-batches (true for the reference's DeepSpeed
+    # accumulation as well)
+    cfg = make_config("EGNN", equivariance=True)
+    cfg = update_config(cfg, samples)
+    mcfg = build_model_config(cfg)
+    model = create_model(mcfg)
+    kw = dict(n_node=80, n_edge=560, n_graph=5)
+    big = collate(samples[:8], n_node=160, n_edge=1120, n_graph=9)
+    micro = [collate(samples[:4], **kw), collate(samples[4:], **kw)]
+    variables = init_params(model, micro[0])
+    fresh_vars = lambda: jax.tree_util.tree_map(jnp.array, variables)
+
+    tcfg = cfg["NeuralNetwork"]["Training"]
+    tx_big = select_optimizer(tcfg)
+    s_big = TrainState.create({"params": fresh_vars()["params"]}, tx_big)
+    step_big = make_train_step(model, mcfg, tx_big, donate=False)
+    s_big, _ = step_big(s_big, big)
+
+    tcfg["gradient_accumulation_steps"] = 2
+    tx_acc = select_optimizer(tcfg)
+    s_acc = TrainState.create({"params": fresh_vars()["params"]}, tx_acc)
+    assert supports_lr_schedule(s_acc.opt_state)
+    assert get_learning_rate(s_acc.opt_state) > 0
+    step_acc = make_train_step(model, mcfg, tx_acc, donate=False)
+    s_acc, _ = step_acc(s_acc, micro[0])
+    # first micro step only accumulates: params unchanged
+    for a, b in zip(jax.tree_util.tree_leaves(variables["params"]),
+                    jax.tree_util.tree_leaves(s_acc.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    s_acc, _ = step_acc(s_acc, micro[1])
+
+    for a, b in zip(jax.tree_util.tree_leaves(s_big.params),
+                    jax.tree_util.tree_leaves(s_acc.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
