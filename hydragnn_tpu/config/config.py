@@ -96,11 +96,15 @@ def update_config(config: Dict[str, Any], train_data, val_data=None,
     # ds_config compat: the reference's only gradient-accumulation knob is
     # DeepSpeed's (parse_deepspeed_config, config_utils.py:319-336); map it
     # onto Training.gradient_accumulation_steps (optax.MultiSteps)
-    ds_cfg = nn.get("ds_config", {})
-    if ("gradient_accumulation_steps" in ds_cfg
+    ds_cfg = nn.get("ds_config") or {}
+    if (isinstance(ds_cfg, dict)
+            and "gradient_accumulation_steps" in ds_cfg
             and "gradient_accumulation_steps" not in train_cfg):
-        train_cfg["gradient_accumulation_steps"] = int(
-            ds_cfg["gradient_accumulation_steps"])
+        try:
+            train_cfg["gradient_accumulation_steps"] = int(
+                ds_cfg["gradient_accumulation_steps"])
+        except (TypeError, ValueError):
+            pass  # DeepSpeed's "auto" -> leave accumulation off
 
     sample0 = train_data[0]
     graph_size_variable = _graph_size_variable(train_data, val_data, test_data)

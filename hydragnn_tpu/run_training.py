@@ -24,7 +24,7 @@ from .train.train_step import TrainState, make_eval_step, make_train_step
 from .train.trainer import train_validate_test
 from .utils import profiling as tr
 from .utils.checkpoint import save_model
-from .utils.print_utils import print_peak_memory, setup_log
+from .utils.print_utils import log, print_peak_memory, setup_log
 
 
 def _load_datasets_from_config(config):
@@ -141,6 +141,30 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     variables = init_params(model, init_batch)
     tx = select_optimizer(train_cfg)
     state = TrainState.create(variables, tx)
+
+    # resume / transfer: Training.continue + startfrom name the run whose
+    # checkpoint seeds this one (reference: load_existing_model_config,
+    # utils/model/model.py:91-98, called from run_training.py:113-115)
+    if train_cfg.get("continue"):
+        from .utils.checkpoint import load_existing_model
+        start_name = train_cfg.get("startfrom", log_name)
+        try:
+            restored = load_existing_model(state, start_name)
+        except Exception as exc:  # noqa: BLE001 — orbax raises opaque
+            # tree-mismatch errors when the checkpointed optimizer state
+            # doesn't match this config's (different Optimizer.type /
+            # gradient_accumulation_steps / use_zero_redundancy)
+            raise ValueError(
+                f"could not restore run '{start_name}' for "
+                "Training.continue: the checkpoint's optimizer state does "
+                "not match this config's optimizer settings "
+                f"({type(exc).__name__}: {exc})") from exc
+        if restored is None:
+            raise ValueError(
+                f"Training.continue is set but run '{start_name}' has no "
+                "checkpoint under ./logs")
+        state = restored
+        log(f"resumed from '{start_name}' at step {int(state.step)}")
 
     accum = int(train_cfg.get("gradient_accumulation_steps", 1) or 1)
     if accum > 1 and len(train_loader) % accum:

@@ -105,3 +105,39 @@ def test_spmd_prediction_matches_single_shard():
     for a, b, c, d in zip(t1, p1, t8, p8):
         assert len(a) == len(c)
         assert rows(a, b) == rows(c, d)
+
+
+def test_continue_startfrom_resumes_training(tmp_path, monkeypatch):
+    """Training.continue + startfrom seed a new run from a previous run's
+    checkpoint (reference: load_existing_model_config,
+    utils/model/model.py:91-98)."""
+    import pytest
+    monkeypatch.chdir(tmp_path)  # checkpoints land under ./logs
+    samples = deterministic_graph_dataset(num_configs=32)
+    splits = split_dataset(samples, 0.7)
+    cfg = make_config("GIN")
+    t = cfg["NeuralNetwork"]["Training"]
+    t["num_epoch"] = 2
+    t["Checkpoint"] = True
+    state1, _, _, completed = run_training(cfg, datasets=splits,
+                                           num_shards=1)
+    from hydragnn_tpu.config import get_log_name_config
+    first_run = get_log_name_config(completed)
+
+    cfg2 = make_config("GIN")
+    t2 = cfg2["NeuralNetwork"]["Training"]
+    t2["num_epoch"] = 1
+    t2["continue"] = 1
+    t2["startfrom"] = first_run
+    t2["keep_best"] = False
+    state2, _, _, _ = run_training(cfg2, datasets=splits, num_shards=1)
+    # resumed state continues counting from the restored step
+    assert int(state2.step) > int(state1.step) >= 2
+
+    cfg3 = make_config("GIN")
+    t3 = cfg3["NeuralNetwork"]["Training"]
+    t3["num_epoch"] = 1
+    t3["continue"] = 1
+    t3["startfrom"] = "no_such_run"
+    with pytest.raises(ValueError, match="no\\s+checkpoint"):
+        run_training(cfg3, datasets=splits, num_shards=1)
