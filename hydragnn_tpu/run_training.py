@@ -147,7 +147,7 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     # utils/model/model.py:91-98, called from run_training.py:113-115)
     if train_cfg.get("continue"):
         from .utils.checkpoint import load_existing_model
-        start_name = train_cfg.get("startfrom", log_name)
+        start_name = train_cfg.get("startfrom") or log_name
         try:
             restored = load_existing_model(state, start_name)
         except Exception as exc:  # noqa: BLE001 — orbax raises opaque
@@ -156,8 +156,9 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
             # gradient_accumulation_steps / use_zero_redundancy)
             raise ValueError(
                 f"could not restore run '{start_name}' for "
-                "Training.continue: the checkpoint's optimizer state does "
-                "not match this config's optimizer settings "
+                "Training.continue: the checkpointed state does not match "
+                "this config (changed Architecture/Optimizer settings?) "
+                f"or the checkpoint is unreadable "
                 f"({type(exc).__name__}: {exc})") from exc
         if restored is None:
             raise ValueError(
@@ -284,7 +285,8 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         checkpoint_fn=ckpt_fn, verbosity=verbosity, tracer=tr.get(),
         place_fn=place_fn, profiler=profiler, walltime_deadline=deadline,
         multi_train_step=multi_step, steps_per_call=steps_per_call,
-        place_group_fn=place_group_fn, multi_eval_step=multi_eval)
+        place_group_fn=place_group_fn, multi_eval_step=multi_eval,
+        keep_best=bool(train_cfg.get("keep_best", True)))
 
     if train_cfg.get("Checkpoint", False):
         from .utils.checkpoint import wait_for_checkpoints

@@ -288,8 +288,9 @@ def train_validate_test(
             print_distributed(verbosity, 1, "walltime guard: stopping")
             break
 
-    with open(os.path.join(run_dir, "history.json"), "w") as f:
-        json.dump(history, f)
+    if jax.process_index() == 0:  # all processes hold identical history
+        with open(os.path.join(run_dir, "history.json"), "w") as f:
+            json.dump(history, f)
     if tb is not None:
         tb.close()
     if keep_best and best_state is not None:
@@ -366,8 +367,9 @@ def _eval_epoch(eval_step, state, loader, tr, name: str,
 
 def _tensorboard_writer(run_dir: str):
     """TensorBoard scalars via torch (CPU build is baked in) — parity with
-    reference SummaryWriter use (utils/model/model.py:82-88)."""
-    if os.getenv("HYDRAGNN_DISABLE_TB"):
+    reference SummaryWriter use (utils/model/model.py:82-88; rank-0 only,
+    like the reference's get_summary_writer)."""
+    if os.getenv("HYDRAGNN_DISABLE_TB") or jax.process_index() != 0:
         return None
     try:
         from torch.utils.tensorboard import SummaryWriter
