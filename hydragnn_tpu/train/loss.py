@@ -23,12 +23,16 @@ def head_targets(cfg: ModelConfig, batch: GraphBatch) -> List[jnp.ndarray]:
     (`get_head_indices`, train/train_validate_test.py:314-377)."""
     targets = []
     for head in cfg.heads:
-        if head.head_type == "graph":
-            targets.append(
-                batch.y_graph[:, head.offset:head.offset + head.output_dim])
-        else:
-            targets.append(
-                batch.y_node[:, head.offset:head.offset + head.output_dim])
+        y = batch.y_graph if head.head_type == "graph" else batch.y_node
+        end = head.offset + head.output_dim
+        if y is None or y.shape[1] < end:
+            have = 0 if y is None else y.shape[1]
+            raise ValueError(
+                f"{head.head_type} head needs packed label columns "
+                f"[{head.offset}:{end}) but the batch carries {have} — "
+                "the dataset provides fewer targets than "
+                "Variables_of_interest selects")
+        targets.append(y[:, head.offset:end])
     return targets
 
 

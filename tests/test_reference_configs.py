@@ -76,3 +76,24 @@ def test_reference_ci_config_trains_unchanged():
     assert history["train_loss"][-1] < history["train_loss"][0] * 5
     import numpy as np
     assert all(np.isfinite(v) for v in history["train_loss"])
+
+
+def test_reference_ci_multihead_config_trains_unchanged():
+    """The upstream ci_multihead.json (graph + node heads, per-task
+    weights) trains end-to-end with only the epoch count reduced."""
+    from hydragnn_tpu.run_training import run_training
+    from tests.deterministic_data import deterministic_graph_dataset
+
+    cfg = _load("ci_multihead.json")
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 3
+    voi = cfg["NeuralNetwork"]["Variables_of_interest"]
+    heads = tuple("graph" if t == "graph" else "node" for t in voi["type"])
+    samples = deterministic_graph_dataset(num_configs=48, heads=heads)
+    state, history, model, completed = run_training(
+        cfg, datasets=(samples[:32], samples[32:40], samples[40:]),
+        num_shards=1)
+    import numpy as np
+    assert all(np.isfinite(v) for v in history["train_loss"])
+    # one task_ metric per configured output
+    ntasks = len(voi["type"])
+    assert all(f"task_{i}" in history for i in range(ntasks))
