@@ -68,9 +68,69 @@ def deterministic_graph_dataset(
     # min-max normalize graph targets to [0, 1] — the reference raw loader
     # does the same (hydragnn/utils/datasets/abstractrawdataset.py normalize)
     if "graph" in heads:
-        vals = np.asarray([s.y_graph[0] for s in samples])
-        lo, hi = vals.min(), vals.max()
-        span = max(hi - lo, 1e-8)
-        for s in samples:
-            s.y_graph = ((s.y_graph - lo) / span).astype(np.float32)
+        _minmax_normalize_graph_targets(samples)
+    return samples
+
+
+def _minmax_normalize_graph_targets(samples):
+    """Per-column min-max of y_graph to [0, 1] across the dataset — the
+    reference raw loader's normalization
+    (hydragnn/utils/datasets/abstractrawdataset.py)."""
+    ys = np.stack([s.y_graph for s in samples])
+    lo, hi = ys.min(0), ys.max(0)
+    span = np.maximum(hi - lo, 1e-8)
+    for s in samples:
+        s.y_graph = ((s.y_graph - lo) / span).astype(np.float32)
+
+
+def deterministic_samples_for_config(config, num_configs=12, seed=0):
+    """Config-driven variant: builds the full node/graph feature menus the
+    Dataset section declares (arbitrary per-feature dims, e.g.
+    ci_vectoroutput.json's [2,1,2] vector blocks) and packs targets through
+    the real selection path (preprocess.transforms.update_predicted_values,
+    honoring any output_index order) — the reference CI's
+    deterministic-dataset + update_predicted_values flow."""
+    from hydragnn_tpu.preprocess.transforms import (update_atom_features,
+                                                     update_predicted_values)
+
+    ds = config["Dataset"]
+    voi = config["NeuralNetwork"]["Variables_of_interest"]
+    node_dims = list(ds["node_features"]["dim"])
+    graph_dims = list(ds.get("graph_features", {}).get("dim", []))
+    arch = config["NeuralNetwork"]["Architecture"]
+    radius = float(arch.get("radius") or 1.0)
+    max_nb = int(arch.get("max_neighbours") or 100)
+
+    rng = np.random.RandomState(seed)
+    samples = []
+    for _ in range(num_configs):
+        pos = bcc_positions(rng.randint(1, 4), rng.randint(1, 4),
+                            rng.randint(1, 3))
+        n = pos.shape[0]
+        types = np.arange(n) % 3
+        x = (types.astype(np.float32) + 1.0) / 3.0
+        powers = [x, x ** 2, x ** 3]
+        # menu blocks: block i of dim d holds columns x^(i+j mod 3 + 1)
+        cols = []
+        for i, d in enumerate(node_dims):
+            for j in range(int(d)):
+                cols.append(powers[(i + j) % 3])
+        node_menu = np.stack(cols, axis=1).astype(np.float32)
+        gvals = []
+        for i, d in enumerate(graph_dims):
+            for j in range(int(d)):
+                gvals.append(powers[(i + j) % 3].sum())
+        graph_menu = np.asarray(gvals, np.float32)
+        send, recv = radius_graph(pos, radius, max_nb)
+        y_graph, y_node = update_predicted_values(
+            voi["type"], voi["output_index"], graph_menu, node_menu,
+            graph_dims, node_dims)
+        # inputs: the column blocks input_node_features selects
+        x_in = update_atom_features(voi.get("input_node_features", [0]),
+                                    node_menu, node_dims)
+        samples.append(GraphSample(
+            x=x_in.astype(np.float32), pos=pos, senders=send, receivers=recv,
+            y_graph=y_graph, y_node=y_node))
+    if samples[0].y_graph is not None:
+        _minmax_normalize_graph_targets(samples)
     return samples

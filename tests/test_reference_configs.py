@@ -97,3 +97,22 @@ def test_reference_ci_multihead_config_trains_unchanged():
     # one task_ metric per configured output
     ntasks = len(voi["type"])
     assert all(f"task_{i}" in history for i in range(ntasks))
+
+
+@pytest.mark.parametrize("name", ["ci_vectoroutput.json", "ci_conv_head.json"])
+def test_reference_special_configs_train_unchanged(name):
+    """ci_vectoroutput (vector feature blocks, non-sequential output_index)
+    and ci_conv_head (conv-type node head) train end-to-end with only the
+    epoch count reduced, via the config-driven deterministic generator."""
+    from hydragnn_tpu.run_training import run_training
+    from tests.deterministic_data import deterministic_samples_for_config
+    import numpy as np
+
+    cfg = _load(name)
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 2
+    cfg.setdefault("Visualization", {})["create_plots"] = False
+    samples = deterministic_samples_for_config(cfg, num_configs=24)
+    state, history, _, _ = run_training(
+        cfg, datasets=(samples[:16], samples[16:20], samples[20:]),
+        num_shards=1)
+    assert all(np.isfinite(v) for v in history["train_loss"])
