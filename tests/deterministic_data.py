@@ -131,6 +131,6 @@ def deterministic_samples_for_config(config, num_configs=12, seed=0):
         samples.append(GraphSample(
             x=x_in.astype(np.float32), pos=pos, senders=send, receivers=recv,
             y_graph=y_graph, y_node=y_node))
-    if samples[0].y_graph is not None:
+    if samples and samples[0].y_graph is not None:
         _minmax_normalize_graph_targets(samples)
     return samples

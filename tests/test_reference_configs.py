@@ -26,6 +26,17 @@ def _configs():
                   for p in glob.glob(os.path.join(REF_INPUTS, "ci*.json")))
 
 
+
+
+def _swap_equivariant_model(cfg):
+    """The reference's equivariant sweep swaps an equivariance-capable stack
+    in for PNA at runtime (tests/test_graphs.py:230-233)."""
+    arch = cfg["NeuralNetwork"]["Architecture"]
+    if arch.get("equivariance") and arch["model_type"] == "PNA":
+        arch["model_type"] = "EGNN"
+    return cfg
+
+
 @pytest.mark.parametrize("name", _configs())
 def test_reference_config_completes(name):
     """Every upstream CI config parses and completes into a buildable model
@@ -44,11 +55,7 @@ def test_reference_config_completes(name):
         base = _load("ci.json")
         cfg = merge_config(base, {"NeuralNetwork": {"Architecture":
                                                     cfg["Architecture"]}})
-    arch = cfg["NeuralNetwork"]["Architecture"]
-    if arch.get("equivariance") and arch["model_type"] == "PNA":
-        # the reference's equivariant sweep swaps in an equivariance-capable
-        # stack at runtime (tests/test_graphs.py:230-233)
-        arch["model_type"] = "EGNN"
+    arch = _swap_equivariant_model(cfg)["NeuralNetwork"]["Architecture"]
     voi = cfg["NeuralNetwork"]["Variables_of_interest"]
     heads = tuple("graph" if t == "graph" else "node" for t in voi["type"])
     # the unit_test format generates x/x2/x3 node features + their sum as the
@@ -99,17 +106,20 @@ def test_reference_ci_multihead_config_trains_unchanged():
     assert all(f"task_{i}" in history for i in range(ntasks))
 
 
-@pytest.mark.parametrize("name", ["ci_vectoroutput.json", "ci_conv_head.json"])
+@pytest.mark.parametrize("name", ["ci_vectoroutput.json", "ci_conv_head.json",
+                                  "ci_equivariant.json"])
 def test_reference_special_configs_train_unchanged(name):
-    """ci_vectoroutput (vector feature blocks, non-sequential output_index)
-    and ci_conv_head (conv-type node head) train end-to-end with only the
-    epoch count reduced, via the config-driven deterministic generator."""
+    """ci_vectoroutput (vector feature blocks, non-sequential output_index),
+    ci_conv_head (conv-type node head), and ci_equivariant train end-to-end
+    with only the epoch count reduced, via the config-driven deterministic
+    generator."""
     from hydragnn_tpu.run_training import run_training
     from tests.deterministic_data import deterministic_samples_for_config
     import numpy as np
 
     cfg = _load(name)
     cfg["NeuralNetwork"]["Training"]["num_epoch"] = 2
+    _swap_equivariant_model(cfg)
     cfg.setdefault("Visualization", {})["create_plots"] = False
     samples = deterministic_samples_for_config(cfg, num_configs=24)
     state, history, _, _ = run_training(
