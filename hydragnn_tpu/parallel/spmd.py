@@ -31,8 +31,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config.config import ModelConfig
 from ..graphs.batch import GraphBatch
-from ..train.loss import energy_force_loss, multihead_loss
-from ..train.train_step import TrainState, freeze_conv_grads
+from ..train.train_step import (TrainState, eval_metrics_and_outputs,
+                                freeze_conv_grads, make_forward_fn,
+                                make_loss_fn)
 
 
 def _batch_spec(batch: GraphBatch):
@@ -48,7 +49,8 @@ def _make_spmd_step_body(model, cfg: ModelConfig,
                          energy_weight: float = 1.0,
                          force_weight: float = 1.0,
                          zero_opt: bool = False,
-                         zero_min_size: int = 2 ** 14):
+                         zero_min_size: int = 2 ** 14,
+                         compute_dtype=None):
     """Pure (un-jitted) SPMD step body shared by make_spmd_train_step
     (direct jit) and make_spmd_multi_train_step (lax.scan).
 
@@ -58,28 +60,13 @@ def _make_spmd_step_body(model, cfg: ModelConfig,
     shard_map with the optimizer-state pytree sharded over the data axis
     (mesh.param_sharding_zero): XLA partitions the elementwise update and
     inserts reduce-scatter/all-gather collectives itself — per-device
-    optimizer-state memory drops by ~1/D for the large leaves."""
+    optimizer-state memory drops by ~1/D for the large leaves.
 
-    def loss_fn(params, batch_stats, batch: GraphBatch):
-        variables = {"params": params, "batch_stats": batch_stats}
-        if compute_grad_energy:
-            def apply_fn(v, b, train):
-                out, _ = model.apply(v, b, train=train, mutable=["batch_stats"])
-                return out
-            total, aux = energy_force_loss(
-                apply_fn, variables, cfg, batch, loss_name,
-                energy_weight, force_weight, train=True)
-            return total, (batch_stats,
-                           {"loss": total, "energy_loss": aux["energy_loss"],
-                            "force_loss": aux["force_loss"]})
-        out_and_var, mutated = model.apply(
-            variables, batch, train=True, mutable=["batch_stats"])
-        outputs, outputs_var = out_and_var
-        total, tasks = multihead_loss(cfg, loss_name, outputs, outputs_var, batch)
-        metrics = {"loss": total}
-        for i, t in enumerate(tasks):
-            metrics[f"task_{i}"] = t
-        return total, (mutated["batch_stats"], metrics)
+    Architecture.dtype="bfloat16" (or `compute_dtype`) selects mixed
+    precision exactly as in the single-device step — the loss body IS the
+    single-device one (train_step.make_loss_fn)."""
+    loss_fn = make_loss_fn(model, cfg, loss_name, compute_grad_energy,
+                           energy_weight, force_weight, compute_dtype)
 
     def grads_per_device(params, batch_stats, batch: GraphBatch):
         # strip the leading device axis (size 1 inside the shard)
@@ -169,26 +156,17 @@ def make_spmd_multi_train_step(model, cfg: ModelConfig,
 def make_spmd_eval_step(model, cfg: ModelConfig, mesh: Mesh,
                         loss_name: str = "mse",
                         compute_grad_energy: bool = False,
-                        energy_weight: float = 1.0, force_weight: float = 1.0):
+                        energy_weight: float = 1.0, force_weight: float = 1.0,
+                        compute_dtype=None):
+    forward = make_forward_fn(model, cfg, compute_dtype)
+
     def per_device(params, batch_stats, batch: GraphBatch):
         local = jax.tree_util.tree_map(
             lambda a: None if a is None else a[0], batch)
         variables = {"params": params, "batch_stats": batch_stats}
-        if compute_grad_energy:
-            def apply_fn(v, b, train):
-                return model.apply(v, b, train=train)
-            total, aux = energy_force_loss(
-                apply_fn, variables, cfg, local, loss_name,
-                energy_weight, force_weight, train=False)
-            metrics = {"loss": total, "energy_loss": aux["energy_loss"],
-                       "force_loss": aux["force_loss"]}
-        else:
-            outputs, outputs_var = model.apply(variables, local, train=False)
-            total, tasks = multihead_loss(cfg, loss_name, outputs,
-                                          outputs_var, local)
-            metrics = {"loss": total}
-            for i, t in enumerate(tasks):
-                metrics[f"task_{i}"] = t
+        metrics, _ = eval_metrics_and_outputs(
+            forward, cfg, loss_name, variables, local, compute_grad_energy,
+            energy_weight, force_weight)
         # sample-weighted global mean: shards may hold unequal real-graph
         # counts (drop_last=False tail batches), so weight each shard's
         # masked mean by its real count before the cross-shard reduction
@@ -224,19 +202,23 @@ def make_spmd_dispatch_group(model, cfg: ModelConfig,
     return multi, (lambda b: shard_stacked_batch(b, mesh))
 
 
-def make_spmd_predict_step(model, mesh: Mesh):
+def make_spmd_predict_step(model, mesh: Mesh, cfg: Optional[ModelConfig] = None,
+                           compute_dtype=None):
     """Per-head predictions over a device-stacked batch: each device runs
     the forward on its shard, outputs concatenate over the data axis
     (device-major — matching a [D, ...] -> [D*..., ...] flatten of the
     batch). The SPMD half of run_prediction (reference: run_prediction
     evaluates under the same DDP layout as training, run_prediction.py:62-97,
-    with per-rank gathers at train_validate_test.py:709-737)."""
+    with per-rank gathers at train_validate_test.py:709-737). With a `cfg`,
+    Architecture.dtype selects the same bf16 compute as the single-device
+    eval, so predictions don't depend on the shard count."""
+    forward = make_forward_fn(model, cfg, compute_dtype)
 
     def per_device(params, batch_stats, batch: GraphBatch):
         local = jax.tree_util.tree_map(
             lambda a: None if a is None else a[0], batch)
-        variables = {"params": params, "batch_stats": batch_stats}
-        outputs, _ = model.apply(variables, local, train=False)
+        outputs, _ = forward(
+            {"params": params, "batch_stats": batch_stats}, local)
         return outputs
 
     @jax.jit

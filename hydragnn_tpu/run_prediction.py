@@ -77,7 +77,7 @@ def run_prediction(config_or_path, datasets: Optional[Tuple] = None,
         from .parallel.mesh import make_mesh, shard_batch
         from .parallel.spmd import make_spmd_predict_step
         mesh = make_mesh((("data", num_shards),))
-        predict = make_spmd_predict_step(model, mesh)
+        predict = make_spmd_predict_step(model, mesh, mcfg)
 
         def step(state, batch):
             outputs = predict(state, shard_batch(batch, mesh))

@@ -85,12 +85,14 @@ def _resolve_compute_dtype(cfg: ModelConfig, compute_dtype):
     return jnp.dtype(name)
 
 
-def _make_step_body(model, cfg: ModelConfig, tx: optax.GradientTransformation,
-                    loss_name: str = "mse", compute_grad_energy: bool = False,
-                    energy_weight: float = 1.0, force_weight: float = 1.0,
-                    compute_dtype: Optional[str] = None):
-    """Pure (un-jitted) train-step body shared by make_train_step (direct
-    jit) and make_multi_train_step (lax.scan)."""
+def make_loss_fn(model, cfg: ModelConfig, loss_name: str = "mse",
+                 compute_grad_energy: bool = False,
+                 energy_weight: float = 1.0, force_weight: float = 1.0,
+                 compute_dtype: Optional[str] = None):
+    """loss_fn(params, batch_stats, batch) -> (total, (new_batch_stats,
+    metrics)) with the mixed-precision casting policy — the ONE training
+    loss body, shared by the single-device step factories here and the
+    SPMD factories in parallel/spmd.py so the two paths cannot drift."""
     cdtype = _resolve_compute_dtype(cfg, compute_dtype)
     mixed = cdtype != jnp.float32
 
@@ -131,6 +133,18 @@ def _make_step_body(model, cfg: ModelConfig, tx: optax.GradientTransformation,
         if mixed:  # running statistics must not degrade to bf16 across epochs
             new_bs = _cast_floats(new_bs, jnp.float32)
         return total, (new_bs, metrics)
+
+    return loss_fn
+
+
+def _make_step_body(model, cfg: ModelConfig, tx: optax.GradientTransformation,
+                    loss_name: str = "mse", compute_grad_energy: bool = False,
+                    energy_weight: float = 1.0, force_weight: float = 1.0,
+                    compute_dtype: Optional[str] = None):
+    """Pure (un-jitted) train-step body shared by make_train_step (direct
+    jit) and make_multi_train_step (lax.scan)."""
+    loss_fn = make_loss_fn(model, cfg, loss_name, compute_grad_energy,
+                           energy_weight, force_weight, compute_dtype)
 
     def step_body(state: TrainState, batch: GraphBatch):
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
@@ -186,44 +200,63 @@ def make_multi_train_step(model, cfg: ModelConfig,
     return multi_step
 
 
+def make_forward_fn(model, cfg: Optional[ModelConfig] = None,
+                    compute_dtype: Optional[str] = None):
+    """Mixed-precision inference forward — f32 variables/batch in, f32
+    outputs out, model compute in Architecture.dtype (or `compute_dtype`).
+    The ONE eval-side casting policy, shared by the single-device eval
+    body here and the SPMD eval/predict factories in parallel/spmd.py."""
+    cdtype = _resolve_compute_dtype(cfg, compute_dtype)
+    mixed = cdtype != jnp.float32
+
+    def forward(variables, batch, train=False):
+        if mixed:
+            variables = _cast_floats(variables, cdtype)
+            batch = _cast_floats(batch, cdtype)
+        out = model.apply(variables, batch, train=train)
+        return _cast_floats(out, jnp.float32) if mixed else out
+
+    return forward
+
+
+def eval_metrics_and_outputs(forward, cfg: ModelConfig, loss_name: str,
+                             variables, batch: GraphBatch,
+                             compute_grad_energy: bool = False,
+                             energy_weight: float = 1.0,
+                             force_weight: float = 1.0):
+    """(metrics, outputs) for one un-stacked batch given a `forward` from
+    make_forward_fn — the shared core of the single-device and SPMD eval
+    steps."""
+    if compute_grad_energy:
+        total, aux = energy_force_loss(
+            forward, variables, cfg, batch, loss_name, energy_weight,
+            force_weight, train=False)
+        metrics = {"loss": total,
+                   "energy_loss": aux["energy_loss"],
+                   "force_loss": aux["force_loss"]}
+        return metrics, [aux["energy_pred"], aux["forces_pred"]]
+    outputs, outputs_var = forward(variables, batch, train=False)
+    total, tasks = multihead_loss(cfg, loss_name, outputs, outputs_var,
+                                  batch)
+    metrics = {"loss": total}
+    for i, t in enumerate(tasks):
+        metrics[f"task_{i}"] = t
+    return metrics, outputs
+
+
 def _make_eval_body(model, cfg: ModelConfig, loss_name: str = "mse",
                     compute_grad_energy: bool = False,
                     energy_weight: float = 1.0, force_weight: float = 1.0,
                     compute_dtype: Optional[str] = None):
     """Pure (un-jitted) eval body shared by make_eval_step (direct jit) and
     make_multi_eval_step (lax.scan)."""
-    cdtype = _resolve_compute_dtype(cfg, compute_dtype)
-    mixed = cdtype != jnp.float32
+    forward = make_forward_fn(model, cfg, compute_dtype)
 
     def eval_step(state: TrainState, batch: GraphBatch):
         variables = {"params": state.params, "batch_stats": state.batch_stats}
-        if mixed:
-            variables = _cast_floats(variables, cdtype)
-        if compute_grad_energy:
-            def apply_fn(v, b, train):
-                if mixed:
-                    b = _cast_floats(b, cdtype)
-                out = model.apply(v, b, train=train)
-                return jax.tree_util.tree_map(
-                    lambda o: o.astype(jnp.float32), out)
-            total, aux = energy_force_loss(
-                apply_fn, variables, cfg, batch, loss_name,
-                energy_weight, force_weight, train=False)
-            metrics = {"loss": total,
-                       "energy_loss": aux["energy_loss"],
-                       "force_loss": aux["force_loss"]}
-            return metrics, [aux["energy_pred"], aux["forces_pred"]]
-        outputs, outputs_var = model.apply(
-            variables, _cast_floats(batch, cdtype) if mixed else batch,
-            train=False)
-        if mixed:
-            outputs = _cast_floats(outputs, jnp.float32)
-            outputs_var = _cast_floats(outputs_var, jnp.float32)
-        total, tasks = multihead_loss(cfg, loss_name, outputs, outputs_var, batch)
-        metrics = {"loss": total}
-        for i, t in enumerate(tasks):
-            metrics[f"task_{i}"] = t
-        return metrics, outputs
+        return eval_metrics_and_outputs(
+            forward, cfg, loss_name, variables, batch, compute_grad_energy,
+            energy_weight, force_weight)
 
     return eval_step
 

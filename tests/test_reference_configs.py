@@ -25,9 +25,6 @@ def _configs():
     return sorted(os.path.basename(p)
                   for p in glob.glob(os.path.join(REF_INPUTS, "ci*.json")))
 
-
-
-
 def _swap_equivariant_model(cfg):
     """The reference's equivariant sweep swaps an equivariance-capable stack
     in for PNA at runtime (tests/test_graphs.py:230-233)."""

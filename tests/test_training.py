@@ -527,3 +527,21 @@ def test_gradient_accumulation_matches_large_batch():
                     jax.tree_util.tree_leaves(s_acc.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-5, atol=2e-6)
+
+
+def test_spmd_bfloat16_training():
+    """Architecture.dtype="bfloat16" must drive mixed precision on the SPMD
+    path too (model compute bf16, params/losses f32) and converge."""
+    import jax
+    samples = deterministic_graph_dataset(num_configs=64)
+    splits = split_dataset(samples, 0.7)
+    cfg = make_config("PNA", dtype="bfloat16")
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 4
+    cfg["NeuralNetwork"]["Training"]["EarlyStopping"] = False
+    state, h, _, _ = run_training(cfg, datasets=splits, num_shards=8)
+    assert h["train_loss"][-1] < h["train_loss"][0]
+    assert all(np.isfinite(v) for v in h["train_loss"])
+    assert all(np.isfinite(v) for v in h["val_loss"])
+    # master params stayed f32
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        assert leaf.dtype == np.float32, leaf.dtype
