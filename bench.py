@@ -48,29 +48,14 @@ def synth_samples(num, rng):
 def _probe_device_backend(timeout_s: int = 90, attempts: int = 2,
                           retry_wait_s: int = 30):
     """The axon TPU tunnel can be down; jax.devices() then hangs forever
-    inside this process. Probe it in a subprocess with a timeout — running
-    a real op, not just device enumeration, since a wedged tunnel can list
-    the device yet hang on dispatch — and retry a couple of times (outages
-    are often transient) before falling back to CPU so the bench always
+    inside this process. Probe it in a subprocess with a timeout (running a
+    real op — a wedged tunnel can list the device yet hang on dispatch) and
+    retry transient outages before falling back to CPU so the bench always
     emits its JSON line (the fallback is visible in `backend`)."""
-    import subprocess
-    import sys
-    probe = ("import jax, jax.numpy as jnp; "
-             "x = jnp.ones((128, 128)); float((x @ x).sum()); "
-             "print(jax.devices()[0].platform)")
-    for attempt in range(attempts):
-        try:
-            r = subprocess.run([sys.executable, "-c", probe],
-                               timeout=timeout_s, capture_output=True,
-                               text=True)
-            if r.returncode == 0:
-                lines = r.stdout.strip().splitlines()
-                return lines[-1] if lines else "unknown"
-        except subprocess.TimeoutExpired:
-            pass
-        if attempt < attempts - 1:
-            time.sleep(retry_wait_s)
-    return None
+    from hydragnn_tpu.utils.devices import probe_backend
+    platform, _ = probe_backend(timeout_s=timeout_s, attempts=attempts,
+                                retry_wait_s=retry_wait_s)
+    return platform
 
 
 def main():
@@ -79,6 +64,13 @@ def main():
     if backend is None:
         jax.config.update("jax_platforms", "cpu")
         backend = "cpu_fallback_tunnel_down"
+    # persistent XLA compilation cache: repeat bench runs (and future
+    # rounds) skip the 20-40s first compile. HYDRAGNN_COMPILE_CACHE=0
+    # disables; entries are keyed by backend so CPU-fallback runs don't
+    # poison TPU entries.
+    from hydragnn_tpu.utils.devices import enable_compile_cache
+    enable_compile_cache(os.environ.get("HYDRAGNN_COMPILE_CACHE",
+                                        ".jax_cache"))
     from hydragnn_tpu.config import build_model_config, update_config
     from hydragnn_tpu.graphs.batch import collate
     from hydragnn_tpu.models.create import create_model, init_params

@@ -71,7 +71,11 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     verbosity = config.get("Verbosity", {}).get("level", 0)
 
     from .utils.envflags import (env_flag, env_int,
-                             resolve_steps_per_call)
+                                 resolve_steps_per_call)
+    # HYDRAGNN_COMPILE_CACHE=<dir>: persistent XLA compilation cache so
+    # repeated runs skip recompiles (opt-in; bench.py defaults it on)
+    from .utils.devices import enable_compile_cache
+    enable_compile_cache(os.environ.get("HYDRAGNN_COMPILE_CACHE"))
     init_distributed()
     # TRACE_LEVEL>0 also turns on synchronous region timing (the cudasync
     # analogue: block_until_ready before closing a span — reference:

@@ -1,0 +1,84 @@
+"""Backend liveness probing and recovery for the axon-tunneled TPU.
+
+The tunnel can wedge: ``jax.devices()`` then hangs forever in-process, and
+``JAX_PLATFORMS=cpu`` in the env is overridden by the axon sitecustomize.
+These helpers let entry points (bench.py, __graft_entry__.py) probe safely
+in a throwaway subprocess and force a working CPU platform when needed.
+"""
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+import time
+from typing import Optional, Tuple
+
+_PROBE_CACHE: dict = {}
+
+
+def probe_backend(timeout_s: int = 60, attempts: int = 1,
+                  retry_wait_s: int = 30) -> Tuple[Optional[str], int]:
+    """(platform, device_count) measured by running a real op in a
+    subprocess — a wedged tunnel can enumerate its device yet hang on
+    dispatch, so enumeration alone is not proof of life. Returns
+    (None, 0) when every attempt times out/fails. Memoized per process."""
+    key = (timeout_s, attempts)
+    if key in _PROBE_CACHE:
+        return _PROBE_CACHE[key]
+    probe = ("import jax, jax.numpy as jnp; "
+             "x = jnp.ones((128, 128)); float((x @ x).sum()); "
+             "print(jax.devices()[0].platform, len(jax.devices()))")
+    result: Tuple[Optional[str], int] = (None, 0)
+    for attempt in range(attempts):
+        try:
+            r = subprocess.run([sys.executable, "-c", probe],
+                               timeout=timeout_s, capture_output=True,
+                               text=True)
+            if r.returncode == 0 and r.stdout.strip():
+                parts = r.stdout.strip().splitlines()[-1].split()
+                if len(parts) == 2:
+                    result = (parts[0], int(parts[1]))
+                    break
+        except subprocess.TimeoutExpired:
+            pass
+        except Exception:
+            pass
+        if attempt < attempts - 1:
+            time.sleep(retry_wait_s)
+    _PROBE_CACHE[key] = result
+    return result
+
+
+def force_cpu_platform(min_devices: int = 1) -> None:
+    """Reconfigure this process onto the CPU platform with at least
+    `min_devices` devices, regardless of whether backends were already
+    initialized. XLA_FLAGS' --xla_force_host_platform_device_count is
+    honored (its parse is stale after any backend init, so the count is
+    re-applied via jax_num_cpu_devices)."""
+    import jax
+    import jax.extend.backend
+    m = re.search(r"host_platform_device_count=(\d+)",
+                  os.environ.get("XLA_FLAGS", ""))
+    target = max(min_devices, int(m.group(1)) if m else 0, 1)
+    jax.extend.backend.clear_backends()  # no-op when nothing initialized
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", target)
+
+
+def enable_compile_cache(cache_dir: Optional[str],
+                         min_compile_secs: float = 1.0) -> bool:
+    """Persistent XLA compilation cache at `cache_dir` (no-op for None/
+    ""/"0"/"off"). Returns True when enabled."""
+    if not cache_dir or cache_dir in ("0", "off"):
+        return False
+    import jax
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.abspath(cache_dir))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_secs))
+        return True
+    except Exception:
+        return False
