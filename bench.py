@@ -65,12 +65,14 @@ def main():
         jax.config.update("jax_platforms", "cpu")
         backend = "cpu_fallback_tunnel_down"
     # persistent XLA compilation cache: repeat bench runs (and future
-    # rounds) skip the 20-40s first compile. HYDRAGNN_COMPILE_CACHE=0
-    # disables; entries are keyed by backend so CPU-fallback runs don't
-    # poison TPU entries.
+    # rounds) skip the 20-40s first compile. Default-on for TPU only —
+    # XLA's CPU AOT loader warns about machine-feature mismatches
+    # (potential SIGILL) when reloading CPU entries, so CPU runs need the
+    # explicit HYDRAGNN_COMPILE_CACHE opt-in.
     from hydragnn_tpu.utils.devices import enable_compile_cache
+    default_cache = "" if backend.startswith("cpu") else ".jax_cache"
     enable_compile_cache(os.environ.get("HYDRAGNN_COMPILE_CACHE",
-                                        ".jax_cache"))
+                                        default_cache))
     from hydragnn_tpu.config import build_model_config, update_config
     from hydragnn_tpu.graphs.batch import collate
     from hydragnn_tpu.models.create import create_model, init_params

@@ -33,6 +33,8 @@ def run_prediction(config_or_path, datasets: Optional[Tuple] = None,
     reference predicts under the same DDP layout as training); default is
     single-program."""
     config = load_config(config_or_path)
+    from .utils.devices import enable_compile_cache
+    enable_compile_cache(os.environ.get("HYDRAGNN_COMPILE_CACHE"))
     if datasets is None:
         from .run_training import _load_datasets_from_config
         datasets = _load_datasets_from_config(config)

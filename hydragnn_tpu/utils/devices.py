@@ -60,7 +60,10 @@ def force_cpu_platform(min_devices: int = 1) -> None:
     import jax.extend.backend
     m = re.search(r"host_platform_device_count=(\d+)",
                   os.environ.get("XLA_FLAGS", ""))
-    target = max(min_devices, int(m.group(1)) if m else 0, 1)
+    # an explicit XLA_FLAGS count wins outright (even below min_devices —
+    # a caller who pinned 2 devices gets 2 and a clear downstream error,
+    # not a silently different mesh); otherwise provision min_devices
+    target = int(m.group(1)) if m else max(min_devices, 1)
     jax.extend.backend.clear_backends()  # no-op when nothing initialized
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", target)
@@ -68,9 +71,11 @@ def force_cpu_platform(min_devices: int = 1) -> None:
 
 def enable_compile_cache(cache_dir: Optional[str],
                          min_compile_secs: float = 1.0) -> bool:
-    """Persistent XLA compilation cache at `cache_dir` (no-op for None/
-    ""/"0"/"off"). Returns True when enabled."""
-    if not cache_dir or cache_dir in ("0", "off"):
+    """Persistent XLA compilation cache at `cache_dir` (no-op for None and
+    falsy spellings: ""/"0"/"off"/"false"/"no", any case). Returns True
+    when enabled."""
+    if not cache_dir or cache_dir.strip().lower() in ("0", "off", "false",
+                                                      "no"):
         return False
     import jax
     try:
