@@ -498,3 +498,34 @@ def test_raw_dataset_2d_graph_features_and_width_divergence(tmp_path):
 
     with pytest.raises(ValueError, match="width differs between samples"):
         DivergentDataset(cfg)
+
+
+def test_run_training_from_config_file_path(tmp_path, monkeypatch):
+    """The reference's primary entry: hydragnn.run_training("config.json")
+    with config-driven dataset loading (pickle format, perc_train split)
+    and prediction from the same path (reference: run_training.py:48-62
+    singledispatch; _load_datasets_from_config)."""
+    import json
+    import numpy as np
+    from hydragnn_tpu.datasets.pickledataset import SimplePickleWriter
+    from hydragnn_tpu.run_prediction import run_prediction
+    from hydragnn_tpu.run_training import run_training
+    from tests.deterministic_data import deterministic_graph_dataset
+
+    from tests.utils import make_config
+
+    monkeypatch.chdir(tmp_path)
+    samples = deterministic_graph_dataset(num_configs=40)
+    SimplePickleWriter(samples, "dataset/pkl", label="total")
+    cfg = make_config("GIN")
+    cfg["Dataset"] = {"format": "pickle", "path": {"total": "dataset/pkl"}}
+    cfg["NeuralNetwork"]["Training"].update(num_epoch=2, batch_size=8,
+                                            perc_train=0.7)
+    with open("config.json", "w") as f:
+        json.dump(cfg, f)
+    state, h, model, _ = run_training("config.json")
+    assert all(np.isfinite(v) for v in h["train_loss"])
+    t, p = run_prediction("config.json", state=state, model=model)
+    assert np.asarray(t[0]).shape == np.asarray(p[0]).shape
+    # perc_train really applied: 40 * (1 - 0.7) / 2 = 6 test graphs
+    assert np.asarray(t[0]).shape[0] == 6
