@@ -6,11 +6,26 @@ PNA, energy+force training). The reference publishes no numbers
 MI250X-GCD-class anchor for this workload shape, held fixed across rounds so
 the judge can track round-over-round progress.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Runs on whatever jax.devices() provides (the real TPU chip under the driver).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "backend",
+"mfu", ...}. Runs on whatever jax.devices() provides (the real TPU chip
+under the driver).
+
+Env knobs:
+  BENCH_WAIT_TUNNEL_S  bounded wait-for-tunnel window before CPU fallback
+                       (default 900; probes every 60s)
+  BENCH_NBR            dense neighbor-list layout on/off (default 1)
+  BENCH_STEPS_PER_CALL lax.scan steps per dispatch (default 4; 0/1 = off)
+  BENCH_SWEEP          =1: sweep NBR x PALLAS x STEPS_PER_CALL in
+                       subprocesses, print the winner (details in
+                       BENCH_SWEEP.json)
+  HYDRAGNN_USE_PALLAS  Pallas segment-sum kernel on/off (ops/segment.py)
+  BENCH_PEAK_FLOPS     override chip peak FLOP/s for MFU
 """
+import itertools
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -24,6 +39,20 @@ DEG = 30
 HIDDEN = 128
 NUM_CONV = 3
 STEPS = 20
+
+# bf16/f32-MXU peak FLOP/s by device kind (public spec sheets); MFU is
+# measured achieved FLOP/s over this peak. Unknown kinds fall back to the
+# v5e figure; override with BENCH_PEAK_FLOPS.
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+    "cpu": 1e11,
+}
 
 
 def synth_samples(num, rng):
@@ -45,22 +74,51 @@ def synth_samples(num, rng):
     return samples
 
 
-def _probe_device_backend(timeout_s: int = 90, attempts: int = 2,
-                          retry_wait_s: int = 30):
-    """The axon TPU tunnel can be down; jax.devices() then hangs forever
-    inside this process. Probe it in a subprocess with a timeout (running a
-    real op — a wedged tunnel can list the device yet hang on dispatch) and
-    retry transient outages before falling back to CPU so the bench always
-    emits its JSON line (the fallback is visible in `backend`)."""
+def _wait_for_backend():
+    """Probe the axon tunnel (in a subprocess — a wedged tunnel hangs
+    jax.devices() forever in-process), waiting inside a bounded outage
+    window before falling back to CPU so the bench always emits its JSON
+    line. Returns the live platform name or None."""
+    known = os.environ.get("BENCH_BACKEND")
+    if known is not None:  # parent sweep already probed
+        return known or None
     from hydragnn_tpu.utils.devices import probe_backend
-    platform, _ = probe_backend(timeout_s=timeout_s, attempts=attempts,
-                                retry_wait_s=retry_wait_s)
-    return platform
+    window = float(os.environ.get("BENCH_WAIT_TUNNEL_S", "900") or 0)
+    deadline = time.time() + window
+    attempt = 0
+    while True:
+        platform, _ = probe_backend(timeout_s=90, attempts=1)
+        if platform is not None:
+            # a live non-CPU platform, or a box with no tunnel at all
+            # (probe ran straight on CPU — nothing to wait for)
+            return platform
+        attempt += 1
+        if time.time() >= deadline:
+            return None  # tunnel present but wedged for the whole window
+        remaining = max(0, deadline - time.time())
+        print(f"# tunnel down (probe {attempt}); retrying for "
+              f"{remaining:.0f}s more", file=sys.stderr)
+        time.sleep(min(60, remaining))
+        from hydragnn_tpu.utils import devices as _d
+        _d._PROBE_CACHE.clear()
 
 
-def main():
+def _step_flops(jitted, *args):
+    """Per-call FLOPs from XLA's compiled cost analysis; None when the
+    backend doesn't report it."""
+    try:
+        ca = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        f = float(ca.get("flops", 0.0))
+        return f if f > 0 else None
+    except Exception:
+        return None
+
+
+def run_bench():
     import jax
-    backend = _probe_device_backend()
+    backend = _wait_for_backend()
     if backend is None:
         jax.config.update("jax_platforms", "cpu")
         backend = "cpu_fallback_tunnel_down"
@@ -93,7 +151,8 @@ def main():
     n_edge = BATCH_GRAPHS * NODES_PER_GRAPH * DEG + 8
     batch = collate(samples, n_node=n_node, n_edge=n_edge,
                     n_graph=BATCH_GRAPHS + 1)
-    if os.environ.get("BENCH_NBR", "1") != "0":
+    use_nbr = os.environ.get("BENCH_NBR", "1") != "0"
+    if use_nbr:
         # dense neighbor-list layout: PNA aggregation becomes [N, K, F]
         # axis reductions with zero scatters
         from hydragnn_tpu.graphs.batch import with_neighbor_format
@@ -110,9 +169,8 @@ def main():
     # BENCH_STEPS_PER_CALL>1: scan S optimizer steps per device dispatch
     # (train_step.make_multi_train_step) — amortizes the ~2.4 ms per-call
     # tunnel dispatch latency. Same training math; throughput counts the
-    # same BATCH_GRAPHS * STEPS graphs. Off by default until the scanned
-    # step is validated through the axon tunnel.
-    spc = min(int(os.environ.get("BENCH_STEPS_PER_CALL", "0") or 0), STEPS)
+    # same BATCH_GRAPHS * STEPS graphs.
+    spc = min(int(os.environ.get("BENCH_STEPS_PER_CALL", "4") or 0), STEPS)
     multi_step = None
     if spc > 1:
         from hydragnn_tpu.datasets.loader import _stack_batches
@@ -121,6 +179,8 @@ def main():
             model, mcfg, tx, loss_name="mae", compute_grad_energy=True,
             donate=False, compute_dtype="float32")
         stacked = _stack_batches([batch] * spc)
+
+    flops_per_step = _step_flops(train_step, state, batch)
 
     def run_steps(state, n_steps):
         if multi_step is not None:
@@ -163,9 +223,63 @@ def main():
         "unit": "graphs/s",
         "vs_baseline": round(gps / REF_BASELINE_GPS, 4),
         "backend": backend,
+        "nbr_layout": use_nbr,
+        "steps_per_call": spc if spc > 1 else 1,
+        "pallas": os.environ.get("HYDRAGNN_USE_PALLAS", "default"),
     }
-    if spc > 1:
-        out["steps_per_call"] = spc
+    if flops_per_step is not None:
+        import jax
+        kind = "cpu" if backend.startswith("cpu") else \
+            jax.devices()[0].device_kind
+        peak = float(os.environ.get("BENCH_PEAK_FLOPS", 0)) or \
+            PEAK_FLOPS.get(kind, PEAK_FLOPS["TPU v5e"])
+        achieved = flops_per_step * STEPS / best_dt
+        out["mfu"] = round(achieved / peak, 5)
+        out["flops_per_step"] = flops_per_step
+        out["peak_flops"] = peak
+        out["device_kind"] = kind
+    return out
+
+
+def sweep():
+    """Run the (nbr-layout x pallas x steps-per-call) grid, each point in a
+    fresh subprocess (the flags are read once per process), and report the
+    winner. Full grid lands in BENCH_SWEEP.json. The parent probes the
+    tunnel ONCE; children skip their own outage window (9x 900s of waiting
+    on a dead tunnel otherwise)."""
+    platform = _wait_for_backend()
+    grid = list(itertools.product(["0", "1"], ["0", "1"], ["1", "4", "10"]))
+    results = []
+    for nbr, pallas, spc in grid:
+        if nbr == "1" and pallas == "1":
+            continue  # dense layout bypasses the scatter the kernel replaces
+        env = dict(os.environ,
+                   BENCH_NBR=nbr, HYDRAGNN_USE_PALLAS=pallas,
+                   BENCH_STEPS_PER_CALL=spc, BENCH_SWEEP="0",
+                   BENCH_BACKEND=platform or "")
+        point = {"nbr_layout": nbr, "pallas": pallas, "steps_per_call": spc}
+        try:
+            r = subprocess.run([sys.executable, __file__], env=env,
+                               capture_output=True, text=True, timeout=1200)
+            line = (r.stdout.strip().splitlines() or [""])[-1]
+            results.append(json.loads(line))
+        except subprocess.TimeoutExpired:
+            results.append({"error": "timeout", "value": 0, **point})
+        except (json.JSONDecodeError, OSError):
+            results.append({"error": r.stderr[-500:], "value": 0, **point})
+    ok = [r for r in results if "error" not in r]
+    best = max(ok, key=lambda r: r["value"]) if ok else {}
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_SWEEP.json"), "w") as f:
+        json.dump({"best": best, "grid": results}, f, indent=1)
+    return best
+
+
+def main():
+    if os.environ.get("BENCH_SWEEP") == "1":
+        out = sweep()
+    else:
+        out = run_bench()
     print(json.dumps(out))
 
 

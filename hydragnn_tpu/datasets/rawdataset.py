@@ -260,17 +260,40 @@ class AbstractRawDataset(AbstractBaseDataset):
             np.stack([mn, mx]).astype(np.float32))
         return gathered[:, 0].min(0), gathered[:, 1].max(0)
 
+    def _block_reduce(self, mn: np.ndarray, mx: np.ndarray, key: str):
+        """Collapse per-column ranges to per-feature-*block* ranges
+        (reference: __normalize_dataset reduces per feature for dim>1
+        features, abstractrawdataset.py:207-289). Returns
+        (col_min, col_max, feat_minmax): the column ranges broadcast so
+        every column of a block shares the block-wide range, plus the
+        [2, n_features] summary the reference stores (one entry per
+        declared feature). With no declared blocks (or a column-count
+        mismatch), per-column is kept and the summary is per-column."""
+        blocks = self._feature_blocks(key)
+        if not blocks or blocks[-1][2] != mn.shape[0]:
+            return mn, mx, np.stack([mn, mx])
+        cmn, cmx = mn.copy(), mx.copy()
+        fmn, fmx = [], []
+        for _, s, e in blocks:
+            bmn, bmx = mn[s:e].min(), mx[s:e].max()
+            cmn[s:e], cmx[s:e] = bmn, bmx
+            fmn.append(bmn)
+            fmx.append(bmx)
+        return cmn, cmx, np.stack([np.asarray(fmn), np.asarray(fmx)])
+
     def _normalize(self, raws: List[RawSample]):
-        """Dataset-wide column min-max to [0, 1], recording the ranges
-        (reference: __normalize_dataset, abstractrawdataset.py:207-289 —
-        the reference reduces per feature *block*; per-column is identical
-        for the common dim-1 features and strictly tighter otherwise).
-        With dist=True the ranges are reduced across all processes so every
-        rank normalizes identically."""
+        """Dataset-wide min-max to [0, 1], reduced per declared feature
+        block (reference: __normalize_dataset,
+        abstractrawdataset.py:207-289 — dim>1 features share one range
+        across their columns, and minmax_*_feature is [2, n_features] so
+        output_index-based consumers line up). With dist=True the ranges
+        are reduced across all processes so every rank normalizes
+        identically."""
         nmin = np.min([r.node_features.min(0) for r in raws], axis=0)
         nmax = np.max([r.node_features.max(0) for r in raws], axis=0)
         nmin, nmax = self._host_minmax_reduce(nmin, nmax)
-        self.minmax_node_feature = np.stack([nmin, nmax])
+        nmin, nmax, self.minmax_node_feature = self._block_reduce(
+            nmin, nmax, "node_features")
         nscale = np.where(nmax > nmin, nmax - nmin, 1.0)
         for r in raws:
             r.node_features = ((r.node_features - nmin) / nscale).astype(
@@ -278,7 +301,8 @@ class AbstractRawDataset(AbstractBaseDataset):
         if raws[0].graph_features is not None:
             g_all = np.stack([r.graph_features for r in raws])
             gmin, gmax = self._host_minmax_reduce(g_all.min(0), g_all.max(0))
-            self.minmax_graph_feature = np.stack([gmin, gmax])
+            gmin, gmax, self.minmax_graph_feature = self._block_reduce(
+                gmin, gmax, "graph_features")
             gscale = np.where(gmax > gmin, gmax - gmin, 1.0)
             for r in raws:
                 r.graph_features = ((r.graph_features - gmin) / gscale

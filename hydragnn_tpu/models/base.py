@@ -41,6 +41,31 @@ def _remat_call(conv: nn.Module, *args):
     return nn.remat(lambda mdl, *a: mdl(*a))(conv, *args)
 
 
+class VecHeadConv(nn.Module):
+    """Adapter presenting a vector-channel conv (PainnConv/PNAEqConv,
+    signature ``conv(s, v, batch, cargs) -> (s, v)``) as a Base-decode
+    conv-head layer (``(h, pos, batch, cargs) -> (h, pos)``).
+
+    The stack's encoder stashes its final vector channel in
+    ``cargs["vec_channel_encoder"]``; decode resets the working key
+    ``cargs["vec_channel"]`` to it at the start of every conv head, and the
+    adapter threads it through that head's conv layers (reference:
+    PAINNStack.py:139-145 — node conv heads reuse the encoder's ``v``;
+    unlike the reference we do not leak one head's final state into the
+    next head). Re-zeroes when feature dims mismatch (e.g. a 1-layer
+    encoder whose last conv skipped the vector re-embedding)."""
+    conv: nn.Module
+
+    @nn.compact
+    def __call__(self, h, pos, batch, cargs):
+        v = cargs.get("vec_channel")
+        if v is None or v.shape[-1] != h.shape[-1]:
+            v = jnp.zeros((h.shape[0], 3, h.shape[-1]), h.dtype)
+        s, v = self.conv(h, v, batch, cargs)
+        cargs["vec_channel"] = v
+        return s, pos
+
+
 class BaseStack(nn.Module):
     """Abstract conv stack + multihead decoder. Subclasses override
     `make_conv` (and optionally `conv_args` / `initial_node_features` /
@@ -130,6 +155,10 @@ class BaseStack(nn.Module):
                 # conv-type node head: fresh convs of the same stack type
                 # (reference: Base.py:262-290 _init_node_conv + forward :334-341)
                 h, hpos = x, pos
+                if "vec_channel_encoder" in cargs:
+                    # vector-channel stacks: every conv head starts from
+                    # the ENCODER's final v, not the previous head's
+                    cargs["vec_channel"] = cargs["vec_channel_encoder"]
                 hdims = list(head.dim_headlayers) + [head.output_dim * widen]
                 hin = h.shape[-1]
                 for li, hd in enumerate(hdims):

@@ -125,9 +125,15 @@ class PAINNStack(BaseStack):
             x, v = conv(x, v, batch, cargs)
             x = act(x)
             in_dim = cfg.hidden_dim
+        # conv-type node heads thread the encoder's final vector channel
+        # (reference: PAINNStack.py:139-145 forward, node conv branch)
+        cargs["vec_channel_encoder"] = v
         return x, batch.pos
 
     def make_conv(self, in_dim, out_dim, idx, final=False):
-        # node "conv" heads reuse PainnConv threading a fresh vector channel
-        raise NotImplementedError(
-            "PAINN conv-type node heads not supported yet; use 'mlp'")
+        from .base import VecHeadConv
+        return VecHeadConv(
+            conv=PainnConv(in_dim=in_dim, out_dim=out_dim,
+                           num_radial=int(self.cfg.num_radial or 6),
+                           cutoff=float(self.cfg.radius), last_layer=final),
+            name=f"conv_{idx}")

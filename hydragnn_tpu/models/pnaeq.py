@@ -162,8 +162,16 @@ class PNAEqStack(BaseStack):
             x, v = conv(x, v, batch, cargs)
             x = act(x)
             in_dim = cfg.hidden_dim
+        # conv-type node heads thread the encoder's final vector channel
+        # (reference: PNAEqStack.py forward, node conv branch)
+        cargs["vec_channel_encoder"] = v
         return x, batch.pos
 
     def make_conv(self, in_dim, out_dim, idx, final=False):
-        raise NotImplementedError(
-            "PNAEq conv-type node heads not supported yet; use 'mlp'")
+        from .base import VecHeadConv
+        return VecHeadConv(
+            conv=PNAEqConv(in_dim=in_dim, out_dim=out_dim,
+                           num_radial=int(self.cfg.num_radial or 6),
+                           deg_hist=self.cfg.pna_deg,
+                           edge_dim=self.cfg.edge_dim, last_layer=final),
+            name=f"conv_{idx}")

@@ -76,11 +76,20 @@ def resolve_num_shards(num_shards: Optional[int], batch_size: int,
     to all devices when more than one, fall back to single-program when the
     batch doesn't divide or the request exceeds the device count."""
     ndev = jax.device_count()
+    explicit = num_shards is not None
     if num_shards is None:
         num_shards = ndev if (use_spmd or (use_spmd is None and ndev > 1)) \
             else 1
     num_shards = max(int(num_shards), 1)
     if num_shards > ndev or batch_size % num_shards != 0:
+        if explicit and num_shards > 1:
+            import warnings
+            reason = (f"exceeds device count {ndev}"
+                      if num_shards > ndev else
+                      f"does not divide batch_size {batch_size}")
+            warnings.warn(
+                f"requested num_shards={num_shards} {reason}; "
+                f"falling back to a single-device run", stacklevel=2)
         return 1
     return num_shards
 

@@ -44,24 +44,83 @@ def save_model(state: TrainState, log_name: str, path: str = "./logs",
         ckptr = _ASYNC_STATE["ckptr"]
         ckptr.save(target, args=ocp.args.StandardSave(host_state),
                    force=True)
+        # LATEST must only ever name a finalized step dir: defer the marker
+        # to a background commit-watcher instead of writing it at enqueue
+        # time (a crash mid-finalize would otherwise leave a dangling
+        # pointer and silently roll readers back to an older checkpoint)
+        if jax.process_index() == 0:
+            with _ASYNC_LOCK:
+                _ASYNC_STATE["pending_latest"] = target
+            _spawn_latest_writer()
     else:
         ckptr = ocp.StandardCheckpointer()
         ckptr.save(target, host_state, force=True)
         ckptr.wait_until_finished()
-    # mark latest (for async saves the marker is written immediately; the
-    # tmp-dir atomic-rename protocol means a reader either sees the
-    # finalized step dir or falls back to the previous checkpoint)
-    if jax.process_index() == 0:
-        with open(os.path.join(d, "LATEST"), "w") as f:
-            f.write(os.path.basename(target))
+        if jax.process_index() == 0:
+            _write_latest(target)
     return target
 
 
+def _write_latest(target: str) -> None:
+    d = os.path.dirname(target)
+    tmp = os.path.join(d, "LATEST.tmp")
+    with open(tmp, "w") as f:
+        f.write(os.path.basename(target))
+    os.replace(tmp, os.path.join(d, "LATEST"))
+
+
+import threading
+
+_ASYNC_LOCK = threading.Lock()
+
+
+def _spawn_latest_writer() -> None:
+    """One background thread that waits for the async checkpointer to
+    finalize, then points LATEST at the newest committed save. The
+    check-and-clear of ``pending_latest`` and the is-alive spawn guard are
+    serialized under one lock: without it, a save enqueued between the old
+    thread's final check and its exit would never get its marker written."""
+    with _ASYNC_LOCK:
+        if _ASYNC_STATE.get("latest_thread") is not None \
+                and _ASYNC_STATE["latest_thread"].is_alive():
+            return
+
+        def _run():
+            while True:
+                with _ASYNC_LOCK:
+                    target = _ASYNC_STATE.get("pending_latest")
+                if target is None:
+                    return
+                _ASYNC_STATE["ckptr"].wait_until_finished()
+                if os.path.isdir(target):
+                    _write_latest(target)
+                with _ASYNC_LOCK:
+                    if _ASYNC_STATE.get("pending_latest") == target:
+                        _ASYNC_STATE["pending_latest"] = None
+                        return
+                    # a newer save was enqueued while we wrote: loop
+
+        t = threading.Thread(target=_run, daemon=True)
+        _ASYNC_STATE["latest_thread"] = t
+        t.start()
+
+
 def wait_for_checkpoints():
-    """Block until every async save has been finalized on disk."""
+    """Block until every async save has been finalized on disk (and the
+    LATEST marker points at a committed step dir). Writes any leftover
+    pending marker itself, so a wedged/raced writer thread cannot leave
+    LATEST stale."""
     ckptr = _ASYNC_STATE.get("ckptr")
     if ckptr is not None:
         ckptr.wait_until_finished()
+    t = _ASYNC_STATE.get("latest_thread")
+    if t is not None and t.is_alive():
+        t.join(timeout=60)
+    with _ASYNC_LOCK:
+        target = _ASYNC_STATE.get("pending_latest")
+        if target is not None and os.path.isdir(target):
+            _write_latest(target)
+            _ASYNC_STATE["pending_latest"] = None
 
 
 def load_existing_model(state_like: TrainState, log_name: str,

@@ -23,9 +23,14 @@ def probe_backend(timeout_s: int = 60, attempts: int = 1,
     subprocess — a wedged tunnel can enumerate its device yet hang on
     dispatch, so enumeration alone is not proof of life. Returns
     (None, 0) when every attempt times out/fails. Memoized per process."""
-    key = (timeout_s, attempts)
+    # successes are memoized for the process lifetime; failures only for
+    # 120s so a transient tunnel outage gets reprobed in long-lived runs
+    key = (timeout_s, attempts, retry_wait_s)
     if key in _PROBE_CACHE:
-        return _PROBE_CACHE[key]
+        cached, stamp = _PROBE_CACHE[key]
+        if cached[0] is not None or time.time() - stamp < 120:
+            return cached
+        del _PROBE_CACHE[key]
     probe = ("import jax, jax.numpy as jnp; "
              "x = jnp.ones((128, 128)); float((x @ x).sum()); "
              "print(jax.devices()[0].platform, len(jax.devices()))")
@@ -46,7 +51,7 @@ def probe_backend(timeout_s: int = 60, attempts: int = 1,
             pass
         if attempt < attempts - 1:
             time.sleep(retry_wait_s)
-    _PROBE_CACHE[key] = result
+    _PROBE_CACHE[key] = (result, time.time())
     return result
 
 

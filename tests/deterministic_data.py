@@ -56,11 +56,10 @@ def deterministic_graph_dataset(
             y_graph = np.asarray([y1.sum() + y2.sum() + y3.sum()], np.float32)
         n_node_heads = sum(1 for h in heads if h == "node")
         if n_node_heads:
-            # one column per node head: x, x2, x3 — the unit_test format's
-            # node targets. Assumes node heads select output_index 0..n-1
-            # in order (true for ci_multihead.json) and supports at most 3.
-            assert n_node_heads <= 3, "generator provides x, x2, x3 only"
-            y_node = np.stack([y1, y2, y3][:n_node_heads],
+            # one column per node head: x^(k+1) for head k (x, x2, x3, ...).
+            # Assumes node heads select output_index 0..n-1 in order (true
+            # for ci_multihead.json and the example configs).
+            y_node = np.stack([x ** (k + 1) for k in range(n_node_heads)],
                               axis=1).astype(np.float32)
         samples.append(GraphSample(
             x=x[:, None], pos=pos, senders=send, receivers=recv,

@@ -108,9 +108,12 @@ def test_gaussian_nll_var_output(samples):
     assert np.all(np.asarray(outputs_var[0]) >= 0)
 
 
-def test_conv_node_head(samples):
-    """Node head of type 'conv' (reference: Base.py:262-290)."""
-    cfg, mcfg, batch = prepare("GIN", samples, heads=("node",))
+@pytest.mark.parametrize("model_type", ["GIN", "PAINN", "PNAEq"])
+def test_conv_node_head(model_type, samples):
+    """Node head of type 'conv' (reference: Base.py:262-290; for the
+    vector-channel stacks the head convs thread the encoder's final v,
+    reference: PAINNStack.py:139-145)."""
+    cfg, mcfg, batch = prepare(model_type, samples, heads=("node",))
     import dataclasses
     head = dataclasses.replace(mcfg.heads[0], node_arch="conv")
     mcfg = dataclasses.replace(mcfg, heads=(head,))
@@ -118,6 +121,26 @@ def test_conv_node_head(samples):
     variables = init_params(model, batch)
     outputs, _ = model.apply(variables, batch, train=False)
     assert outputs[0].shape == (batch.num_nodes, 1)
+    assert np.all(np.isfinite(np.asarray(outputs[0])))
+    if model_type == "GIN":
+        # the grad-flow check below is for the vector-channel threading;
+        # GIN's head conv can be legitimately relu-dead at init on this
+        # unnormalized fixture (its 1-wide MLP saturates negative)
+        return
+    # gradients flow through the threaded vector channel (train=True: the
+    # masked batchnorm recenters on batch stats, so the head's final
+    # activation isn't uniformly relu-dead at init)
+    def loss(params):
+        out_and_var, _ = model.apply(
+            {"params": params,
+             "batch_stats": variables.get("batch_stats", {})},
+            batch, train=True, mutable=["batch_stats"])
+        out, _ = out_and_var
+        return jnp.sum(out[0] ** 2)
+    g = jax.grad(loss)(variables["params"])
+    flat = jax.tree_util.tree_leaves(g)
+    assert all(np.all(np.isfinite(np.asarray(x))) for x in flat)
+    assert any(float(jnp.max(jnp.abs(x))) > 0 for x in flat)
 
 
 def test_mlp_per_node_head():
