@@ -265,8 +265,10 @@ def sweep():
             results.append(json.loads(line))
         except subprocess.TimeoutExpired:
             results.append({"error": "timeout", "value": 0, **point})
-        except (json.JSONDecodeError, OSError):
+        except json.JSONDecodeError:
             results.append({"error": r.stderr[-500:], "value": 0, **point})
+        except OSError as e:
+            results.append({"error": str(e), "value": 0, **point})
     ok = [r for r in results if "error" not in r]
     best = max(ok, key=lambda r: r["value"]) if ok else {}
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),

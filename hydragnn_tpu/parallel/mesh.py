@@ -71,11 +71,14 @@ def make_mesh(axes: Sequence[Tuple[str, int]] = None,
 
 
 def resolve_num_shards(num_shards: Optional[int], batch_size: int,
-                       use_spmd: Optional[bool] = None) -> int:
+                       use_spmd: Optional[bool] = None,
+                       device_budget: Optional[int] = None) -> int:
     """Shared shard-count policy for run_training/run_prediction: default
     to all devices when more than one, fall back to single-program when the
-    batch doesn't divide or the request exceeds the device count."""
-    ndev = jax.device_count()
+    batch doesn't divide or the request exceeds the device count.
+    `device_budget` caps the devices available to the data axis (a composed
+    mesh reserves device_count/graph_shards for the graph axis)."""
+    ndev = device_budget if device_budget is not None else jax.device_count()
     explicit = num_shards is not None
     if num_shards is None:
         num_shards = ndev if (use_spmd or (use_spmd is None and ndev > 1)) \

@@ -100,8 +100,42 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     train_cfg = nn["Training"]
     batch_size = int(train_cfg["batch_size"])
 
+    # Architecture.graph_shards > 1: composed (data x graph) mesh — each
+    # data shard's edge set is sharded over the graph axis
+    # (parallel/composite.py). The graph axis claims its devices first;
+    # data parallelism gets the rest.
+    graph_shards = int(nn["Architecture"].get("graph_shards", 1) or 1)
+    ndev = jax.device_count()
+    if graph_shards > 1 and ndev % graph_shards != 0:
+        raise ValueError(
+            f"Architecture.graph_shards={graph_shards} does not divide the "
+            f"device count {ndev}")
+
+    # Training.pipeline_stages > 1: GPipe layer parallelism over a "pipe"
+    # mesh axis (parallel/pipeline_trainer.py). The loader's device-stacked
+    # output doubles as the microbatch axis.
+    pipeline_stages = int(train_cfg.get("pipeline_stages", 1) or 1)
+    microbatches = int(train_cfg.get("pipeline_microbatches",
+                                     pipeline_stages) or pipeline_stages)
+    if pipeline_stages > 1 and graph_shards > 1:
+        raise ValueError("pipeline_stages and graph_shards cannot be "
+                         "combined yet")
+
+    mcfg = build_model_config(config)
+
     from .parallel.mesh import resolve_num_shards
-    num_shards = resolve_num_shards(num_shards, batch_size, use_spmd)
+    if pipeline_stages > 1:
+        # validate before the loader asserts on batch/shard divisibility
+        # with a less actionable message
+        from .parallel.pipeline_trainer import validate_pipeline_config
+        validate_pipeline_config(mcfg, pipeline_stages, batch_size,
+                                 microbatches)
+        num_shards = microbatches  # loader stacking = microbatch axis
+    else:
+        num_shards = resolve_num_shards(
+            num_shards, batch_size, use_spmd,
+            device_budget=(ndev // graph_shards) if graph_shards > 1
+            else None)
 
     from .graphs.triplets import maybe_triplet_transform
     batch_transform = maybe_triplet_transform(
@@ -115,6 +149,12 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     # HYDRAGNN_NEIGHBOR_FORMAT overrides.
     nbr_fmt = bool(nn["Architecture"].get("neighbor_format", True))
     nbr_fmt = env_flag("HYDRAGNN_NEIGHBOR_FORMAT", nbr_fmt)
+    if graph_shards > 1 and nbr_fmt:
+        # the dense [N, K] layout is node-major — edge sharding needs the
+        # edge-leading segment path
+        log("graph_shards > 1: disabling the dense neighbor-list layout "
+            "(edge-sharded aggregation uses the segment path)")
+        nbr_fmt = False
 
     # HYDRAGNN_USE_ddstore serves training samples from the C++ DDStore
     # (reference: the --ddstore path wrapping datasets in DistDataset,
@@ -132,9 +172,6 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         train_source, valset, testset, batch_size, num_shards=num_shards,
         batch_transform=batch_transform, neighbor_format=nbr_fmt)
 
-    mcfg = build_model_config(config)
-    model = create_model(mcfg)
-
     # init on one shard-shaped batch
     from .graphs.batch import collate
     init_batch = collate(trainset[:min(len(trainset), train_loader.graphs_per_shard)],
@@ -142,9 +179,18 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
                          n_graph=train_loader.n_graph, np_out=True)
     if batch_transform is not None:
         init_batch = batch_transform(init_batch)
-    variables = init_params(model, init_batch)
     tx = select_optimizer(train_cfg)
-    state = TrainState.create(variables, tx)
+    if pipeline_stages > 1:
+        # (config already validated before the loader was built)
+        from .parallel.pipeline_trainer import init_pipeline_params
+        model = None  # pipelined params are a plain pytree, not a flax stack
+        pparams = init_pipeline_params(jax.random.PRNGKey(0), mcfg,
+                                       init_batch)
+        state = TrainState.create({"params": pparams}, tx)
+    else:
+        model = create_model(mcfg)
+        variables = init_params(model, init_batch)
+        state = TrainState.create(variables, tx)
 
     # resume / transfer: Training.continue + startfrom name the run whose
     # checkpoint seeds this one (reference: load_existing_model_config,
@@ -183,7 +229,29 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
 
     loss_name = train_cfg.get("loss_function_type", "mse")
     cge = bool(train_cfg.get("compute_grad_energy", False))
-    if num_shards > 1:
+    if pipeline_stages > 1:
+        if cge:
+            raise ValueError("pipeline_stages does not support "
+                             "compute_grad_energy yet")
+        from .parallel.pipeline_trainer import (make_pipeline_eval_step,
+                                                make_pipeline_train_step)
+        mesh = make_mesh((("pipe", pipeline_stages),))
+        train_step = make_pipeline_train_step(mcfg, mesh, pipeline_stages,
+                                              tx, loss_name)
+        eval_step = make_pipeline_eval_step(mcfg, mesh, pipeline_stages,
+                                            loss_name)
+    elif graph_shards > 1:
+        from .parallel.composite import (make_composed_eval_step,
+                                         make_composed_train_step)
+        mesh = make_mesh((("data", num_shards), ("graph", graph_shards)))
+        opt_cfg = train_cfg.get("Optimizer", {})
+        train_step = make_composed_train_step(
+            model, mcfg, tx, mesh, loss_name, compute_grad_energy=cge,
+            zero_opt=bool(opt_cfg.get("use_zero_redundancy", False)),
+            zero_min_size=int(opt_cfg.get("zero_min_shard_size", 2 ** 14)))
+        eval_step = make_composed_eval_step(model, mcfg, loss_name,
+                                            compute_grad_energy=cge)
+    elif num_shards > 1:
         mesh = make_mesh((("data", num_shards),))
         # ZeRO-equivalent optimizer-state sharding (reference:
         # Training.Optimizer.use_zero_redundancy, optimizer.py:104-113)
@@ -207,7 +275,10 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     # math to the per-batch loop; amortizes host dispatch latency.
     multi_step = multi_eval = place_group_fn = None
     steps_per_call = resolve_steps_per_call(train_cfg)
-    if num_shards == 1 and steps_per_call > 1:
+    if graph_shards > 1 or pipeline_stages > 1:
+        steps_per_call = 1  # dispatch grouping not composed with the
+        # (data x graph) / pipeline meshes yet
+    elif num_shards == 1 and steps_per_call > 1:
         from .train.train_step import (make_multi_eval_step,
                                        make_multi_train_step)
         multi_step = make_multi_train_step(model, mcfg, tx,
@@ -241,6 +312,10 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     # the Visualizer, initial-solution scatter, and final plots)
     viz_cfg = config.get("Visualization", {})
     create_plots = bool(viz_cfg.get("create_plots", False))
+    if create_plots and model is None:
+        log("pipeline_stages > 1: prediction-based plots are not wired "
+            "for the pipelined parameter layout; skipping")
+        create_plots = False
     visualizer = None
     if create_plots:
         from .postprocess.visualizer import Visualizer
@@ -259,7 +334,19 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
             visualizer.create_scatter_plots(t0, p0, output_names=out_names,
                                             iepoch=-1)
 
-    if num_shards > 1:
+    if pipeline_stages > 1:
+        from .parallel.pipeline_trainer import place_pipeline_batch
+        place_fn = lambda b: place_pipeline_batch(b, mesh)
+    elif graph_shards > 1:
+        from .parallel.composite import place_composed_batch
+
+        def place_fn(b):
+            if num_shards == 1:  # loader emits unstacked batches for one
+                # data shard; the composed step vmaps a leading shard axis
+                b = jax.tree_util.tree_map(
+                    lambda a: None if a is None else a[None], b)
+            return place_composed_batch(b, mesh)
+    elif num_shards > 1:
         from .parallel.mesh import shard_batch
         place_fn = lambda b: shard_batch(b, mesh)
     else:
@@ -280,8 +367,22 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         from .parallel.mesh import walltime_deadline
         deadline = walltime_deadline()
 
+    # Training.ReduceLROnPlateau overrides the scheduler defaults (the
+    # reference hard-codes factor 0.5 / patience 5, train_validate_test.py:
+    # 191-195; exposing them matters for loss surfaces whose val plateaus
+    # early, e.g. energy-force training)
+    plateau = None
+    if "ReduceLROnPlateau" in train_cfg:
+        from .train.trainer import ReduceLROnPlateau
+        pcfg = train_cfg["ReduceLROnPlateau"] or {}
+        plateau = ReduceLROnPlateau(
+            factor=float(pcfg.get("factor", 0.5)),
+            patience=int(pcfg.get("patience", 5)),
+            min_lr=float(pcfg.get("min_lr", 1e-6)))
+
     state, history = train_validate_test(
         train_step, eval_step, state, train_loader, val_loader, test_loader,
+        plateau=plateau,
         num_epochs=int(train_cfg["num_epoch"]), log_name=log_name,
         patience=int(train_cfg.get("patience", 10)),
         use_early_stopping=bool(train_cfg.get("EarlyStopping", False)),

@@ -62,16 +62,23 @@ def energy_force_loss(apply_fn: Callable, variables, cfg: ModelConfig,
 
     Head 0 must be a node-level energy head; graph energy = masked sum of
     node energies; forces = -dE/dpos.
-    """
+
+    ``apply_fn(variables, batch, train) -> ((outputs, outputs_var),
+    new_batch_stats_or_None)``: batch-norm stacks MUST thread their updated
+    running stats out (the reference's torch train mode updates them on
+    this path too — silently freezing them at init makes eval-mode
+    normalization diverge from what training fit). Returned in the aux
+    dict under "batch_stats"."""
     def total_energy(pos):
         b = batch.replace(pos=pos)
-        outputs, _ = apply_fn(variables, b, train=train)
+        (outputs, _), new_bs = apply_fn(variables, b, train=train)
         node_e = outputs[0][:, :1]
         graph_e = global_sum_pool(node_e, b.node_graph, b.num_graphs, b.node_mask)
         # sum over real graphs only; padding contributes zero by masking
-        return jnp.sum(jnp.where(batch.graph_mask[:, None], graph_e, 0.0)), graph_e
+        return (jnp.sum(jnp.where(batch.graph_mask[:, None], graph_e, 0.0)),
+                (graph_e, new_bs))
 
-    (tot_e, graph_e), neg_forces = jax.value_and_grad(
+    (tot_e, (graph_e, new_bs)), neg_forces = jax.value_and_grad(
         total_energy, has_aux=True)(batch.pos)
     forces_pred = -neg_forces
 
@@ -79,4 +86,5 @@ def energy_force_loss(apply_fn: Callable, variables, cfg: ModelConfig,
     f_loss = masked_loss(loss_name, forces_pred, batch.forces, batch.node_mask)
     total = energy_weight * e_loss + force_weight * f_loss
     return total, {"energy_loss": e_loss, "force_loss": f_loss,
-                   "energy_pred": graph_e, "forces_pred": forces_pred}
+                   "energy_pred": graph_e, "forces_pred": forces_pred,
+                   "batch_stats": new_bs}

@@ -97,7 +97,6 @@ def make_loss_fn(model, cfg: ModelConfig, loss_name: str = "mse",
     mixed = cdtype != jnp.float32
 
     def loss_fn(params, batch_stats, batch: GraphBatch):
-        orig_batch_stats = batch_stats
         if mixed:
             params = _cast_floats(params, cdtype)
             batch_stats = _cast_floats(batch_stats, cdtype)
@@ -109,15 +108,22 @@ def make_loss_fn(model, cfg: ModelConfig, loss_name: str = "mse",
                 out, mut = model.apply(
                     v, b, train=train, mutable=["batch_stats"])
                 # losses/pooling accumulate in f32 regardless of compute dtype
-                return jax.tree_util.tree_map(
+                out = jax.tree_util.tree_map(
                     lambda o: o.astype(jnp.float32), out)
+                return out, mut.get("batch_stats", {})
             total, aux = energy_force_loss(
                 apply_fn, variables, cfg, batch, loss_name,
                 energy_weight, force_weight, train=True)
-            # batch_stats not updated on E-F path (identity feature layers
-            # for the equivariant stacks that support it)
-            return total, (orig_batch_stats, {"loss": total, **{
-                k: v for k, v in aux.items() if v.ndim == 0}})
+            # batch-norm running stats update on the E-F path too (the
+            # reference's torch train-mode forward does; freezing them at
+            # init made eval-mode normalization garbage for SchNet-style
+            # stacks). Stop-grad: the pos-grad must not differentiate them.
+            new_bs = jax.lax.stop_gradient(aux["batch_stats"])
+            if mixed:
+                new_bs = _cast_floats(new_bs, jnp.float32)
+            return total, (new_bs, {"loss": total, **{
+                k: v for k, v in aux.items()
+                if hasattr(v, "ndim") and v.ndim == 0}})
         outputs_and_var, mutated = model.apply(
             variables, _cast_floats(batch, cdtype) if mixed else batch,
             train=True, mutable=["batch_stats"])
@@ -228,8 +234,12 @@ def eval_metrics_and_outputs(forward, cfg: ModelConfig, loss_name: str,
     make_forward_fn — the shared core of the single-device and SPMD eval
     steps."""
     if compute_grad_energy:
+        # eval forward mutates nothing; adapt to energy_force_loss's
+        # (outputs, new_batch_stats) apply contract
+        def apply_fn(v, b, train):
+            return forward(v, b, train=train), None
         total, aux = energy_force_loss(
-            forward, variables, cfg, batch, loss_name, energy_weight,
+            apply_fn, variables, cfg, batch, loss_name, energy_weight,
             force_weight, train=False)
         metrics = {"loss": total,
                    "energy_loss": aux["energy_loss"],

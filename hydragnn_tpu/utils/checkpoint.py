@@ -81,22 +81,27 @@ def _spawn_latest_writer() -> None:
     serialized under one lock: without it, a save enqueued between the old
     thread's final check and its exit would never get its marker written."""
     with _ASYNC_LOCK:
-        if _ASYNC_STATE.get("latest_thread") is not None \
-                and _ASYNC_STATE["latest_thread"].is_alive():
+        if _ASYNC_STATE.get("latest_thread") is not None:
+            # guard on the registered slot, not Thread.is_alive(): a thread
+            # that decided to exit clears its slot under the lock below, so
+            # there is no window where a live-looking-but-exiting thread
+            # swallows a newly enqueued save
             return
 
         def _run():
             while True:
                 with _ASYNC_LOCK:
                     target = _ASYNC_STATE.get("pending_latest")
-                if target is None:
-                    return
+                    if target is None:
+                        _ASYNC_STATE["latest_thread"] = None
+                        return
                 _ASYNC_STATE["ckptr"].wait_until_finished()
                 if os.path.isdir(target):
                     _write_latest(target)
                 with _ASYNC_LOCK:
                     if _ASYNC_STATE.get("pending_latest") == target:
                         _ASYNC_STATE["pending_latest"] = None
+                        _ASYNC_STATE["latest_thread"] = None
                         return
                     # a newer save was enqueued while we wrote: loop
 

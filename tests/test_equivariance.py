@@ -66,7 +66,7 @@ def test_forces_rotate_covariantly():
     variables = init_params(model, batch)
 
     def apply_fn(v, b, train):
-        return model.apply(v, b, train=train)
+        return model.apply(v, b, train=train), None
 
     _, aux1 = energy_force_loss(apply_fn, variables, mcfg, batch)
     R = _random_rotation(7)

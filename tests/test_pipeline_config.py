@@ -1,0 +1,77 @@
+"""Config-reachable pipeline parallelism (`Training.pipeline_stages`).
+
+The GPipe schedule must be a pure execution strategy: pipelined forward ==
+sequential forward on the same params, and a JSON config alone turns the
+path on (VERDICT r1 item 4)."""
+import copy
+
+import jax
+import numpy as np
+import pytest
+
+from hydragnn_tpu.run_training import run_training
+
+from tests.deterministic_data import deterministic_graph_dataset
+from tests.utils import make_config
+
+
+def _splits(n=48, heads=("graph",)):
+    samples = deterministic_graph_dataset(num_configs=n, heads=heads)
+    k = int(n * 2 / 3)
+    return samples[:k], samples[k:k + n // 6], samples[k + n // 6:]
+
+
+def _cfg(stages, model_type="GIN", num_conv_layers=4, heads=("graph",)):
+    cfg = make_config(model_type, heads=heads,
+                      num_conv_layers=num_conv_layers)
+    cfg["NeuralNetwork"]["Training"]["pipeline_stages"] = stages
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 3
+    return cfg
+
+
+def test_pipeline_config_trains():
+    state, history, model, completed = run_training(
+        _cfg(2), datasets=_splits())
+    assert all(np.isfinite(v) for v in history["train_loss"])
+    assert history["train_loss"][-1] < history["train_loss"][0]
+
+
+def test_pipeline_forward_matches_sequential():
+    """Pipelined and sequential execution of the SAME params agree."""
+    from hydragnn_tpu.config import build_model_config, update_config
+    from hydragnn_tpu.graphs.batch import collate
+    from hydragnn_tpu.datasets.loader import _stack_batches
+    from hydragnn_tpu.parallel.mesh import make_mesh
+    from hydragnn_tpu.parallel.pipeline_trainer import (
+        init_pipeline_params, make_pipeline_forward)
+
+    samples = deterministic_graph_dataset(num_configs=16)
+    cfg = make_config("GIN", num_conv_layers=4)
+    cfg = update_config(cfg, samples)
+    mcfg = build_model_config(cfg)
+    micro = [collate(samples[i:i + 4], n_node=128, n_edge=2048, n_graph=5)
+             for i in range(0, 16, 4)]
+    stacked = _stack_batches(micro)
+    params = init_pipeline_params(jax.random.PRNGKey(0), mcfg, micro[0])
+
+    mesh = make_mesh((("pipe", 2),))
+    fwd_pipe = make_pipeline_forward(mcfg, mesh, 2, pipelined=True)
+    fwd_seq = make_pipeline_forward(mcfg, mesh, 2, pipelined=False)
+    out_p, _ = fwd_pipe(params, stacked)
+    out_s, _ = fwd_seq(params, stacked)
+    for a, b in zip(out_p, out_s):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_node_head_trains():
+    state, history, _, _ = run_training(
+        _cfg(2, heads=("node",)), datasets=_splits(heads=("node",)))
+    assert all(np.isfinite(v) for v in history["train_loss"])
+
+
+def test_pipeline_validation_errors():
+    with pytest.raises(ValueError, match="pipeline stages"):
+        run_training(_cfg(3, num_conv_layers=4), datasets=_splits())
+    with pytest.raises(ValueError, match="supports model_type"):
+        run_training(_cfg(2, model_type="PNA"), datasets=_splits())

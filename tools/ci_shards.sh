@@ -1,0 +1,45 @@
+#!/bin/bash
+# CI test shards — one definition shared by .github/workflows/ci.yml and
+# local runs (`tools/ci_shards.sh <shard>`). Each shard targets <10 min on
+# a CI-class CPU box with the 8-device virtual mesh (tests/conftest.py).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+shard="${1:?usage: ci_shards.sh core|data|train|zoo|sweep}"
+
+case "$shard" in
+  core)
+    # ops, model zoo construction, kernels, symmetry
+    python -m pytest -q tests/test_graph_core.py tests/test_models.py \
+      tests/test_registries.py tests/test_irreps.py tests/test_kernels.py \
+      tests/test_equivariance.py
+    ;;
+  data)
+    # datasets, configs, loaders, postprocess
+    python -m pytest -q tests/test_datasets.py tests/test_example_configs.py \
+      tests/test_reference_configs.py tests/test_multidataset.py \
+      tests/test_sampling.py tests/test_visualizer.py \
+      tests/test_model_loadpred.py
+    ;;
+  train)
+    # end-to-end training paths: single-device, SPMD, composed mesh,
+    # pipeline, multi-process rendezvous, examples
+    python -m pytest -q tests/test_training.py tests/test_examples.py \
+      tests/test_multiprocess.py tests/test_composite.py \
+      tests/test_pipeline_config.py tests/test_graph_parallel.py \
+      tests/test_pipeline.py
+    ;;
+  zoo)
+    # the 13-model accuracy battery (per-model thresholds)
+    python -m pytest -q tests/test_graphs_full.py
+    ;;
+  sweep)
+    # nightly: full variant sweep (multihead/lengths/vector/conv-head/
+    # equivariant thresholds) + the energy-force accuracy harness
+    python -m pytest -q -m sweep tests/test_graphs_sweep.py
+    python accuracy.py --cpu
+    ;;
+  *)
+    echo "unknown shard: $shard" >&2; exit 2
+    ;;
+esac
