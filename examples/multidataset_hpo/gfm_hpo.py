@@ -34,6 +34,23 @@ def main():
     p.add_argument("--trial_timeout", type=int, default=360,
                    help="per-trial wall clock (s); slow trials score inf")
     p.add_argument("--cpu", action="store_true")
+    p.add_argument("--concurrent", type=int, default=1,
+                   help=">1: standing orchestration loop (utils.hpo."
+                        "orchestrate) running trials in parallel "
+                        "subprocesses — the DeepHyper queued-evaluator "
+                        "pattern (gfm_deephyper_multi.py:160-177). On a "
+                        "TPU host pass --chips_per_trial (libtpu is "
+                        "single-owner; unpinned concurrent trials fight "
+                        "over the chip) or --cpu.")
+    p.add_argument("--chips_per_trial", type=int, default=0,
+                   help="pin trial i to a disjoint TPU_VISIBLE_CHIPS "
+                        "slice of this size")
+    # single-trial mode (used by the orchestrator as the trial script)
+    p.add_argument("--run_one", action="store_true")
+    p.add_argument("--num_conv_layers", type=int, default=2)
+    p.add_argument("--hidden_dim", type=int, default=32)
+    p.add_argument("--learning_rate", type=float, default=1e-3)
+    p.add_argument("--batch_size", type=int, default=16)
     args = p.parse_args()
 
     here = os.path.dirname(os.path.abspath(__file__))
@@ -75,11 +92,9 @@ def main():
         cmd = create_launch_command(train_script, trial_args)
         if args.cpu:
             cmd = [c for c in cmd] + ["--cpu"]
-        # env-assignment prefixes -> env dict for subprocess
-        env = dict(os.environ)
-        while cmd and "=" in cmd[0] and not cmd[0].startswith("-"):
-            k, _, v = cmd.pop(0).partition("=")
-            env[k] = v
+        from hydragnn_tpu.utils.hpo import split_env_prefix
+        env_over, cmd = split_env_prefix(cmd)
+        env = dict(os.environ, **env_over)
         try:
             r = subprocess.run(cmd, cwd=repo, env=env,
                                timeout=args.trial_timeout,
@@ -94,6 +109,35 @@ def main():
             return float("inf")
         finally:
             os.unlink(overlay)
+
+    if args.run_one:
+        # trial-script mode for the orchestrator: run one sampled config
+        # synchronously; the parent parses final_val_loss from stdout
+        val = objective({"num_conv_layers": args.num_conv_layers,
+                         "hidden_dim": args.hidden_dim,
+                         "learning_rate": args.learning_rate,
+                         "batch_size": args.batch_size})
+        print(json.dumps({"final_val_loss": val}))
+        return
+
+    if args.concurrent > 1:
+        from hydragnn_tpu.utils.hpo import orchestrate
+        extra = {"run_one": "", "trial_epochs": args.trial_epochs,
+                 "multi_model_list": args.multi_model_list,
+                 "limit": args.limit, "inputfile": args.inputfile,
+                 "trial_timeout": args.trial_timeout}
+        if args.cpu:
+            extra["cpu"] = ""
+        result = orchestrate(
+            os.path.abspath(__file__), space,
+            num_trials=args.num_trials, concurrent=args.concurrent,
+            log_dir=os.path.join(repo, "logs", "hpo_gfm"),
+            chips_per_trial=args.chips_per_trial or None,
+            extra_args=extra, timeout_s=args.trial_timeout + 120)
+        print(json.dumps({"best_params": (result["best"] or {}).get("params"),
+                          "num_trials": len(result["history"])},
+                         default=str))
+        return
 
     best, history = search(objective, space, num_trials=args.num_trials,
                            log_path=os.path.join(here, "hpo_results.json"))

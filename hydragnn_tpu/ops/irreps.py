@@ -19,50 +19,72 @@ from typing import Dict, List, Sequence, Tuple
 import numpy as np
 import jax.numpy as jnp
 
-LMAX_SUPPORTED = 3
+# Practical cap: sympy CG derivation and the SH recurrences are general;
+# beyond l=6 the tensor-product path count explodes and fp32 CG precision
+# degrades, so the cap is a guard rail rather than a structural limit
+# (reference: the e3nn machinery is arbitrary-l, mace_utils/tools/cg.py:94).
+LMAX_SUPPORTED = 6
 
 
 # --------------------------------------------------------------------------
-# Real spherical harmonics (component normalization), explicit closed forms
+# Real spherical harmonics (component normalization), arbitrary l via the
+# associated-Legendre recurrence
 # --------------------------------------------------------------------------
 
 def real_spherical_harmonics(vec, lmax: int, normalize: bool = True,
                              eps: float = 1e-9) -> Dict[int, jnp.ndarray]:
-    """vec [..., 3] -> {l: [..., 2l+1]} for l = 0..lmax."""
+    """vec [..., 3] -> {l: [..., 2l+1]} for l = 0..lmax, m ordered -l..l
+    (e3nn ordering: l=1 is (y, z, x)), component normalization
+    (sum_m Y_lm^2 = 2l+1 on the unit sphere), no Condon-Shortley phase.
+
+    General-l construction (replaces the former closed forms, which capped
+    lmax at 3): Y_lm = N_lm * q_l^|m|(z) * {B_|m|, A_|m|}(x, y) with
+      * A_m + i B_m = (x + i y)^m  (azimuthal part times sin^m(theta)),
+      * q_l^m(z) = P_l^m(z) / (1-z^2)^{m/2}, a polynomial in z built by the
+        standard recurrences q_m^m = (2m-1)!!,
+        q_{m+1}^m = (2m+1) z q_m^m,
+        (l-m) q_l^m = (2l-1) z q_{l-1}^m - (l+m-1) q_{l-2}^m,
+      * N_lm = sqrt((2l+1) (l-|m|)!/(l+|m|)!) * (sqrt2 for m != 0).
+    Exactness against the l<=3 closed forms and the component norm at
+    higher l are asserted in tests/test_irreps.py."""
     assert lmax <= LMAX_SUPPORTED, f"lmax {lmax} > {LMAX_SUPPORTED}"
     if normalize:
         r = jnp.sqrt(jnp.sum(vec * vec, axis=-1, keepdims=True) + eps)
         vec = vec / r
     x, y, z = vec[..., 0], vec[..., 1], vec[..., 2]
-    out = {0: jnp.ones(x.shape + (1,), vec.dtype)}
-    if lmax >= 1:
-        s3 = np.sqrt(3.0)
-        out[1] = jnp.stack([s3 * y, s3 * z, s3 * x], axis=-1)
-    if lmax >= 2:
-        s15 = np.sqrt(15.0)
-        s5 = np.sqrt(5.0)
-        out[2] = jnp.stack([
-            s15 * x * y,
-            s15 * y * z,
-            0.5 * s5 * (3 * z * z - 1.0),
-            s15 * x * z,
-            0.5 * s15 * (x * x - y * y),
-        ], axis=-1)
-    if lmax >= 3:
-        c1 = np.sqrt(35.0 / 2.0) / 2.0   # sqrt(4pi)*1/4*sqrt(35/(2pi))
-        c2 = np.sqrt(105.0)              # sqrt(4pi)*1/2*sqrt(105/pi)
-        c3 = np.sqrt(21.0 / 2.0) / 2.0
-        c4 = np.sqrt(7.0) / 2.0
-        c5 = np.sqrt(105.0) / 2.0
-        out[3] = jnp.stack([
-            c1 * y * (3 * x * x - y * y),
-            c2 * x * y * z,
-            c3 * y * (5 * z * z - 1.0),
-            c4 * z * (5 * z * z - 3.0),
-            c3 * x * (5 * z * z - 1.0),
-            c5 * z * (x * x - y * y),
-            c1 * x * (x * x - 3 * y * y),
-        ], axis=-1)
+
+    # azimuthal polynomials A_m, B_m ((x+iy)^m real/imag parts)
+    A = [jnp.ones_like(x)]
+    B = [jnp.zeros_like(x)]
+    for m in range(1, lmax + 1):
+        A.append(x * A[m - 1] - y * B[m - 1])
+        B.append(x * B[m - 1] + y * A[m - 1])
+
+    # q[m][l] = q_l^m(z)
+    q: List[Dict[int, jnp.ndarray]] = [dict() for _ in range(lmax + 1)]
+    dfact = 1.0  # (2m-1)!!
+    for m in range(0, lmax + 1):
+        if m > 0:
+            dfact *= (2 * m - 1)
+        q[m][m] = jnp.full_like(z, dfact)
+        if m + 1 <= lmax:
+            q[m][m + 1] = (2 * m + 1) * z * q[m][m]
+        for l in range(m + 2, lmax + 1):
+            q[m][l] = ((2 * l - 1) * z * q[m][l - 1]
+                       - (l + m - 1) * q[m][l - 2]) / (l - m)
+
+    from math import factorial, sqrt
+    out: Dict[int, jnp.ndarray] = {}
+    for l in range(lmax + 1):
+        cols = []
+        for m in range(-l, l + 1):
+            am = abs(m)
+            n = sqrt((2 * l + 1) * factorial(l - am) / factorial(l + am))
+            if m != 0:
+                n *= sqrt(2.0)
+            azi = B[am] if m < 0 else A[am]
+            cols.append(n * q[am][l] * azi)
+        out[l] = jnp.stack(cols, axis=-1)
     return out
 
 

@@ -66,8 +66,22 @@ def create_launch_command(script: str, trial_args: Dict[str, Any],
         cmd += [f"{k}={v}"]
     cmd += [python, script]
     for k, v in trial_args.items():
-        cmd += [f"--{k}", str(v)]
+        if v == "":
+            cmd.append(f"--{k}")  # boolean flag (store_true)
+        else:
+            cmd += [f"--{k}", str(v)]
     return cmd
+
+
+def split_env_prefix(cmd: Sequence[str]) -> Tuple[Dict[str, str], List[str]]:
+    """Split create_launch_command's KEY=VALUE env prefixes from the argv
+    (one shared splitter — every subprocess consumer needs this)."""
+    env: Dict[str, str] = {}
+    rest = list(cmd)
+    while rest and "=" in rest[0] and not rest[0].startswith("-"):
+        k, _, v = rest.pop(0).partition("=")
+        env[k] = v
+    return env, rest
 
 
 class SearchSpace:
@@ -127,18 +141,149 @@ def search(objective: Callable[[Dict[str, Any]], float],
         study.optimize(obj, n_trials=num_trials)
         best = study.best_params
     except ImportError:
-        rng = np.random.RandomState(seed)
-        ss = SearchSpace(space)
-        best, best_val = None, np.inf if not maximize else -np.inf
-        for _ in range(num_trials):
-            params = ss.sample(rng)
-            val = objective(params)
-            history.append({"params": params, "value": val})
-            better = val > best_val if maximize else val < best_val
-            if better:
-                best, best_val = params, val
+        # in-tree Bayesian optimization (GP + UCB + constant liar) — the
+        # CBO equivalent (reference: deephyper CBO at
+        # gfm_deephyper_multi.py:164-177); random search only as the
+        # explicit HYDRAGNN_HPO_RANDOM=1 opt-out
+        from .envflags import env_flag
+        if env_flag("HYDRAGNN_HPO_RANDOM"):
+            rng = np.random.RandomState(seed)
+            ss = SearchSpace(space)
+            best, best_val = None, np.inf if not maximize else -np.inf
+            for _ in range(num_trials):
+                params = ss.sample(rng)
+                val = objective(params)
+                history.append({"params": params, "value": val})
+                better = val > best_val if maximize else val < best_val
+                if better:
+                    best, best_val = params, val
+        else:
+            from .bayes_opt import CBO
+            opt = CBO(space, seed=seed, maximize=maximize)
+            for _ in range(num_trials):
+                params = opt.ask()
+                val = objective(params)
+                opt.tell(params, val)
+                history.append({"params": params, "value": val})
+            best = opt.best[0] if opt.best else None
     if log_path:
         with open(log_path, "w") as f:
             json.dump({"best": best, "history": history}, f, indent=2,
                       default=str)
     return best, history
+
+
+def orchestrate(script: str, space: Dict[str, Any], num_trials: int = 20,
+                concurrent: int = 1, seed: int = 42,
+                objective_pattern: str = r"final_val_loss\"?[:=]\s*([-\d.eE+]+)",
+                log_dir: str = "./logs/hpo",
+                extra_args: Optional[Dict[str, Any]] = None,
+                chips_per_trial: Optional[int] = None,
+                maximize: bool = False,
+                timeout_s: float = 3600.0) -> Dict[str, Any]:
+    """Standing multi-trial orchestration loop — the DeepHyper
+    ProcessPoolEvaluator + CBO driver as one function (reference:
+    gfm_deephyper_multi.py:47-180: queued evaluator pops node subsets,
+    launches a trial script per suggestion, parses the objective from the
+    trial's output with a regex, feeds it back to the search).
+
+    Trials run as subprocesses of `script` with --key value args from the
+    suggested params (+ extra_args). With `chips_per_trial`, trial i is
+    pinned to a disjoint TPU-chip slice via TPU_VISIBLE_CHIPS. Results
+    stream to {log_dir}/trials.jsonl (one JSON line per finished trial —
+    crash-resumable: already-logged trials are told to the optimizer on
+    restart). Failed/unparseable trials score worst-case, like the
+    reference's "F" objective. Returns {"best": ..., "history": [...]}.
+    """
+    import sys as _sys
+    import time
+
+    from .bayes_opt import CBO
+
+    os.makedirs(log_dir, exist_ok=True)
+    trials_path = os.path.join(log_dir, "trials.jsonl")
+    opt = CBO(space, seed=seed, maximize=maximize)
+    history: List[Dict] = []
+    if os.path.exists(trials_path):  # resume a prior loop
+        with open(trials_path) as f:
+            for line in f:
+                rec = json.loads(line)
+                opt.tell(rec["params"], rec["value"])
+                history.append(rec)
+
+    worst = -np.inf if maximize else np.inf
+    running: List[Tuple[subprocess.Popen, Dict, float, Any, int]] = []
+    launched = len(history)
+    pattern = re.compile(objective_pattern)
+    # chip slices are leased from a free-slot pool, NOT idx % concurrent:
+    # out-of-order completions would otherwise pin two live trials to the
+    # same TPU_VISIBLE_CHIPS slice
+    free_slots = list(range(max(1, concurrent)))
+
+    def _launch(idx: int):
+        params = opt.ask()
+        args = dict(params)
+        args.update(extra_args or {})
+        slot = free_slots.pop(0)
+        chips = None
+        if chips_per_trial:
+            chips = list(range(slot * chips_per_trial,
+                               (slot + 1) * chips_per_trial))
+        cmd = create_launch_command(script, args, chips=chips,
+                                    python=_sys.executable)
+        env_over, cmd = split_env_prefix(cmd)
+        env = dict(os.environ, **env_over)
+        out = open(os.path.join(log_dir, f"trial_{idx:04d}.log"), "w")
+        proc = subprocess.Popen(cmd, stdout=out, stderr=subprocess.STDOUT,
+                                env=env)
+        running.append((proc, params, time.time(), out, slot))
+
+    def _reap(block: bool):
+        while running:
+            for i, (proc, params, t0, out, slot) in enumerate(running):
+                rc = proc.poll()
+                timed_out = time.time() - t0 > timeout_s
+                if rc is None and timed_out:
+                    proc.kill()
+                    proc.wait()  # no zombie; log fully flushed before read
+                    rc = -9
+                if rc is not None:
+                    out.close()
+                    val = worst
+                    logf = out.name
+                    try:
+                        with open(out.name) as f:
+                            matches = pattern.findall(f.read())
+                        if rc == 0 and matches:
+                            val = float(matches[-1])
+                    except (OSError, ValueError):
+                        pass
+                    # tell() maps non-finite scores to worst-finite so a
+                    # failed trial can't poison the GP surrogate
+                    opt.tell(params, val)
+                    rec = {"params": params, "value": val, "rc": rc,
+                           "log": logf}
+                    history.append(rec)
+                    with open(trials_path, "a") as f:
+                        f.write(json.dumps(rec, default=str) + "\n")
+                    free_slots.append(slot)
+                    del running[i]
+                    return
+            if not block:
+                return
+            time.sleep(1.0)
+
+    while launched < num_trials:
+        while len(running) < concurrent and launched < num_trials:
+            _launch(launched)
+            launched += 1
+        _reap(block=True)
+    while running:
+        _reap(block=True)
+
+    best = opt.best
+    result = {"best": {"params": best[0], "value": best[1]} if best else None,
+              "history": history}
+    with open(os.path.join(log_dir, "result.json"), "w") as f:
+        json.dump(result, f, indent=2, default=str)
+    return result

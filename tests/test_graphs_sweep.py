@@ -63,6 +63,17 @@ EXTRA_ARCH = {
 NUM_CONFIGS = 200
 NUM_EPOCH = 50
 
+# cases that marginally miss their (reference) thresholds at the reduced
+# CI budget get the reference's own 500-config/100-epoch budget
+# (reference: tests/test_graphs.py:88,num_samples_tot=500 + ci configs'
+# num_epoch=100) — thresholds are never loosened
+FULL_BUDGET = {
+    ("SchNet", "ci_multihead.json"),
+    ("PNA", "ci.json"), ("PNAPlus", "ci.json"),            # lengths
+    ("PNA", "ci_vectoroutput.json"), ("PNAPlus", "ci_vectoroutput.json"),
+    ("MFC", "ci_conv_head.json"), ("SchNet", "ci_conv_head.json"),
+}
+
 
 def _load(name):
     with open(os.path.join(REF_INPUTS, name)) as f:
@@ -94,12 +105,14 @@ def _train_and_check(model_type, ci_input, use_lengths=False):
         arch["task_weights"][0] = 2
     if use_lengths:
         arch["edge_features"] = ["lengths"]
+    full = (model_type, ci_input) in FULL_BUDGET
+    num_configs = 500 if full else NUM_CONFIGS
     train_cfg = cfg["NeuralNetwork"]["Training"]
-    train_cfg["num_epoch"] = NUM_EPOCH
+    train_cfg["num_epoch"] = 100 if full else NUM_EPOCH
     train_cfg["EarlyStopping"] = False
     cfg.setdefault("Visualization", {})["create_plots"] = False
 
-    samples = deterministic_samples_for_config(cfg, num_configs=NUM_CONFIGS)
+    samples = deterministic_samples_for_config(cfg, num_configs=num_configs)
     splits = split_dataset(samples, train_cfg.get("perc_train", 0.7))
     state, history, model, completed = run_training(cfg, datasets=splits,
                                                     num_shards=1)

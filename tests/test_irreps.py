@@ -29,7 +29,7 @@ def _random_rotation(seed):
 
 
 class TestSphericalHarmonics:
-    @pytest.mark.parametrize("l", [0, 1, 2, 3])
+    @pytest.mark.parametrize("l", [0, 1, 2, 3, 4, 5, 6])
     def test_component_normalization(self, l):
         rng = np.random.RandomState(1)
         v = rng.randn(200, 3)
@@ -37,7 +37,36 @@ class TestSphericalHarmonics:
         np.testing.assert_allclose(np.sum(Y ** 2, axis=1), 2 * l + 1,
                                    rtol=1e-4)
 
-    @pytest.mark.parametrize("l", [1, 2, 3])
+    def test_matches_closed_forms_lmax3(self):
+        """The general recurrence generator must reproduce the original
+        l<=3 closed forms exactly (same ordering, normalization, signs)."""
+        rng = np.random.RandomState(2)
+        v = rng.randn(100, 3)
+        v /= np.linalg.norm(v, axis=1, keepdims=True)
+        x, y, z = v[:, 0], v[:, 1], v[:, 2]
+        sh = real_spherical_harmonics(jnp.asarray(v), 3, normalize=False)
+        s3, s5, s15 = np.sqrt(3.0), np.sqrt(5.0), np.sqrt(15.0)
+        np.testing.assert_allclose(np.asarray(sh[1]),
+                                   np.stack([s3 * y, s3 * z, s3 * x], -1),
+                                   atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(sh[2]),
+            np.stack([s15 * x * y, s15 * y * z,
+                      0.5 * s5 * (3 * z * z - 1.0), s15 * x * z,
+                      0.5 * s15 * (x * x - y * y)], -1), atol=1e-5)
+        c1 = np.sqrt(35.0 / 2.0) / 2.0
+        c2 = np.sqrt(105.0)
+        c3 = np.sqrt(21.0 / 2.0) / 2.0
+        c4 = np.sqrt(7.0) / 2.0
+        c5 = np.sqrt(105.0) / 2.0
+        np.testing.assert_allclose(
+            np.asarray(sh[3]),
+            np.stack([c1 * y * (3 * x * x - y * y), c2 * x * y * z,
+                      c3 * y * (5 * z * z - 1.0), c4 * z * (5 * z * z - 3.0),
+                      c3 * x * (5 * z * z - 1.0), c5 * z * (x * x - y * y),
+                      c1 * x * (x * x - 3 * y * y)], -1), atol=1e-5)
+
+    @pytest.mark.parametrize("l", [1, 2, 3, 4, 5])
     def test_rotation_representation(self, l):
         """Y_l(Rv) = D_l(R) Y_l(v) with D orthogonal (it's a representation)."""
         R = _random_rotation(3)
@@ -54,7 +83,8 @@ class TestSphericalHarmonics:
 class TestClebschGordan:
     @pytest.mark.parametrize("l1,l2,l3", [(1, 1, 0), (1, 1, 1), (1, 1, 2),
                                           (2, 1, 1), (2, 2, 2), (2, 1, 3),
-                                          (3, 2, 1)])
+                                          (3, 2, 1), (4, 1, 4), (3, 2, 4),
+                                          (4, 2, 5)])
     def test_intertwining(self, l1, l2, l3):
         """CG contraction commutes with rotation: the core equivariance
         property every MACE layer relies on."""

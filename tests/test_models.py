@@ -143,6 +143,19 @@ def test_conv_node_head(model_type, samples):
     assert any(float(jnp.max(jnp.abs(x))) > 0 for x in flat)
 
 
+def test_mace_lmax4(samples):
+    """MACE above the old lmax=3 cap: the general-l spherical harmonics +
+    sympy CG path builds and produces finite outputs at max_ell=4
+    (reference: e3nn machinery is arbitrary-l, mace_utils/tools/cg.py:94)."""
+    cfg, mcfg, batch = prepare("MACE", samples, max_ell=4, node_max_ell=2,
+                               correlation=[2])
+    model = create_model(mcfg)
+    variables = init_params(model, batch)
+    outputs, _ = model.apply(variables, batch, train=False)
+    assert outputs[0].shape == (batch.num_graphs, 1)
+    assert np.all(np.isfinite(np.asarray(outputs[0])))
+
+
 def test_mlp_per_node_head():
     samples = deterministic_graph_dataset(num_configs=8, heads=("node",))
     # fix graph size: filter to the modal size
