@@ -1,0 +1,139 @@
+"""Shared dataset acquisition/convert helpers for the examples.
+
+reference: examples/open_catalyst_2020/download_dataset.py:1-153 (wget +
+tar + per-split layout), uncompress.py (parallel .xz inflation), and the
+per-example ad-hoc downloads. Here: one stdlib toolbox (urllib, tarfile,
+zipfile, lzma — no wget/os.system) shared by every example's
+download_dataset.py, plus GraphStore conversion so a downloaded corpus can
+be streamed out-of-core by datasets.gsdataset.
+
+Zero-egress environments: every downloader accepts --from-file to ingest a
+pre-fetched archive, and the extract/convert paths are unit-tested against
+locally generated fixtures (tests/test_dataset_tooling.py).
+"""
+from __future__ import annotations
+
+import hashlib
+import lzma
+import os
+import shutil
+import sys
+import tarfile
+import urllib.request
+import zipfile
+from typing import Callable, Iterable, Optional
+
+
+def download(url: str, dest: str, sha256: Optional[str] = None,
+             retries: int = 3, chunk: int = 1 << 20) -> str:
+    """Resumable download to `dest` (skips when complete + checksum ok)."""
+    os.makedirs(os.path.dirname(os.path.abspath(dest)), exist_ok=True)
+    if os.path.exists(dest) and (sha256 is None or
+                                 _sha256(dest) == sha256):
+        return dest
+    tmp = dest + ".part"
+    for attempt in range(retries):
+        try:
+            req = urllib.request.Request(url)
+            start = os.path.getsize(tmp) if os.path.exists(tmp) else 0
+            if start:
+                req.add_header("Range", f"bytes={start}-")
+            with urllib.request.urlopen(req, timeout=60) as r:
+                # append ONLY on a 206 partial response — a server that
+                # ignores Range returns 200 with the full body, and
+                # appending that would corrupt the file
+                resume = start and getattr(r, "status", 200) == 206
+                with open(tmp, "ab" if resume else "wb") as f:
+                    while True:
+                        buf = r.read(chunk)
+                        if not buf:
+                            break
+                        f.write(buf)
+            break
+        except OSError:
+            if attempt == retries - 1:
+                raise
+    if sha256 is not None and _sha256(tmp) != sha256:
+        os.remove(tmp)  # a kept corrupt .part would poison every retry
+        raise ValueError(f"checksum mismatch for {url}")
+    os.replace(tmp, dest)
+    return dest
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for blk in iter(lambda: f.read(1 << 20), b""):
+            h.update(blk)
+    return h.hexdigest()
+
+
+def extract(archive: str, dest: str) -> str:
+    """tar(.gz/.xz)/zip/.xz extraction into `dest`."""
+    os.makedirs(dest, exist_ok=True)
+    if tarfile.is_tarfile(archive):
+        with tarfile.open(archive) as t:
+            t.extractall(dest, filter="data")
+    elif zipfile.is_zipfile(archive):
+        with zipfile.ZipFile(archive) as z:
+            z.extractall(dest)
+    elif archive.endswith(".xz"):
+        out = os.path.join(dest, os.path.basename(archive)[:-3])
+        with lzma.open(archive) as src, open(out, "wb") as dst:
+            shutil.copyfileobj(src, dst)
+    else:
+        raise ValueError(f"unknown archive format: {archive}")
+    return dest
+
+
+def uncompress_xz_dir(src_dir: str, dest_dir: str,
+                      workers: int = 0) -> int:
+    """Inflate every .xz chunk under src_dir (the S2EF layout — reference:
+    uncompress.py runs this via multiprocessing Pool). Returns the count."""
+    os.makedirs(dest_dir, exist_ok=True)
+    paths = []
+    for root, _, files in os.walk(src_dir):
+        for name in files:
+            if name.endswith(".xz"):
+                paths.append(os.path.join(root, name))
+
+    def one(path):
+        out = os.path.join(dest_dir, os.path.basename(path)[:-3])
+        with lzma.open(path) as src, open(out, "wb") as dst:
+            shutil.copyfileobj(src, dst)
+
+    if workers and len(paths) > 1:
+        from multiprocessing.pool import ThreadPool
+        ThreadPool(workers).map(one, paths)
+    else:
+        for p in paths:
+            one(p)
+    return len(paths)
+
+
+def to_graphstore(samples: Iterable, out_dir: str,
+                  log: Callable[[str], None] = lambda s: print(s)) -> int:
+    """Persist samples into a GraphStore directory (columnar out-of-core
+    format, datasets/gsdataset.py) for training at scales that don't fit
+    in memory. Returns the sample count."""
+    from hydragnn_tpu.datasets.gsdataset import GraphStoreWriter
+    w = GraphStoreWriter(out_dir)
+    n = 0
+    for s in samples:
+        w.add(s)
+        n += 1
+        if n % 10000 == 0:
+            log(f"  converted {n} samples")
+    w.save()
+    log(f"wrote {n} samples -> {out_dir}")
+    return n
+
+
+def resolve_archive(url: str, workdir: str,
+                    from_file: Optional[str] = None,
+                    sha256: Optional[str] = None) -> str:
+    """`from_file` (pre-fetched archive) when given, else download(url)."""
+    if from_file:
+        return from_file
+    return download(url, os.path.join(workdir,
+                                      os.path.basename(url)), sha256)

@@ -1,0 +1,40 @@
+"""Download MD17 trajectory npz files into the layout md17_data.py reads
+(dataset/md17/raw/md17_<molecule>.npz).
+
+reference: torch_geometric.datasets.MD17's sGDML download
+(examples/md17/md17.py:19-35 delegates to PyG). `--from-file` ingests a
+pre-fetched npz on zero-egress hosts.
+"""
+import argparse
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+MD17_URL = "http://www.quantum-machine.org/gdml/data/npz/md17_{mol}.npz"
+MOLECULES = ["uracil", "aspirin", "benzene2017", "ethanol", "malonaldehyde",
+             "naphthalene", "salicylic", "toluene"]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--molecule", default="uracil", choices=MOLECULES)
+    p.add_argument("--datadir", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "dataset", "md17",
+        "raw"))
+    p.add_argument("--from-file", default=None)
+    a = p.parse_args()
+
+    from examples.dataset_utils import resolve_archive
+    dest = os.path.join(a.datadir, f"md17_{a.molecule}.npz")
+    os.makedirs(a.datadir, exist_ok=True)
+    if a.from_file:
+        shutil.copy(a.from_file, dest)
+    else:
+        resolve_archive(MD17_URL.format(mol=a.molecule), a.datadir)
+    print(f"MD17 ({a.molecule}) ready at {dest}")
+
+
+if __name__ == "__main__":
+    main()

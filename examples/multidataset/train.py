@@ -98,6 +98,19 @@ def main():
     p.add_argument("--steps_per_call", type=int, default=None,
                    help="scan S optimizer steps per device dispatch")
     p.add_argument("--cpu", action="store_true")
+    p.add_argument("--ddstore", action="store_true",
+                   help="serve training samples through the C++ DDStore "
+                        "(reference: --ddstore, multidataset/train.py:49)")
+    p.add_argument("--log", default="gfm_multidataset",
+                   help="run/log name (reference: --log)")
+    p.add_argument("--modelname", default=None,
+                   help="resume from this prior run's checkpoint "
+                        "(reference: --modelname + Training.continue)")
+    p.add_argument("--checkpoint", action="store_true",
+                   help="save best-val checkpoints during training")
+    p.add_argument("--everyone", action="store_true",
+                   help="print the timer table at exit (reference: "
+                        "--everyone gptimer)")
     args = p.parse_args()
 
     if args.cpu:
@@ -182,6 +195,18 @@ def main():
     batch_size = train_cfg["batch_size"]
     if batch_size % num_shards != 0:
         batch_size = num_shards * max(1, batch_size // num_shards)
+    if args.ddstore:
+        # per-member C++ DDStore data plane (reference: DistDataset wrap
+        # behind --ddstore, multidataset/train.py:321-339); single-process
+        # wiring here — each member becomes one locally-owned shard
+        from hydragnn_tpu.datasets.ddstore import DistDataset
+        wrapped = []
+        for t in trainsets:
+            t = list(t)
+            dd = DistDataset(rank=0, world=1)
+            dd.populate(t, 0, len(t), [0, len(t)])
+            wrapped.append(dd)
+        trainsets = wrapped
     loader = MultiDatasetLoader(trainsets, batch_size=batch_size,
                                 num_shards=num_shards)
     val_loader = GraphDataLoader(valset, batch_size=batch_size,
@@ -195,6 +220,17 @@ def main():
     variables = init_params(model, init_batch)
     tx = select_optimizer(train_cfg)
     state = TrainState.create(variables, tx)
+
+    if args.modelname:
+        # transfer/resume from a prior run's checkpoint (reference:
+        # load_existing_model via Training.continue + startfrom)
+        from hydragnn_tpu.utils.checkpoint import load_existing_model
+        restored = load_existing_model(state, args.modelname)
+        if restored is None:
+            raise SystemExit(f"--modelname {args.modelname}: no checkpoint "
+                             "found under ./logs")
+        state = restored
+        print(f"resumed from '{args.modelname}' at step {int(state.step)}")
     mesh = make_mesh((("data", num_shards),))
     loss_name = train_cfg.get("loss_function_type", "mae")
     train_step = make_spmd_train_step(model, mcfg, tx, mesh, loss_name)
@@ -208,18 +244,35 @@ def main():
     steps_per_call = resolve_steps_per_call(train_cfg)
     multi_step, place_group = make_spmd_dispatch_group(
         model, mcfg, tx, mesh, steps_per_call, loss_name=loss_name)
+    ckpt_fn = None
+    if args.checkpoint:
+        from hydragnn_tpu.utils.checkpoint import save_model
+
+        def ckpt_fn(s, e, v):
+            save_model(s, args.log, use_async=True)
+
+    from hydragnn_tpu.utils import profiling as tr
     state, history = train_validate_test(
         train_step, eval_step, state, loader, val_loader, test_loader,
-        num_epochs=train_cfg["num_epoch"], log_name="gfm_multidataset",
+        num_epochs=train_cfg["num_epoch"], log_name=args.log,
         use_early_stopping=bool(train_cfg.get("EarlyStopping", False)),
         verbosity=config.get("Verbosity", {}).get("level", 0),
         place_fn=lambda b: shard_batch(b, mesh),
+        checkpoint_fn=ckpt_fn, tracer=tr.get(),
         multi_train_step=multi_step, steps_per_call=steps_per_call,
         place_group_fn=place_group)
+    if args.checkpoint:
+        from hydragnn_tpu.utils.checkpoint import (save_model,
+                                                   wait_for_checkpoints)
+        wait_for_checkpoints()
+        save_model(state, args.log)
     print(json.dumps({"final_train_loss": history["train_loss"][-1],
                       "final_val_loss": history["val_loss"][-1],
                       "num_datasets": len(modellist),
                       "shard_batch": batch_size}))
+    if args.everyone:
+        from hydragnn_tpu.utils import profiling as tr
+        print(tr.print_timers())
 
 
 if __name__ == "__main__":

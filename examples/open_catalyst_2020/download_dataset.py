@@ -1,0 +1,108 @@
+"""Download + preprocess OC20 S2EF splits into trainable layouts.
+
+reference: examples/open_catalyst_2020/download_dataset.py:1-153 (wget +
+tar + uncompress + per-split directory layout) and uncompress.py. Stdlib
+re-implementation (urllib/tarfile/lzma via examples.dataset_utils) with a
+`--to-graphstore` conversion step so the uncompressed extxyz chunks stream
+out-of-core through datasets.gsdataset at training time.
+
+Usage:
+    python download_dataset.py --task s2ef --split 200k [--datadir ...]
+        [--to-graphstore] [--limit N] [--from-file s2ef_train_200K.tar]
+        [--keep-intermediate]
+
+Zero-egress hosts: pass --from-file with a pre-fetched archive; everything
+after the download step runs locally.
+"""
+import argparse
+import glob
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+# reference: DOWNLOAD_LINKS, download_dataset.py:11-27
+DOWNLOAD_LINKS = {
+    "s2ef": {
+        "200k": "https://dl.fbaipublicfiles.com/opencatalystproject/data/s2ef_train_200K.tar",
+        "2M": "https://dl.fbaipublicfiles.com/opencatalystproject/data/s2ef_train_2M.tar",
+        "20M": "https://dl.fbaipublicfiles.com/opencatalystproject/data/s2ef_train_20M.tar",
+        "all": "https://dl.fbaipublicfiles.com/opencatalystproject/data/s2ef_train_all.tar",
+        "val_id": "https://dl.fbaipublicfiles.com/opencatalystproject/data/s2ef_val_id.tar",
+        "val_ood_ads": "https://dl.fbaipublicfiles.com/opencatalystproject/data/s2ef_val_ood_ads.tar",
+        "val_ood_cat": "https://dl.fbaipublicfiles.com/opencatalystproject/data/s2ef_val_ood_cat.tar",
+        "val_ood_both": "https://dl.fbaipublicfiles.com/opencatalystproject/data/s2ef_val_ood_both.tar",
+        "test": "https://dl.fbaipublicfiles.com/opencatalystproject/data/s2ef_test_lmdbs.tar.gz",
+        "rattled": "https://dl.fbaipublicfiles.com/opencatalystproject/data/s2ef_rattled.tar",
+        "md": "https://dl.fbaipublicfiles.com/opencatalystproject/data/s2ef_md.tar",
+    },
+    "is2re": "https://dl.fbaipublicfiles.com/opencatalystproject/data/is2res_train_val_test_lmdbs.tar.gz",
+}
+
+
+def get_data(datadir, task, split, from_file=None, to_graphstore=False,
+             limit=0, keep_intermediate=False):
+    from examples.dataset_utils import (extract, resolve_archive,
+                                        to_graphstore as convert,
+                                        uncompress_xz_dir)
+    os.makedirs(datadir, exist_ok=True)
+    if task == "s2ef":
+        if split not in DOWNLOAD_LINKS["s2ef"]:
+            raise SystemExit(
+                f"unknown s2ef split {split!r}; one of "
+                f"{sorted(DOWNLOAD_LINKS['s2ef'])}")
+        url = DOWNLOAD_LINKS["s2ef"][split]
+    else:
+        url = DOWNLOAD_LINKS["is2re"]
+
+    archive = resolve_archive(url, datadir, from_file)
+    staged = os.path.join(datadir, "staged", os.path.basename(url).split(
+        ".")[0])
+    extract(archive, staged)
+
+    if task == "s2ef" and split != "test":
+        # layout parity with the reference (download_dataset.py:66-76):
+        # train splits -> s2ef/<split>/train, val -> s2ef/all/<split>
+        if split in ("200k", "2M", "20M", "all", "rattled", "md"):
+            out = os.path.join(datadir, "s2ef", split, "train")
+        else:
+            out = os.path.join(datadir, "s2ef", "all", split)
+        n = uncompress_xz_dir(staged, out, workers=os.cpu_count())
+        print(f"uncompressed {n} chunks -> {out}")
+    else:
+        out = os.path.join(datadir, task)
+        os.makedirs(out, exist_ok=True)
+        for p in glob.glob(os.path.join(staged, "**", "*"), recursive=True):
+            if os.path.isfile(p):
+                shutil.move(p, os.path.join(out, os.path.basename(p)))
+    if not keep_intermediate:
+        shutil.rmtree(os.path.join(datadir, "staged"), ignore_errors=True)
+
+    if to_graphstore:
+        from examples.open_catalyst_2020.oc20_data import load_oc20
+        samples = load_oc20(out, limit=limit or 10 ** 9)
+        convert(samples, out + "_graphstore")
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--datadir", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "dataset"))
+    p.add_argument("--task", default="s2ef", choices=["s2ef", "is2re"])
+    p.add_argument("--split", default="200k")
+    p.add_argument("--from-file", default=None,
+                   help="pre-fetched archive (skips the download)")
+    p.add_argument("--to-graphstore", action="store_true",
+                   help="also convert to the out-of-core GraphStore format")
+    p.add_argument("--limit", type=int, default=0)
+    p.add_argument("--keep-intermediate", action="store_true")
+    a = p.parse_args()
+    out = get_data(a.datadir, a.task, a.split, a.from_file,
+                   a.to_graphstore, a.limit, a.keep_intermediate)
+    print(f"dataset ready at {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -122,7 +122,7 @@ class DDStore:
 
 
 _DD_FIELDS = ("x", "pos", "senders", "receivers", "y_graph", "y_node",
-              "edge_attr", "edge_shifts", "energy", "forces")
+              "edge_attr", "edge_shifts", "energy", "forces", "cell")
 
 
 class DistDataset:

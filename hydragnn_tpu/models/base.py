@@ -165,9 +165,15 @@ class BaseStack(nn.Module):
                     conv = self.make_conv(hin, hd, cfg.num_conv_layers + 100 * ih + li,
                                           final=(li == len(hdims) - 1))
                     h, hpos = conv(h, hpos, batch, cargs)
-                    if self.use_batch_norm:
-                        h = MaskedBatchNorm(name=f"head_{ih}_norm_{li}")(
-                            h, batch.node_mask, use_running_average=not train)
+                    # head-conv batchnorm is unconditional: the reference
+                    # creates BatchNorm1d for conv heads in EVERY stack
+                    # (_init_node_conv, Base.py:240-260 + forward :336-341)
+                    # — use_batch_norm only governs encoder feature layers.
+                    # Without it the unnormalized stacks (EGNN/PAINN/
+                    # PNAEq/DimeNet) explode through the head convs and
+                    # die at relu(0) (constant-zero predictions)
+                    h = MaskedBatchNorm(name=f"head_{ih}_norm_{li}")(
+                        h, batch.node_mask, use_running_average=not train)
                     h = act(h)
                     hin = hd
                 out = h

@@ -234,8 +234,11 @@ def orchestrate(script: str, space: Dict[str, Any], num_trials: int = 20,
         env_over, cmd = split_env_prefix(cmd)
         env = dict(os.environ, **env_over)
         out = open(os.path.join(log_dir, f"trial_{idx:04d}.log"), "w")
+        # own session: a timed-out trial is killed as a PROCESS GROUP so
+        # grandchildren (run_one wrappers spawn the actual training) can't
+        # outlive it still holding the chip slice we're about to re-lease
         proc = subprocess.Popen(cmd, stdout=out, stderr=subprocess.STDOUT,
-                                env=env)
+                                env=env, start_new_session=True)
         running.append((proc, params, time.time(), out, slot))
 
     def _reap(block: bool):
@@ -244,7 +247,11 @@ def orchestrate(script: str, space: Dict[str, Any], num_trials: int = 20,
                 rc = proc.poll()
                 timed_out = time.time() - t0 > timeout_s
                 if rc is None and timed_out:
-                    proc.kill()
+                    import signal
+                    try:
+                        os.killpg(proc.pid, signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        proc.kill()
                     proc.wait()  # no zombie; log fully flushed before read
                     rc = -9
                 if rc is not None:

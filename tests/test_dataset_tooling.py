@@ -1,0 +1,102 @@
+"""Dataset acquisition tooling against locally generated fixtures — the
+zero-egress test path for the download/uncompress/convert pipeline
+(reference: examples/open_catalyst_2020/download_dataset.py +
+uncompress.py)."""
+import lzma
+import os
+import subprocess
+import sys
+import tarfile
+
+import numpy as np
+
+from examples.dataset_utils import (extract, resolve_archive, to_graphstore,
+                                    uncompress_xz_dir)
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _write_extxyz_chunk(path, n_frames=2, n_atoms=5, seed=0):
+    rng = np.random.RandomState(seed)
+    lines = []
+    for _ in range(n_frames):
+        lines.append(str(n_atoms))
+        lines.append('Lattice="9 0 0 0 9 0 0 0 9" '
+                     'Properties=species:S:1:pos:R:3:forces:R:3 '
+                     'free_energy=-12.5')
+        for _ in range(n_atoms):
+            p = rng.rand(3) * 8
+            f = rng.randn(3)
+            lines.append("Cu " + " ".join(f"{v:.6f}" for v in p) + " "
+                         + " ".join(f"{v:.6f}" for v in f))
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+
+
+def _make_s2ef_archive(tmp_path, n_chunks=2):
+    """A miniature s2ef tar: .extxyz.xz chunks like the real S2EF splits."""
+    src = tmp_path / "raw"
+    src.mkdir()
+    for i in range(n_chunks):
+        plain = src / f"{i}.extxyz"
+        _write_extxyz_chunk(str(plain), seed=i)
+        with open(plain, "rb") as f_in, \
+                lzma.open(str(plain) + ".xz", "wb") as f_out:
+            f_out.write(f_in.read())
+        plain.unlink()
+    tar_path = tmp_path / "s2ef_train_tiny.tar"
+    with tarfile.open(tar_path, "w") as t:
+        for p in sorted(src.iterdir()):
+            t.add(str(p), arcname=f"s2ef_train_tiny/{p.name}")
+    return str(tar_path)
+
+
+def test_extract_and_uncompress_roundtrip(tmp_path):
+    tar_path = _make_s2ef_archive(tmp_path)
+    staged = str(tmp_path / "staged")
+    extract(tar_path, staged)
+    out = str(tmp_path / "out")
+    n = uncompress_xz_dir(staged, out, workers=2)
+    assert n == 2
+    files = sorted(os.listdir(out))
+    assert files == ["0.extxyz", "1.extxyz"]
+    first = open(os.path.join(out, "0.extxyz")).read()
+    assert "free_energy=-12.5" in first
+
+
+def test_resolve_archive_from_file(tmp_path):
+    tar_path = _make_s2ef_archive(tmp_path)
+    got = resolve_archive("https://example.invalid/x.tar",
+                          str(tmp_path), from_file=tar_path)
+    assert got == tar_path
+
+
+def test_oc20_download_pipeline_from_file(tmp_path):
+    """download_dataset.py --from-file end-to-end: extract, uncompress into
+    the reference layout, convert to GraphStore, and train-load it."""
+    tar_path = _make_s2ef_archive(tmp_path)
+    datadir = str(tmp_path / "ds")
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples", "open_catalyst_2020",
+                      "download_dataset.py"),
+         "--datadir", datadir, "--task", "s2ef", "--split", "200k",
+         "--from-file", tar_path, "--to-graphstore"],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = os.path.join(datadir, "s2ef", "200k", "train")
+    assert sorted(os.listdir(out)) == ["0.extxyz", "1.extxyz"]
+
+    from hydragnn_tpu.datasets.gsdataset import GraphStoreDataset
+    gs = GraphStoreDataset(out + "_graphstore")
+    samples = list(gs)
+    assert len(samples) == 4  # 2 chunks x 2 frames
+    assert samples[0].forces is not None
+
+
+def test_to_graphstore_counts(tmp_path):
+    from examples.LennardJones.lj_data import generate_lj_dataset
+    samples = generate_lj_dataset(num_configs=6)
+    n = to_graphstore(iter(samples), str(tmp_path / "gs"),
+                      log=lambda s: None)
+    assert n == 6
