@@ -58,6 +58,9 @@ def main():
     p.add_argument("--round", type=int,
                    default=int(os.environ.get("GRAFT_ROUND", "2")))
     p.add_argument("--model", default="SchNet", choices=sorted(THRESHOLDS))
+    p.add_argument("--all", action="store_true",
+                   help="run the whole battery (every model in THRESHOLDS) "
+                        "and write one combined artifact")
     p.add_argument("--cpu", action="store_true",
                    help="force the 8-device virtual CPU mesh")
     p.add_argument("--out", default=None)
@@ -79,21 +82,42 @@ def main():
         else:
             backend = platform
 
+    path = args.out or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    f"ACCURACY_r{args.round:02d}.json")
+    # the dataset is deterministic (fixed budget/seed) — generate once,
+    # share across the battery
     from examples.LennardJones.lj_data import generate_lj_dataset
-    from hydragnn_tpu.graphs.batch import collate
     from hydragnn_tpu.preprocess.load_data import split_dataset
-    from hydragnn_tpu.run_training import run_training
-    from hydragnn_tpu.train.train_step import make_eval_step
-
     samples = generate_lj_dataset(num_configs=NUM_CONFIGS, seed=SEED,
                                   lattice=LATTICE, jitter=JITTER,
                                   cutoff=RADIUS)
     splits = split_dataset(samples, 0.7)
+
+    if args.all:
+        models = sorted(THRESHOLDS)
+        results = {m: run_model(m, backend, samples, splits)
+                   for m in models}
+        out = {"metric": "lj_energy_force_mae_battery",
+               "backend": backend,
+               "pass": all(r["pass"] for r in results.values()),
+               "models": results}
+    else:
+        out = run_model(args.model, backend, samples, splits)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    sys.exit(0 if out["pass"] else 1)
+
+
+def run_model(model_name: str, backend: str, samples, splits) -> dict:
+    from hydragnn_tpu.graphs.batch import collate
+    from hydragnn_tpu.run_training import run_training
+    from hydragnn_tpu.train.train_step import make_eval_step
     config = {
         "Verbosity": {"level": 1},
         "NeuralNetwork": {
             "Architecture": {
-                "model_type": args.model, "hidden_dim": HIDDEN,
+                "model_type": model_name, "hidden_dim": HIDDEN,
                 "num_conv_layers": NUM_CONV, "radius": RADIUS,
                 "max_neighbours": 64, "num_gaussians": 32,
                 "num_filters": HIDDEN, "num_radial": 8, "num_spherical": 4,
@@ -154,15 +178,20 @@ def main():
         f_true = np.concatenate([s.forces for s in chunk])
         f_abs += float(np.abs(f_pred[mask] - f_true).sum())
         f_n += f_true.size
-    energy_mae = e_abs / max(e_n, 1)
-    force_mae = f_abs / max(f_n, 1)
+    # a test split smaller than BATCH_SIZE would skip the loop entirely
+    # and "pass" with 0.0 MAEs — refuse to report on zero samples
+    assert e_n > 0 and f_n > 0, (
+        f"test split ({len(te)} samples) yielded no full batch of "
+        f"{bs}; raise NUM_CONFIGS or lower BATCH_SIZE")
+    energy_mae = e_abs / e_n
+    force_mae = f_abs / f_n
     # scale context: MAE relative to the label spread
     e_all = np.asarray([s.energy[0] for s in samples])
     f_all = np.concatenate([s.forces for s in samples])
-    th = THRESHOLDS[args.model]
+    th = THRESHOLDS[model_name]
     out = {
         "metric": "lj_energy_force_mae",
-        "model": args.model,
+        "model": model_name,
         "energy_mae": round(energy_mae, 5),
         "force_mae": round(force_mae, 5),
         "energy_mae_rel": round(energy_mae / float(np.abs(e_all).mean()), 5),
@@ -177,12 +206,7 @@ def main():
         "final_train_loss": round(float(history["train_loss"][-1]), 5),
         "backend": backend,
     }
-    path = args.out or os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                    f"ACCURACY_r{args.round:02d}.json")
-    with open(path, "w") as f:
-        json.dump(out, f, indent=1)
-    print(json.dumps(out))
-    sys.exit(0 if out["pass"] else 1)
+    return out
 
 
 if __name__ == "__main__":

@@ -1,0 +1,74 @@
+"""LJ inference + plot suite: train (or reuse) an energy-force model on
+the Lennard-Jones workload, predict the test split, and emit the full
+Visualizer battery.
+
+reference: examples/LennardJones/LJ_inference_plots.py — loads the
+trained LJ model, runs inference over the serialized dataset, and
+scatter-plots predicted vs. true energies/forces per rank. Here the
+prediction path is run_prediction and the plots are the Visualizer's
+(parity, global analysis, error PDFs), written under
+logs/<name>/postprocess/.
+
+Usage:
+    python examples/LennardJones/LJ_inference_plots.py \
+        [--model_type SchNet] [--num_configs 160] [--num_epoch 30] [--cpu]
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model_type", default="SchNet")
+    p.add_argument("--num_configs", type=int, default=160)
+    p.add_argument("--num_epoch", type=int, default=30)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+    if args.cpu:
+        from examples.cli_utils import setup_cpu_devices
+        setup_cpu_devices()
+
+    from examples.LennardJones.lj_data import generate_lj_dataset
+    from hydragnn_tpu.postprocess.visualizer import Visualizer
+    from hydragnn_tpu.preprocess.load_data import split_dataset
+    from hydragnn_tpu.run_prediction import run_prediction
+    from hydragnn_tpu.run_training import run_training
+    from tests.utils import make_config
+
+    samples = generate_lj_dataset(num_configs=args.num_configs)
+    splits = split_dataset(samples, 0.8, False)
+
+    cfg = make_config(args.model_type, heads=("graph", "node"))
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = args.num_epoch
+    cfg["NeuralNetwork"]["Training"]["compute_grad_energy"] = True
+    state, history, model, completed = run_training(cfg, datasets=splits)
+    trues, preds = run_prediction(completed, datasets=splits, state=state,
+                                  model=model)
+
+    name = f"LJ_{args.model_type}"
+    viz = Visualizer(name, num_heads=len(trues),
+                     num_nodes_list=[len(s.x) for s in splits[2]])
+    viz.plot_history(history)
+    viz.num_nodes_plot()
+    t_e, p_e = np.asarray(trues[0]), np.asarray(preds[0])
+    viz.create_scatter_plots(trues, preds,
+                             output_names=["energy", "forces"])
+    viz.create_plot_global_analysis("energy", t_e, p_e)
+    viz.create_parity_plot_and_error_histogram_scalar("energy", t_e, p_e)
+    # forces: per-sample [N*3] vectors -> component parity
+    t_f = np.asarray(trues[1]).reshape(len(trues[1]), -1)
+    p_f = np.asarray(preds[1]).reshape(len(preds[1]), -1)
+    viz.create_parity_plot_vector(t_f[:, :3], p_f[:, :3], name="force")
+    e_mae = float(np.mean(np.abs(t_e - p_e)))
+    f_mae = float(np.mean(np.abs(t_f - p_f)))
+    print(f"wrote plots under {viz.outdir}; "
+          f"energy_mae={e_mae:.4f} force_mae={f_mae:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,97 @@
+"""Download Alexandria ComputedStructureEntry JSON dumps into the layout
+alexandria_data.py reads (dataset/*.json).
+
+reference: examples/alexandria/find_json_files.py:9-47 — scrape the
+index pages https://alexandria.icams.rub.de/data/<functional> for
+.json.bz2 links (requests+BeautifulSoup there; stdlib HTMLParser here),
+wget each into dataset/compressed_data/<functional>. This adds the bz2
+inflation step the reference leaves to the user. `--from-file` ingests
+pre-fetched .json.bz2 / .json files on zero-egress hosts;
+`--to-graphstore` converts entries for out-of-core training.
+"""
+import argparse
+import bz2
+import os
+import shutil
+import sys
+import urllib.request
+from html.parser import HTMLParser
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+URL_ROOT = "https://alexandria.icams.rub.de/data"
+# the reference's index list (find_json_files.py:23)
+FUNCTIONALS = ["pascal", "pbe", "pbe_1d", "pbe_2d", "pbesol", "scan"]
+
+
+class _HrefCollector(HTMLParser):
+    def __init__(self):
+        super().__init__()
+        self.hrefs = []
+
+    def handle_starttag(self, tag, attrs):
+        if tag == "a":
+            for k, v in attrs:
+                if k == "href" and v and v.endswith(".bz2"):
+                    self.hrefs.append(v)
+
+
+def find_json_files(url: str):
+    """List .bz2 hrefs on an Alexandria index page (the reference's
+    find_json_files, stdlib-only)."""
+    with urllib.request.urlopen(url, timeout=60) as r:
+        html = r.read().decode("utf-8", errors="replace")
+    collector = _HrefCollector()
+    collector.feed(html)
+    return collector.hrefs
+
+
+def _inflate(src: str, dest_json: str) -> None:
+    with bz2.open(src, "rb") as f, open(dest_json, "wb") as out:
+        shutil.copyfileobj(f, out)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--datadir", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "dataset"))
+    p.add_argument("--functional", default="pbe", choices=FUNCTIONALS)
+    p.add_argument("--max-files", type=int, default=1,
+                   help="index files to fetch (the full corpus is large)")
+    p.add_argument("--from-file", nargs="*", default=None,
+                   help="pre-fetched .json.bz2 or .json dumps")
+    p.add_argument("--to-graphstore", action="store_true")
+    p.add_argument("--limit", type=int, default=1000,
+                   help="entry cap for --to-graphstore (0 = all)")
+    a = p.parse_args()
+
+    os.makedirs(a.datadir, exist_ok=True)
+    if a.from_file:
+        for src in a.from_file:
+            if src.endswith(".bz2"):
+                _inflate(src, os.path.join(
+                    a.datadir, os.path.basename(src)[:-4]))
+            else:
+                shutil.copy(src, a.datadir)
+    else:
+        from examples.dataset_utils import download
+        index = f"{URL_ROOT}/{a.functional}"
+        names = find_json_files(index)[: a.max_files]
+        if not names:
+            raise SystemExit(f"no .bz2 links found at {index}")
+        comp = os.path.join(a.datadir, "compressed_data", a.functional)
+        for name in names:
+            bz = download(f"{index}/{name}", os.path.join(comp, name))
+            _inflate(bz, os.path.join(a.datadir, name[:-4]))
+            print(name)
+    print(f"Alexandria JSON dumps ready under {a.datadir}")
+
+    if a.to_graphstore:
+        from examples.alexandria.alexandria_data import load_alexandria
+        from examples.dataset_utils import to_graphstore
+        samples = load_alexandria(a.datadir, limit=a.limit or 10 ** 9)
+        to_graphstore(samples, os.path.join(a.datadir, "graphstore"))
+
+
+if __name__ == "__main__":
+    main()

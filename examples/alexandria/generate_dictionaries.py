@@ -1,0 +1,79 @@
+"""Pure-element reference dictionaries for Alexandria formation-energy
+work.
+
+reference: examples/alexandria/generate_dictionaries_pure_elements.py —
+generate_dictionary_elements() (symbol <-> Z, :127-250) and
+generate_dictionary_bulk_energies() (per-element bulk reference
+energies, :1-124; the reference ships them zero-initialized for the
+user to fill). Here the element table reuses utils/elements.py instead
+of restating 118 literals, and the bulk energies can be FITTED from a
+downloaded corpus (least-squares per-element regression of total
+energy on composition — the standard atomization baseline) rather than
+left as zeros.
+"""
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+from hydragnn_tpu.utils.elements import SYMBOLS  # noqa: E402
+
+
+def generate_dictionary_elements():
+    """symbol -> atomic number (the reference's inverted dict)."""
+    return {s: z for z, s in enumerate(SYMBOLS) if z > 0}
+
+
+def generate_dictionary_bulk_energies(entries=None):
+    """Per-element reference energies {symbol: eV}.
+
+    With no entries: zero-initialized, like the reference. With a list of
+    Alexandria ComputedStructureEntry dicts: least-squares fit of
+    data.energy_total on composition counts."""
+    energies = {s: 0.0 for z, s in enumerate(SYMBOLS) if z > 0}
+    if not entries:
+        return energies
+    sym_to_col = {s: i for i, s in enumerate(sorted(energies))}
+    rows, ys = [], []
+    for e in entries:
+        counts = np.zeros(len(sym_to_col))
+        for site in e["structure"]["sites"]:
+            counts[sym_to_col[site["species"][0]["element"]]] += 1
+        rows.append(counts)
+        ys.append(float(e["data"]["energy_total"]))
+    coef, *_ = np.linalg.lstsq(np.asarray(rows), np.asarray(ys),
+                               rcond=None)
+    present = np.asarray(rows).sum(0) > 0
+    for s, i in sym_to_col.items():
+        if present[i]:
+            energies[s] = float(coef[i])
+    return energies
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--datadir", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "dataset"))
+    p.add_argument("--out", default=None,
+                   help="write dictionaries as JSON here")
+    a = p.parse_args()
+    import glob
+    entries = []
+    for path in sorted(glob.glob(os.path.join(a.datadir, "*.json"))):
+        with open(path) as f:
+            entries.extend(json.load(f).get("entries", []))
+    result = {"elements": generate_dictionary_elements(),
+              "bulk_energies": generate_dictionary_bulk_energies(entries)}
+    out = a.out or os.path.join(a.datadir, "dictionaries.json")
+    os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(f"wrote {out} ({len(entries)} entries fitted)")
+
+
+if __name__ == "__main__":
+    main()

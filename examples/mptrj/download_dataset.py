@@ -1,0 +1,47 @@
+"""Download the MPtrj full JSON into the layout mptrj_data.py reads
+(dataset/MPtrj_2022.9_full.json).
+
+reference: examples/mptrj/download_data_andes.sh:6-7 — wget of figshare
+file 41619375 renamed to MPtrj_2022.9_full.json (ORNL proxy exports
+dropped). `--from-file` ingests a pre-fetched copy on zero-egress hosts;
+`--to-graphstore` converts frames for out-of-core training.
+"""
+import argparse
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+MPTRJ_URL = "https://figshare.com/ndownloader/files/41619375"
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--datadir", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "dataset"))
+    p.add_argument("--from-file", default=None)
+    p.add_argument("--to-graphstore", action="store_true")
+    p.add_argument("--limit", type=int, default=1000,
+                   help="frame cap for --to-graphstore (0 = all)")
+    a = p.parse_args()
+
+    from examples.dataset_utils import download
+    from examples.mptrj.mptrj_data import FNAME
+    dest = os.path.join(a.datadir, FNAME)
+    os.makedirs(a.datadir, exist_ok=True)
+    if a.from_file:
+        shutil.copy(a.from_file, dest)
+    elif not os.path.exists(dest):
+        download(MPTRJ_URL, dest)
+    print(f"MPtrj ready at {dest}")
+
+    if a.to_graphstore:
+        from examples.dataset_utils import to_graphstore
+        from examples.mptrj.mptrj_data import load_mptrj
+        samples = load_mptrj(a.datadir, limit=a.limit or 10 ** 9)
+        to_graphstore(samples, os.path.join(a.datadir, "graphstore"))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+"""Element dictionary helper for MPtrj preprocessing.
+
+reference: examples/mptrj/utils/generate_dictionary.py:1-128 —
+generate_dictionary_elements() returns {symbol: Z} (a 118-entry literal
+there; reused from utils/elements.py here).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+from hydragnn_tpu.utils.elements import SYMBOLS  # noqa: E402
+
+
+def generate_dictionary_elements():
+    """symbol -> atomic number."""
+    return {s: z for z, s in enumerate(SYMBOLS) if z > 0}
+
+
+if __name__ == "__main__":
+    d = generate_dictionary_elements()
+    print(f"{len(d)} elements, H={d['H']} ... Og={d['Og']}")

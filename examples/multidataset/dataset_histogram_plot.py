@@ -1,0 +1,73 @@
+"""Per-member label histograms for the GFM dataset mix.
+
+reference: examples/multidataset/dataset_histogram_plot.py — reads each
+member's adios store and histograms energies/forces per member. Here the
+members come through train.py's loaders (real files when downloaded,
+synthetic otherwise) and every histogram degrades to .npz when
+matplotlib is unavailable.
+
+Usage:
+    python examples/multidataset/dataset_histogram_plot.py \
+        [--members ANI1x MPTrj ...] [--limit 200] [--outdir logs/gfm_hist]
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+from examples.multidataset.train import _KNOWN, _load_member  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--members", nargs="*", default=list(_KNOWN),
+                   choices=list(_KNOWN))
+    p.add_argument("--limit", type=int, default=200)
+    p.add_argument("--outdir", default=os.path.join("logs", "gfm_hist"))
+    args = p.parse_args()
+    here = os.path.dirname(os.path.abspath(__file__))
+    os.makedirs(args.outdir, exist_ok=True)
+
+    stats = {}
+    for name in args.members:
+        samples = _load_member(name, here, args.limit)
+        energies = np.asarray([float(s.y_graph[0]) for s in samples])
+        fnorms = np.concatenate(
+            [np.linalg.norm(s.y_node[:, :3], axis=1) for s in samples])
+        sizes = np.asarray([len(s.x) for s in samples])
+        stats[name] = {"energy": energies, "fnorm": fnorms,
+                       "nodes": sizes}
+        print(f"{name}: {len(samples)} graphs, "
+              f"E mean={energies.mean():.4f} std={energies.std():.4f}, "
+              f"|F| mean={fnorms.mean():.4f}, "
+              f"nodes mean={sizes.mean():.1f}")
+
+    base = os.path.join(args.outdir, "member_histograms")
+    np.savez(base + ".npz", **{f"{m}_{k}": v for m, d in stats.items()
+                               for k, v in d.items()})
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except Exception:
+        print(f"matplotlib unavailable; wrote {base}.npz only")
+        return
+    fig, axes = plt.subplots(1, 3, figsize=(15, 4.2))
+    for m, d in stats.items():
+        for ax, key in zip(axes, ("energy", "fnorm", "nodes")):
+            ax.hist(d[key], bins=50, alpha=0.5, label=m, density=True)
+    for ax, title in zip(axes, ("energy / atom", "|force|",
+                                "nodes per graph")):
+        ax.set_title(title)
+        ax.set_yscale("log")
+        ax.legend(fontsize=7)
+    fig.tight_layout()
+    fig.savefig(base + ".png", dpi=120)
+    print(f"wrote {base}.png / .npz")
+
+
+if __name__ == "__main__":
+    main()

@@ -30,20 +30,36 @@ sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
 _KNOWN = ("ANI1x", "MPTrj", "OC2020", "OC2022", "qm7x")
 
 
+def _member_dir(here: str, member: str, example: str, real_relpath: str):
+    """Pick the member's data dir: the multidataset-local one, unless the
+    member example's own dataset dir (where its download_dataset.py lands
+    real files) holds the real layout — so a downloaded corpus is used
+    with no extra flags."""
+    local = os.path.join(here, "dataset", member)
+    example_dir = os.path.join(os.path.dirname(here), example, "dataset")
+    import glob
+    if not glob.glob(os.path.join(local, real_relpath)) and \
+            glob.glob(os.path.join(example_dir, real_relpath)):
+        return example_dir
+    return local
+
+
 def _load_member(name: str, here: str, limit: int):
     if name == "ANI1x":
         from examples.ani1_x.ani1x_data import (generate_ani1x_dataset,
                                                 load_ani1x)
-        d = os.path.join(here, "dataset", "ani1x")
-        if not os.path.exists(os.path.join(d, "synthetic",
-                                           "ani1x-release.h5")):
+        d = _member_dir(here, "ani1x", "ani1_x", "ani1x-release.h5")
+        if not os.path.exists(os.path.join(d, "ani1x-release.h5")) and \
+                not os.path.exists(os.path.join(d, "synthetic",
+                                                "ani1x-release.h5")):
             generate_ani1x_dataset(d)
         return load_ani1x(d, limit=limit, max_neighbours=64)
     if name == "MPTrj":
         from examples.mptrj.mptrj_data import (FNAME, generate_mptrj_dataset,
                                                load_mptrj)
-        d = os.path.join(here, "dataset", "mptrj")
-        if not os.path.exists(os.path.join(d, "synthetic", FNAME)):
+        d = _member_dir(here, "mptrj", "mptrj", FNAME)
+        if not os.path.exists(os.path.join(d, FNAME)) and \
+                not os.path.exists(os.path.join(d, "synthetic", FNAME)):
             generate_mptrj_dataset(d)
         return load_mptrj(d, limit=limit, max_neighbours=64)
     if name == "OC2020":
@@ -51,22 +67,36 @@ def _load_member(name: str, here: str, limit: int):
             generate_oc20_dataset, load_oc20)
         import glob
         d = os.path.join(here, "dataset", "oc2020")
-        if not glob.glob(os.path.join(d, "synthetic", "*.extxyz")):
-            generate_oc20_dataset(d)
+        if not glob.glob(os.path.join(d, "*.extxyz")):
+            # a corpus downloaded by the OC20 example's own
+            # download_dataset.py (dataset/s2ef/<split>/train) wins over
+            # generating synthetic data here
+            dl = sorted(glob.glob(os.path.join(
+                os.path.dirname(here), "open_catalyst_2020", "dataset",
+                "s2ef", "*", "train")))
+            dl = [p for p in dl if glob.glob(os.path.join(p, "*.extxyz"))]
+            if dl:
+                d = dl[0]
+            elif not glob.glob(os.path.join(d, "synthetic", "*.extxyz")):
+                generate_oc20_dataset(d)
         return load_oc20(d, limit=limit, max_neighbours=64)
     if name == "OC2022":
         from examples.open_catalyst_2022.oc22_data import (
             TRAJ_SUBDIR, generate_oc22_dataset, load_oc22)
-        d = os.path.join(here, "dataset", "oc2022")
-        if not os.path.exists(os.path.join(d, "synthetic", TRAJ_SUBDIR,
-                                           "train_t.txt")):
+        d = _member_dir(here, "oc2022", "open_catalyst_2022",
+                        os.path.join(TRAJ_SUBDIR, "train_t.txt"))
+        if not os.path.exists(os.path.join(d, TRAJ_SUBDIR,
+                                           "train_t.txt")) and \
+                not os.path.exists(os.path.join(d, "synthetic", TRAJ_SUBDIR,
+                                                "train_t.txt")):
             generate_oc22_dataset(d)
         return load_oc22(d, limit=limit, max_neighbours=64)
     if name == "qm7x":
         from examples.qm7x.qm7x_data import generate_qm7x_dataset, load_qm7x
         import glob
-        d = os.path.join(here, "dataset", "qm7x")
-        if not glob.glob(os.path.join(d, "synthetic", "*.hdf5")):
+        d = _member_dir(here, "qm7x", "qm7x", "*.hdf5")
+        if not glob.glob(os.path.join(d, "*.hdf5")) and \
+                not glob.glob(os.path.join(d, "synthetic", "*.hdf5")):
             generate_qm7x_dataset(d)
         # remap to the common x=[Z,pos,forces] / energy / forces schema
         samples = load_qm7x(d, limit=limit)

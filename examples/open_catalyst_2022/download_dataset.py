@@ -1,0 +1,50 @@
+"""Download the OC22 trajectory corpus into the layout oc22_data.py reads
+(dataset/oc22_trajectories/trajectories/oc22/ + *_t.txt filelists).
+
+reference: examples/open_catalyst_2022/train.py:62-130 reads the
+oc22_trajectories tarball layout published by the Open Catalyst Project
+(dl.fbaipublicfiles.com). The real tarball holds ase .traj files — ase
+is not in this image, so convert to extxyz separately (oc22_data.py
+docstring); the ingest/extract/filelist plumbing is identical either
+way. `--from-file` ingests a pre-fetched tarball on zero-egress hosts;
+`--to-graphstore` converts frames for out-of-core training.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+OC22_URL = ("https://dl.fbaipublicfiles.com/opencatalystproject/data/oc22/"
+            "oc22_trajectories.tar.gz")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--datadir", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "dataset"))
+    p.add_argument("--from-file", default=None)
+    p.add_argument("--to-graphstore", action="store_true")
+    p.add_argument("--data_type", default="train",
+                   choices=["train", "val", "test"])
+    p.add_argument("--limit", type=int, default=1000,
+                   help="frame cap for --to-graphstore (0 = all)")
+    a = p.parse_args()
+
+    from examples.dataset_utils import extract, resolve_archive
+    os.makedirs(a.datadir, exist_ok=True)
+    archive = resolve_archive(OC22_URL, a.datadir, a.from_file)
+    extract(archive, a.datadir)
+    print(f"OC22 trajectories ready under {a.datadir}")
+
+    if a.to_graphstore:
+        from examples.dataset_utils import to_graphstore
+        from examples.open_catalyst_2022.oc22_data import load_oc22
+        samples = load_oc22(a.datadir, data_type=a.data_type,
+                            limit=a.limit or 10 ** 9)
+        to_graphstore(samples, os.path.join(a.datadir, "graphstore",
+                                            a.data_type))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Download the QM7-X set files into the layout qm7x_data.py reads
+(dataset/*.hdf5).
+
+reference: examples/qm7x/train.py documents the Zenodo record 4288677
+workflow (8 xz-compressed HDF5 set files, 1000.xz ... 8000.xz, inflated
+to 1000.hdf5 ...). `--from-file` ingests pre-fetched .xz (or .hdf5)
+files on zero-egress hosts; `--to-graphstore` converts conformations for
+out-of-core training.
+"""
+import argparse
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+QM7X_URL = "https://zenodo.org/record/4288677/files/{name}.xz"
+SETS = ["1000", "2000", "3000", "4000", "5000", "6000", "7000", "8000"]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--datadir", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "dataset"))
+    p.add_argument("--sets", nargs="*", default=SETS, choices=SETS,
+                   help="which set files to fetch (default: all 8)")
+    p.add_argument("--from-file", nargs="*", default=None,
+                   help="pre-fetched .xz or .hdf5 set files")
+    p.add_argument("--to-graphstore", action="store_true")
+    p.add_argument("--limit", type=int, default=1000,
+                   help="conformation cap for --to-graphstore (0 = all)")
+    a = p.parse_args()
+
+    from examples.dataset_utils import download, extract
+
+    def _ensure_hdf5_suffix(bare: str) -> None:
+        # lzma inflation drops only the .xz suffix (1000.xz -> 1000);
+        # the loader globs *.hdf5
+        if os.path.exists(bare) and not bare.endswith(".hdf5"):
+            os.replace(bare, bare + ".hdf5")
+
+    os.makedirs(a.datadir, exist_ok=True)
+    if a.from_file:
+        for src in a.from_file:
+            if src.endswith(".xz"):
+                extract(src, a.datadir)
+                _ensure_hdf5_suffix(os.path.join(
+                    a.datadir, os.path.basename(src)[:-3]))
+            else:
+                shutil.copy(src, a.datadir)
+    else:
+        for name in a.sets:
+            xz = os.path.join(a.datadir, f"{name}.xz")
+            if not os.path.exists(os.path.join(a.datadir,
+                                               f"{name}.hdf5")):
+                download(QM7X_URL.format(name=name), xz)
+                extract(xz, a.datadir)
+                _ensure_hdf5_suffix(os.path.join(a.datadir, name))
+                os.remove(xz)
+    print(f"QM7-X set files ready under {a.datadir}")
+
+    if a.to_graphstore:
+        from examples.dataset_utils import to_graphstore
+        from examples.qm7x.qm7x_data import load_qm7x
+        samples = load_qm7x(a.datadir, limit=a.limit or 10 ** 9)
+        to_graphstore(samples, os.path.join(a.datadir, "graphstore"))
+
+
+if __name__ == "__main__":
+    main()

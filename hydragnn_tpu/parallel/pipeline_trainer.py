@@ -19,9 +19,16 @@ Layer params/apply reuse the zoo conv modules (models/convs.py) — the
 pipelined math IS the sequential math, asserted by
 tests/test_pipeline_config.py.
 
-Scope (documented limits): conv kinds below, no batch-norm between convs
-(GPipe microbatching and running stats don't compose), graph/node MLP
-heads. Eval/prediction run the sequential forward.
+Scope (documented limits): conv kinds below (incl. the flagship PNA),
+graph/node MLP heads, Architecture.dtype mixed precision (bf16 compute,
+f32 masters — the main path's policy). Eval/prediction run the sequential
+forward.
+
+ARCHITECTURAL DIVERGENCE (surfaced loudly at config time by
+run_training): the pipelined stack normalizes with LayerNorm, not
+BaseStack's MaskedBatchNorm — running statistics don't compose with GPipe
+microbatching — so `pipeline_stages: 4` trains a DIFFERENT (LayerNorm)
+model than `pipeline_stages: 1` of the same config, on purpose.
 """
 from __future__ import annotations
 
@@ -36,16 +43,24 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config.config import ModelConfig
 from ..graphs.batch import GraphBatch
-from ..models.convs import GINConv, SAGEConv
+from ..models.convs import GINConv, PNAConv, SAGEConv
 from ..models.layers import MLP
 from ..ops.activations import activation_function_selection
 from ..ops.segment import global_mean_pool
 from ..train.loss import multihead_loss
-from ..train.train_step import TrainState
+from ..train.train_step import (TrainState, _cast_floats,
+                                _resolve_compute_dtype)
 from .pipeline import make_pipeline_apply, stack_stage_params
 
-PIPELINE_CONV_TYPES = {"GIN": lambda hidden: GINConv(out_dim=hidden),
-                       "SAGE": lambda hidden: SAGEConv(out_dim=hidden)}
+# factories take (hidden, cfg): PNA needs the degree histogram. PNAPlus
+# is excluded — its per-conv Bessel radial embedding rides conv_args,
+# which the homogeneous pipelined block does not thread.
+PIPELINE_CONV_TYPES = {
+    "GIN": lambda hidden, cfg: GINConv(out_dim=hidden),
+    "SAGE": lambda hidden, cfg: SAGEConv(out_dim=hidden),
+    "PNA": lambda hidden, cfg: PNAConv(out_dim=hidden,
+                                       deg_hist=cfg.pna_deg),
+}
 
 
 class _ConvBlock(nn.Module):
@@ -85,7 +100,7 @@ def init_pipeline_params(rng, cfg: ModelConfig, sample_batch: GraphBatch):
     p_embed = embed.init(k_embed, sample_batch.x)["params"]
     x_h = jnp.zeros(sample_batch.x.shape[:-1] + (hidden,), jnp.float32)
 
-    block = _ConvBlock(conv=conv_fn(hidden), activation=cfg.activation)
+    block = _ConvBlock(conv=conv_fn(hidden, cfg), activation=cfg.activation)
     per_layer = []
     for i in range(cfg.num_conv_layers):
         ki = jax.random.fold_in(k_conv, i)
@@ -119,16 +134,23 @@ def _decode(params, cfg: ModelConfig, x, batch: GraphBatch, act):
 
 
 def make_pipeline_forward(cfg: ModelConfig, mesh: Mesh, num_stages: int,
-                          pipelined: bool = True):
-    """forward(params, stacked_batch [M, ...]) -> per-microbatch outputs.
+                          pipelined: bool = True,
+                          compute_dtype=None):
+    """forward(params, stacked_batch [M, ...]) -> per-microbatch outputs
+    (f32, whatever the compute dtype).
 
     ``pipelined=False`` runs the identical math as a sequential scan over
-    the stacked conv params — the eval path and the equivalence oracle."""
+    the stacked conv params — the eval path and the equivalence oracle.
+    ``compute_dtype`` follows the main path's mixed-precision policy
+    (train_step._resolve_compute_dtype): params/batch floats cast to the
+    compute dtype, outputs accumulated back in f32."""
     conv_fn = PIPELINE_CONV_TYPES[cfg.model_type]
     hidden = cfg.hidden_dim
     act = activation_function_selection(cfg.activation)
-    block = _ConvBlock(conv=conv_fn(hidden), activation=cfg.activation)
+    block = _ConvBlock(conv=conv_fn(hidden, cfg), activation=cfg.activation)
     embed = _embed(hidden)
+    cdtype = _resolve_compute_dtype(cfg, compute_dtype)
+    mixed = cdtype != jnp.float32
 
     def layer_fn(layer_params, h, batch_t: GraphBatch):
         return block.apply({"params": layer_params}, h, batch_t)
@@ -139,6 +161,9 @@ def make_pipeline_forward(cfg: ModelConfig, mesh: Mesh, num_stages: int,
                                          cfg.num_conv_layers, axis="pipe")
 
     def forward(params, stacked: GraphBatch):
+        if mixed:
+            params = _cast_floats(params, cdtype)
+            stacked = _cast_floats(stacked, cdtype)
         x = jax.vmap(lambda xb: embed.apply({"params": params["embed"]}, xb)
                      )(stacked.x)
         if pipelined:
@@ -156,6 +181,9 @@ def make_pipeline_forward(cfg: ModelConfig, mesh: Mesh, num_stages: int,
             x, _ = jax.lax.scan(scan_layer, x, params["convs"])
         outs = jax.vmap(lambda xm, bm: _decode(params, cfg, xm, bm, act)
                         )(x, stacked)
+        if mixed:  # losses/metrics accumulate in f32
+            outs = jax.tree_util.tree_map(
+                lambda o: o.astype(jnp.float32), outs)
         return outs
 
     return forward
@@ -255,7 +283,3 @@ def validate_pipeline_config(cfg: ModelConfig, num_stages: int,
     if getattr(cfg, "freeze_conv", False):
         raise ValueError(
             "pipeline_stages does not support freeze_conv_layers yet")
-    if getattr(cfg, "dtype", None) not in (None, "float32"):
-        raise ValueError(
-            "pipeline_stages does not support Architecture.dtype mixed "
-            "precision yet (runs float32)")

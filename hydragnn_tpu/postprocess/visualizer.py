@@ -2,10 +2,14 @@
 
 reference: hydragnn/postprocess/visualizer.py:24-742 (Visualizer class:
 create_scatter_plots :692, create_plot_global :722, plot_history :629,
-create_parity_plot_vector :467, error histograms :281,387, num_nodes_plot
-:734). Matplotlib is optional in this image; all methods degrade to writing
+create_plot_global_analysis :134, parity+error-histogram scalar :281,
+error histogram per node :387, create_parity_plot_vector :467,
+per-node vector parity :519, add_identity :614, num_nodes_plot :734).
+Matplotlib is optional in this image; all methods degrade to writing
 the underlying data as .npz next to where the plot would go, so the
-artifacts exist either way.
+artifacts exist either way. Per-node panels are vectorized numpy over
+[num_samples, num_nodes] arrays rather than the reference's per-sample
+Python loops.
 """
 from __future__ import annotations
 
@@ -171,6 +175,183 @@ class Visualizer:
         fig.tight_layout()
         fig.savefig(base + ".png", dpi=120)
         plt.close(fig)
+
+    # -- per-variable analysis (fixed-node-count corpora, LSMS-style) -----
+    def create_plot_global_analysis(self, varname: str, true, pred):
+        """Scalar variable: parity scatter + conditional mean |error| +
+        error PDF in one row; per-node variable: vector-length and
+        node-sum parity + error PDF (reference:
+        create_plot_global_analysis :134-281)."""
+        t = np.asarray(true).reshape(len(true), -1)
+        p = np.asarray(pred).reshape(len(pred), -1)
+        base = os.path.join(self.outdir, f"global_analysis_{varname}")
+        np.savez(base + ".npz", true=t, pred=p)
+        plt = _plt()
+        if plt is None:
+            return
+        if t.shape[1] > 1:
+            # per-node variable: compare magnitudes and per-sample sums
+            t_plot = [np.linalg.norm(t, axis=1), t.sum(1)]
+            p_plot = [np.linalg.norm(p, axis=1), p.sum(1)]
+            titles = [f"{varname} |vec|", f"{varname} sum"]
+        else:
+            t_plot, p_plot = [t.ravel()], [p.ravel()]
+            titles = [varname]
+        n = len(t_plot) + 2
+        fig, axs = plt.subplots(1, n, figsize=(4.2 * n, 4))
+        for ax, tt, pp, title in zip(axs, t_plot, p_plot, titles):
+            self._scatter(ax, tt, pp, title)
+        centers, condmean = _err_condmean(t_plot[0], p_plot[0])
+        axs[-2].plot(centers, condmean, "ro")
+        axs[-2].set_title("conditional mean |error|")
+        axs[-2].set_xlabel("true")
+        self._error_pdf(axs[-1], t_plot[0], p_plot[0],
+                        f"{varname}: error PDF")
+        fig.tight_layout()
+        fig.savefig(base + ".png", dpi=120)
+        plt.close(fig)
+
+    def create_parity_plot_and_error_histogram_scalar(
+            self, varname: str, true, pred, iepoch: Optional[int] = None):
+        """Scalar: parity + error PDF; per-node [S, N]: one parity panel
+        per node colored by its feature, plus SUM and per-site-mean
+        panels (reference: :281-387)."""
+        t = np.asarray(true).reshape(len(true), -1)
+        p = np.asarray(pred).reshape(len(pred), -1)
+        suffix = "" if iepoch is None else f"_{iepoch:04d}"
+        base = os.path.join(self.outdir, f"parity_scalar_{varname}{suffix}")
+        np.savez(base + ".npz", true=t, pred=p)
+        plt = _plt()
+        if plt is None:
+            return
+        if t.shape[1] == 1:
+            fig, axs = plt.subplots(1, 2, figsize=(10, 4.5))
+            self._scatter(axs[0], t.ravel(), p.ravel(), varname)
+            self._error_pdf(axs[1], t.ravel(), p.ravel(),
+                            f"{varname}: error PDF")
+        else:
+            fig, axs = self._node_grid(plt, t.shape[1])
+            feat = self._node_feature_matrix(t.shape)
+            for inode in range(t.shape[1]):
+                self._scatter(axs[inode], t[:, inode], p[:, inode],
+                              f"node:{inode}",
+                              c=None if feat is None else feat[:, inode])
+            self._scatter(axs[t.shape[1]], t.sum(1), p.sum(1), "SUM")
+            self._scatter(axs[t.shape[1] + 1], t.mean(0), p.mean(0),
+                          f"SMP_Mean4sites:0-{t.shape[1]}")
+        fig.tight_layout()
+        fig.savefig(base + ".png", dpi=120)
+        plt.close(fig)
+
+    def create_error_histogram_per_node(self, varname: str, true, pred,
+                                        iepoch: Optional[int] = None):
+        """Per-node error PDFs plus SUM / per-site-mean panels
+        (reference: create_error_histogram_per_node :387-467). No-op for
+        scalar heads, like the reference."""
+        t = np.asarray(true).reshape(len(true), -1)
+        p = np.asarray(pred).reshape(len(pred), -1)
+        if t.shape[1] == 1:
+            return
+        suffix = "" if iepoch is None else f"_{iepoch:04d}"
+        base = os.path.join(self.outdir,
+                            f"error_hist1d_{varname}{suffix}")
+        np.savez(base + ".npz", true=t, pred=p)
+        plt = _plt()
+        if plt is None:
+            return
+        fig, axs = self._node_grid(plt, t.shape[1])
+        for inode in range(t.shape[1]):
+            self._error_pdf(axs[inode], t[:, inode], p[:, inode],
+                            f"node:{inode}")
+        self._error_pdf(axs[t.shape[1]], t.sum(1), p.sum(1), "SUM")
+        self._error_pdf(axs[t.shape[1] + 1], t.mean(0), p.mean(0),
+                        f"SMP_Mean4sites:0-{t.shape[1]}")
+        fig.tight_layout()
+        fig.savefig(base + ".png", dpi=120)
+        plt.close(fig)
+
+    def create_parity_plot_per_node_vector(self, varname: str, true, pred,
+                                           iepoch: Optional[int] = None):
+        """Per-node parity for a 3-vector node variable [S, N*3]: one
+        panel per node with a marker per component, plus a node-sum panel
+        (reference: create_parity_plot_per_node_vector :519-614)."""
+        t = np.asarray(true).reshape(len(true), -1, 3)
+        p = np.asarray(pred).reshape(len(pred), -1, 3)
+        num_nodes = t.shape[1]
+        suffix = "" if iepoch is None else f"_{iepoch:04d}"
+        base = os.path.join(self.outdir,
+                            f"parity_pernode_vec_{varname}{suffix}")
+        np.savez(base + ".npz", true=t, pred=p)
+        plt = _plt()
+        if plt is None:
+            return
+        markers = ["o", "s", "d"]
+        fig, axs = self._node_grid(plt, num_nodes, extra=1)  # SUM only
+        feat = self._node_feature_matrix((t.shape[0], num_nodes))
+        for inode in range(num_nodes):
+            for icomp in range(3):
+                self._scatter(
+                    axs[inode], t[:, inode, icomp], p[:, inode, icomp],
+                    f"node:{inode}", marker=markers[icomp],
+                    c=None if feat is None else feat[:, inode])
+        for icomp in range(3):
+            self._scatter(axs[num_nodes], t[:, :, icomp].sum(1),
+                          p[:, :, icomp].sum(1), "SUM",
+                          marker=markers[icomp], s=24)
+        fig.tight_layout()
+        fig.savefig(base + ".png", dpi=120)
+        plt.close(fig)
+
+    # -- shared panel helpers ---------------------------------------------
+    @staticmethod
+    def add_identity(ax, **line_kwargs):
+        """y=x guide spanning the current data limits
+        (reference: add_identity :614-628)."""
+        lo = min(ax.get_xlim()[0], ax.get_ylim()[0])
+        hi = max(ax.get_xlim()[1], ax.get_ylim()[1])
+        line_kwargs.setdefault("lw", 1)
+        ax.plot([lo, hi], [lo, hi], "k--", **line_kwargs)
+
+    def _scatter(self, ax, t, p, title, c=None, marker="o", s=6):
+        ax.scatter(np.asarray(t), np.asarray(p), s=s, alpha=0.6, c=c,
+                   marker=marker)
+        self.add_identity(ax)
+        ax.set_title(title)
+        ax.set_xlabel("true")
+        ax.set_ylabel("predicted")
+
+    @staticmethod
+    def _error_pdf(ax, t, p, title, bins: int = 40):
+        hist, edges = np.histogram(np.asarray(p) - np.asarray(t),
+                                   bins=bins, density=True)
+        ax.plot(0.5 * (edges[:-1] + edges[1:]), hist, "ro")
+        ax.set_title(title)
+        ax.set_xlabel("error")
+        ax.set_ylabel("PDF")
+
+    @staticmethod
+    def _node_grid(plt, num_nodes: int, extra: int = 2):
+        """Square-ish grid with `extra` summary panels (SUM, per-site
+        mean); surplus axes switched off."""
+        import math
+        nrow = int(math.floor(math.sqrt(num_nodes + extra)))
+        ncol = int(math.ceil((num_nodes + extra) / nrow))
+        fig, axs = plt.subplots(nrow, ncol,
+                                figsize=(ncol * 3.2, nrow * 3.0),
+                                squeeze=False)
+        axs = axs.flatten()
+        for ax in axs[num_nodes + extra:]:
+            ax.axis("off")
+        return fig, axs
+
+    def _node_feature_matrix(self, shape):
+        """node_feature as an [S, N] color matrix when it matches."""
+        if self.node_feature is None:
+            return None
+        feat = np.asarray(self.node_feature)
+        if feat.ndim >= 2 and feat.shape[:2] == tuple(shape[:2]):
+            return feat.reshape(shape[0], shape[1], -1)[:, :, 0]
+        return None
 
     # -- history ----------------------------------------------------------
     def plot_history(self, history: Dict[str, List[float]]):

@@ -47,7 +47,25 @@ def _load_datasets_from_config(config):
         return load_lsms_splits(config)
     if fmt == "adios":
         from .datasets.gsdataset import GraphStoreDataset
-        return tuple(GraphStoreDataset(ds["path"][k])
+        # multi-host data sharding (tools/tpu_pod_launch.py): when
+        # HYDRAGNN_GS_SHARD_DIR names this process's shard directory, its
+        # split subdirs override the config paths — each host streams only
+        # its own bytes; splits absent from the shard (typically
+        # validate/test, replicated) still come from the config.
+        # HYDRAGNN_GS_SHARD_ROOT is the same, resolved per process — the
+        # gcloud --worker=all launch runs ONE identical command on every
+        # worker, so the shard index must come from the runtime.
+        shard = os.environ.get("HYDRAGNN_GS_SHARD_DIR")
+        root = os.environ.get("HYDRAGNN_GS_SHARD_ROOT")
+        if not shard and root:
+            shard = os.path.join(root,
+                                 f"shard_{jax.process_index()}")
+
+        def _split_path(k):
+            if shard and os.path.isdir(os.path.join(shard, k)):
+                return os.path.join(shard, k)
+            return ds["path"][k]
+        return tuple(GraphStoreDataset(_split_path(k))
                      for k in ("train", "validate", "test"))
     if fmt == "XYZ":
         from .datasets.xyzdataset import load_xyz_splits
@@ -130,6 +148,11 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         from .parallel.pipeline_trainer import validate_pipeline_config
         validate_pipeline_config(mcfg, pipeline_stages, batch_size,
                                  microbatches)
+        log("NOTICE: pipeline_stages > 1 trains the pipelined stack "
+            "(conv + LayerNorm blocks) — NOT the same architecture as "
+            "pipeline_stages=1 (MaskedBatchNorm): running stats do not "
+            "compose with GPipe microbatching. Checkpoints are not "
+            "interchangeable between the two.")
         num_shards = microbatches  # loader stacking = microbatch axis
     else:
         num_shards = resolve_num_shards(

@@ -55,8 +55,11 @@ class _Encoder:
                 x.append((params[k] - lo) / max(hi - lo, 1))
             elif kind == "float":
                 lo, hi = spec
-                x.append((math.log10(params[k]) - math.log10(lo))
-                         / max(math.log10(hi) - math.log10(lo), 1e-12))
+                if lo > 0:  # log scale for positive ranges (lr-like)
+                    x.append((math.log10(params[k]) - math.log10(lo))
+                             / max(math.log10(hi) - math.log10(lo), 1e-12))
+                else:  # linear for ranges touching 0 or negative
+                    x.append((params[k] - lo) / max(hi - lo, 1e-12))
         return np.asarray(x, np.float64)
 
     def sample(self, rng: np.random.RandomState) -> Dict[str, Any]:
@@ -67,8 +70,11 @@ class _Encoder:
             elif kind == "int":
                 out[k] = int(rng.randint(spec[0], spec[1] + 1))
             elif kind == "float":
-                out[k] = float(10 ** rng.uniform(math.log10(spec[0]),
-                                                 math.log10(spec[1])))
+                if spec[0] > 0:
+                    out[k] = float(10 ** rng.uniform(math.log10(spec[0]),
+                                                     math.log10(spec[1])))
+                else:
+                    out[k] = float(rng.uniform(spec[0], spec[1]))
             else:
                 out[k] = spec
         return out

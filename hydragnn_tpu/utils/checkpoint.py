@@ -89,21 +89,37 @@ def _spawn_latest_writer() -> None:
             return
 
         def _run():
-            while True:
+            # normal exits clear the slot ATOMICALLY with the pending
+            # check (a lock-gap between them would let a save enqueued
+            # in the gap see a registered-but-exiting writer and skip
+            # spawning). The except block covers only the abnormal path
+            # — e.g. wait_until_finished() raising — where the slot
+            # would otherwise stay registered forever and every later
+            # async save would silently skip spawning; the identity
+            # guard keeps it from clearing a successor's registration.
+            # pending_latest is left for wait_for_checkpoints to write.
+            try:
+                while True:
+                    with _ASYNC_LOCK:
+                        target = _ASYNC_STATE.get("pending_latest")
+                        if target is None:
+                            _ASYNC_STATE["latest_thread"] = None
+                            return
+                    _ASYNC_STATE["ckptr"].wait_until_finished()
+                    if os.path.isdir(target):
+                        _write_latest(target)
+                    with _ASYNC_LOCK:
+                        if _ASYNC_STATE.get("pending_latest") == target:
+                            _ASYNC_STATE["pending_latest"] = None
+                            _ASYNC_STATE["latest_thread"] = None
+                            return
+                        # a newer save was enqueued while we wrote: loop
+            except BaseException:
                 with _ASYNC_LOCK:
-                    target = _ASYNC_STATE.get("pending_latest")
-                    if target is None:
+                    if _ASYNC_STATE.get("latest_thread") is \
+                            threading.current_thread():
                         _ASYNC_STATE["latest_thread"] = None
-                        return
-                _ASYNC_STATE["ckptr"].wait_until_finished()
-                if os.path.isdir(target):
-                    _write_latest(target)
-                with _ASYNC_LOCK:
-                    if _ASYNC_STATE.get("pending_latest") == target:
-                        _ASYNC_STATE["pending_latest"] = None
-                        _ASYNC_STATE["latest_thread"] = None
-                        return
-                    # a newer save was enqueued while we wrote: loop
+                raise
 
         t = threading.Thread(target=_run, daemon=True)
         _ASYNC_STATE["latest_thread"] = t

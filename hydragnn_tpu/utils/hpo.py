@@ -204,14 +204,17 @@ def orchestrate(script: str, space: Dict[str, Any], num_trials: int = 20,
     trials_path = os.path.join(log_dir, "trials.jsonl")
     opt = CBO(space, seed=seed, maximize=maximize)
     history: List[Dict] = []
+    worst = -np.inf if maximize else np.inf
     if os.path.exists(trials_path):  # resume a prior loop
         with open(trials_path) as f:
             for line in f:
                 rec = json.loads(line)
-                opt.tell(rec["params"], rec["value"])
+                # failed trials persist as value=null (strict JSON);
+                # tell() maps the non-finite stand-in to worst-finite
+                val = rec["value"] if rec.get("value") is not None else worst
+                opt.tell(rec["params"], val)
                 history.append(rec)
 
-    worst = -np.inf if maximize else np.inf
     running: List[Tuple[subprocess.Popen, Dict, float, Any, int]] = []
     launched = len(history)
     pattern = re.compile(objective_pattern)
@@ -245,15 +248,18 @@ def orchestrate(script: str, space: Dict[str, Any], num_trials: int = 20,
         while running:
             for i, (proc, params, t0, out, slot) in enumerate(running):
                 rc = proc.poll()
-                timed_out = time.time() - t0 > timeout_s
-                if rc is None and timed_out:
+                timed_out = False
+                if rc is None and time.time() - t0 > timeout_s:
                     import signal
+                    timed_out = True
                     try:
                         os.killpg(proc.pid, signal.SIGKILL)
                     except (ProcessLookupError, PermissionError):
                         proc.kill()
-                    proc.wait()  # no zombie; log fully flushed before read
-                    rc = -9
+                    # real wait() status (not a hardcoded -9): diagnostics
+                    # can tell a SIGKILLed group from one that beat the
+                    # kill to a clean exit
+                    rc = proc.wait()
                 if rc is not None:
                     out.close()
                     val = worst
@@ -268,8 +274,13 @@ def orchestrate(script: str, space: Dict[str, Any], num_trials: int = 20,
                     # tell() maps non-finite scores to worst-finite so a
                     # failed trial can't poison the GP surrogate
                     opt.tell(params, val)
-                    rec = {"params": params, "value": val, "rc": rc,
-                           "log": logf}
+                    # strict JSON: a failed trial records null + failed
+                    # (json.dumps would emit bare Infinity otherwise,
+                    # breaking jq/strict parsers on trials.jsonl)
+                    rec = {"params": params,
+                           "value": val if np.isfinite(val) else None,
+                           "failed": not np.isfinite(val),
+                           "timed_out": timed_out, "rc": rc, "log": logf}
                     history.append(rec)
                     with open(trials_path, "a") as f:
                         f.write(json.dumps(rec, default=str) + "\n")

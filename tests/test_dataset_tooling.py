@@ -100,3 +100,142 @@ def test_to_graphstore_counts(tmp_path):
     n = to_graphstore(iter(samples), str(tmp_path / "gs"),
                       log=lambda s: None)
     assert n == 6
+
+
+def _run_downloader(example, args, tmp_path):
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples", example, "download_dataset.py"),
+         *args], capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    return r
+
+
+def _graphstore_samples(path):
+    from hydragnn_tpu.datasets.gsdataset import GraphStoreDataset
+    return list(GraphStoreDataset(path))
+
+
+def test_ani1x_download_pipeline_from_file(tmp_path):
+    """ani1_x --from-file: HDF5 in the release schema -> GraphStore."""
+    from examples.ani1_x.ani1x_data import generate_ani1x_dataset
+    fix = tmp_path / "fix"
+    fix.mkdir()
+    generate_ani1x_dataset(str(fix), num_formulas=3, frames_per_formula=2)
+    datadir = str(tmp_path / "ds")
+    _run_downloader("ani1_x",
+                    ["--datadir", datadir, "--from-file",
+                     str(fix / "synthetic" / "ani1x-release.h5"),
+                     "--to-graphstore", "--limit", "6"], tmp_path)
+    samples = _graphstore_samples(os.path.join(datadir, "graphstore"))
+    assert len(samples) == 6
+    assert samples[0].forces is not None
+
+
+def test_mptrj_download_pipeline_from_file(tmp_path):
+    """mptrj --from-file: nested MPtrj JSON -> GraphStore."""
+    from examples.mptrj.mptrj_data import FNAME, generate_mptrj_dataset
+    fix = tmp_path / "fix"
+    fix.mkdir()
+    generate_mptrj_dataset(str(fix), num_structures=5)
+    datadir = str(tmp_path / "ds")
+    _run_downloader("mptrj",
+                    ["--datadir", datadir, "--from-file",
+                     str(fix / "synthetic" / FNAME), "--to-graphstore",
+                     "--limit", "5"], tmp_path)
+    samples = _graphstore_samples(os.path.join(datadir, "graphstore"))
+    assert len(samples) == 5
+    assert samples[0].forces is not None
+
+
+def test_qm7x_download_pipeline_from_file(tmp_path):
+    """qm7x --from-file: xz-compressed set file -> *.hdf5 -> GraphStore."""
+    from examples.qm7x.qm7x_data import generate_qm7x_dataset
+    fix = tmp_path / "fix"
+    fix.mkdir()
+    generate_qm7x_dataset(str(fix), num_mols=4, confs_per_mol=2)
+    synth = fix / "synthetic"
+    h5s = [p for p in os.listdir(synth) if p.endswith(".hdf5")]
+    assert h5s
+    xz = str(tmp_path / "1000.xz")
+    with open(synth / h5s[0], "rb") as f_in, lzma.open(xz, "wb") as f_out:
+        f_out.write(f_in.read())
+    datadir = str(tmp_path / "ds")
+    _run_downloader("qm7x",
+                    ["--datadir", datadir, "--from-file", xz,
+                     "--to-graphstore", "--limit", "8"], tmp_path)
+    assert os.path.exists(os.path.join(datadir, "1000.hdf5"))
+    samples = _graphstore_samples(os.path.join(datadir, "graphstore"))
+    assert len(samples) == 8
+
+
+def test_oc22_download_pipeline_from_file(tmp_path):
+    """oc22 --from-file: trajectories tarball -> filelist layout ->
+    GraphStore."""
+    from examples.open_catalyst_2022.oc22_data import (TRAJ_SUBDIR,
+                                                       generate_oc22_dataset)
+    fix = tmp_path / "fix"
+    fix.mkdir()
+    generate_oc22_dataset(str(fix), data_type="train", num_systems=2,
+                          frames_per_system=2)
+    tar_path = str(tmp_path / "oc22_trajectories.tar.gz")
+    with tarfile.open(tar_path, "w:gz") as t:
+        t.add(str(fix / "synthetic" / "oc22_trajectories"),
+              arcname="oc22_trajectories")
+    datadir = str(tmp_path / "ds")
+    _run_downloader("open_catalyst_2022",
+                    ["--datadir", datadir, "--from-file", tar_path,
+                     "--to-graphstore", "--limit", "4"], tmp_path)
+    assert os.path.isdir(os.path.join(datadir, TRAJ_SUBDIR))
+    samples = _graphstore_samples(
+        os.path.join(datadir, "graphstore", "train"))
+    assert len(samples) == 4
+    assert samples[0].forces is not None
+
+
+def test_alexandria_download_pipeline_from_file(tmp_path):
+    """alexandria --from-file: .json.bz2 entry dump -> GraphStore."""
+    import bz2 as _bz2
+    from examples.alexandria.alexandria_data import generate_alexandria_dataset
+    fix = tmp_path / "fix"
+    fix.mkdir()
+    generate_alexandria_dataset(str(fix), num_entries=6)
+    synth = fix / "synthetic"
+    js = [p for p in os.listdir(synth) if p.endswith(".json")]
+    assert js
+    bz = str(tmp_path / (js[0] + ".bz2"))
+    with open(synth / js[0], "rb") as f_in, _bz2.open(bz, "wb") as f_out:
+        f_out.write(f_in.read())
+    datadir = str(tmp_path / "ds")
+    _run_downloader("alexandria",
+                    ["--datadir", datadir, "--from-file", bz,
+                     "--to-graphstore", "--limit", "6"], tmp_path)
+    samples = _graphstore_samples(os.path.join(datadir, "graphstore"))
+    assert len(samples) == 6
+    assert samples[0].forces is not None
+
+
+def test_alexandria_generate_dictionaries(tmp_path):
+    """The bulk-energy fit recovers per-element reference energies."""
+    from examples.alexandria.generate_dictionaries import (
+        generate_dictionary_bulk_energies, generate_dictionary_elements)
+    elements = generate_dictionary_elements()
+    assert elements["H"] == 1 and elements["Og"] == 118
+    # 3 fake entries over Cu/O with known per-element energies
+    ref = {"Cu": -3.5, "O": -4.25}
+
+    def entry(counts):
+        sites = []
+        for sym, k in counts.items():
+            sites += [{"species": [{"element": sym}], "xyz": [0, 0, 0],
+                       "properties": {"forces": [0, 0, 0]}}] * k
+        total = sum(ref[s] * k for s, k in counts.items())
+        return {"structure": {"lattice": {"matrix": np.eye(3).tolist()},
+                              "sites": sites},
+                "data": {"energy_total": total, "mat_id": "x"}}
+
+    entries = [entry({"Cu": 2}), entry({"O": 3}), entry({"Cu": 1, "O": 1})]
+    fit = generate_dictionary_bulk_energies(entries)
+    assert abs(fit["Cu"] - ref["Cu"]) < 1e-6
+    assert abs(fit["O"] - ref["O"]) < 1e-6
+    assert fit["H"] == 0.0

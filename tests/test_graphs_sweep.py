@@ -119,20 +119,41 @@ def _train_and_check(model_type, ci_input, use_lengths=False):
     trues, preds = run_prediction(completed, datasets=splits, state=state,
                                   model=model)
     rmse_t, mae_t = _thresholds(model_type, ci_input, use_lengths)
+    heads = []
     total_se, total_n = 0.0, 0
-    for ih, (ht, hp) in enumerate(zip(trues, preds)):
+    for ht, hp in zip(trues, preds):
         ht, hp = np.asarray(ht), np.asarray(hp)
-        head_rmse = float(np.sqrt(np.mean((ht - hp) ** 2)))
-        head_mae = float(np.mean(np.abs(ht - hp)))
+        heads.append((float(np.sqrt(np.mean((ht - hp) ** 2))),
+                      float(np.mean(np.abs(ht - hp)))))
+        total_se += float(np.sum((ht - hp) ** 2))
+        total_n += ht.size
+    total_rmse = float(np.sqrt(total_se / max(total_n, 1)))
+
+    # metrics are recorded BEFORE the asserts so a failing case still
+    # lands in the battery report (SWEEP_REPORT -> tools/run_sweep_battery)
+    report = os.environ.get("SWEEP_REPORT")
+    if report:
+        rec = {"model": model_type, "config": ci_input,
+               "use_lengths": use_lengths,
+               "budget": {"num_configs": num_configs,
+                          "num_epoch": train_cfg["num_epoch"]},
+               "threshold": {"rmse": rmse_t, "mae": mae_t},
+               "heads": [{"rmse": round(r, 4), "mae": round(m, 4)}
+                         for r, m in heads],
+               "total_rmse": round(total_rmse, 4),
+               "pass": bool(total_rmse < rmse_t
+                            and all(r < rmse_t and m < mae_t
+                                    for r, m in heads))}
+        with open(report, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    for ih, (head_rmse, head_mae) in enumerate(heads):
         assert head_rmse < rmse_t, (
             f"{model_type}/{ci_input} head {ih} RMSE {head_rmse:.4f} "
             f">= {rmse_t}")
         assert head_mae < mae_t, (
             f"{model_type}/{ci_input} head {ih} MAE {head_mae:.4f} "
             f">= {mae_t}")
-        total_se += float(np.sum((ht - hp) ** 2))
-        total_n += ht.size
-    total_rmse = float(np.sqrt(total_se / max(total_n, 1)))
     assert total_rmse < rmse_t, (
         f"{model_type}/{ci_input} total RMSE {total_rmse:.4f} >= {rmse_t}")
 

@@ -135,4 +135,32 @@ def test_orchestrate_failed_trial_scores_worst(tmp_path):
     result = orchestrate(str(script), {"x": (0.01, 1.0)}, num_trials=2,
                          concurrent=1, seed=0, log_dir=log_dir,
                          timeout_s=60)
-    assert all(r["value"] == float("inf") for r in result["history"])
+    # failed trials persist as value=null + failed flag (strict JSON —
+    # bare Infinity would break jq/strict parsers), rc preserved
+    assert all(r["value"] is None and r["failed"] and not r["timed_out"]
+               and r["rc"] == 3 for r in result["history"])
+    # trials.jsonl must round-trip through a STRICT json parser
+    with open(os.path.join(log_dir, "trials.jsonl")) as f:
+        for line in f:
+            json.loads(line, parse_constant=lambda s: (_ for _ in ()).throw(
+                ValueError(f"non-standard JSON constant {s}")))
+    # and resume must still poison-guard: a fresh orchestrate over the
+    # same log_dir replays the failed trials as worst-finite
+    result2 = orchestrate(str(script), {"x": (0.01, 1.0)}, num_trials=2,
+                          concurrent=1, seed=0, log_dir=log_dir,
+                          timeout_s=60)
+    assert len(result2["history"]) == 2  # resumed, nothing re-run
+
+
+def test_cbo_non_positive_float_range():
+    """Float ranges touching 0/negative use linear scaling (log10 would
+    raise); positive ranges keep the log scale."""
+    opt = CBO({"lr": (1e-4, 1.0), "shift": (-0.5, 0.5)}, seed=0)
+    for _ in range(6):
+        p = opt.ask()
+        assert -0.5 <= p["shift"] <= 0.5
+        assert 1e-4 <= p["lr"] <= 1.0
+        opt.tell(p, p["shift"] ** 2 + p["lr"])
+    enc = _Encoder({"shift": (-0.5, 0.5)})
+    x = enc.encode({"shift": 0.0})
+    assert 0.0 <= float(x[0]) <= 1.0

@@ -74,4 +74,53 @@ def test_pipeline_validation_errors():
     with pytest.raises(ValueError, match="pipeline stages"):
         run_training(_cfg(3, num_conv_layers=4), datasets=_splits())
     with pytest.raises(ValueError, match="supports model_type"):
-        run_training(_cfg(2, model_type="PNA"), datasets=_splits())
+        run_training(_cfg(2, model_type="GAT"), datasets=_splits())
+
+
+def test_pipeline_pna_forward_matches_sequential():
+    """The flagship conv (PNA) pipelines: pipelined == sequential on the
+    same params (VERDICT r2 Next #6)."""
+    from hydragnn_tpu.config import build_model_config, update_config
+    from hydragnn_tpu.graphs.batch import collate
+    from hydragnn_tpu.datasets.loader import _stack_batches
+    from hydragnn_tpu.parallel.mesh import make_mesh
+    from hydragnn_tpu.parallel.pipeline_trainer import (
+        init_pipeline_params, make_pipeline_forward)
+
+    samples = deterministic_graph_dataset(num_configs=16)
+    cfg = make_config("PNA", num_conv_layers=4)
+    cfg = update_config(cfg, samples)
+    mcfg = build_model_config(cfg)
+    micro = [collate(samples[i:i + 4], n_node=128, n_edge=2048, n_graph=5)
+             for i in range(0, 16, 4)]
+    stacked = _stack_batches(micro)
+    params = init_pipeline_params(jax.random.PRNGKey(0), mcfg, micro[0])
+
+    mesh = make_mesh((("pipe", 2),))
+    fwd_pipe = make_pipeline_forward(mcfg, mesh, 2, pipelined=True)
+    fwd_seq = make_pipeline_forward(mcfg, mesh, 2, pipelined=False)
+    out_p, _ = fwd_pipe(params, stacked)
+    out_s, _ = fwd_seq(params, stacked)
+    for a, b in zip(out_p, out_s):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_pna_config_trains():
+    state, history, _, _ = run_training(
+        _cfg(2, model_type="PNA"), datasets=_splits())
+    assert all(np.isfinite(v) for v in history["train_loss"])
+    assert history["train_loss"][-1] < history["train_loss"][0]
+
+
+def test_pipeline_bf16_trains():
+    """Architecture.dtype=bfloat16 through the pipelined path: bf16
+    compute, f32 masters (the main path's mixed-precision policy)."""
+    cfg = _cfg(2)
+    cfg["NeuralNetwork"]["Architecture"]["dtype"] = "bfloat16"
+    state, history, _, _ = run_training(cfg, datasets=_splits())
+    assert all(np.isfinite(v) for v in history["train_loss"])
+    # masters stay f32
+    leaves = jax.tree_util.tree_leaves(state.params)
+    assert all(l.dtype == np.float32 for l in leaves
+               if np.issubdtype(l.dtype, np.floating))

@@ -1,0 +1,49 @@
+"""tools/tpu_pod_launch.py --dry-run: the command plan must be complete,
+correct, and side-effect free (the runbook's CI anchor)."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+SCRIPT = os.path.join(REPO, "tools", "tpu_pod_launch.py")
+
+
+def _run(args):
+    r = subprocess.run([sys.executable, SCRIPT, *args, "--dry-run"],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    return r.stdout
+
+
+def test_gcloud_mode_plan():
+    out = _run(["--tpu", "pod-a", "--zone", "us-central2-b",
+                "--script", "examples/multidataset/train.py",
+                "--script-args=--ddstore",
+                "--graphstore-root", "/mnt/gfm"])
+    assert "gcloud compute tpus tpu-vm ssh" in out and "pod-a" in out
+    assert "--worker=all" in out
+    assert "--zone=us-central2-b" in out
+    assert "HYDRAGNN_STEPS_PER_CALL=8" in out
+    # one identical command everywhere: shard root resolved at runtime
+    assert "HYDRAGNN_GS_SHARD_ROOT=/mnt/gfm" in out
+    assert "python -u examples/multidataset/train.py --ddstore" in out
+    assert "nothing executed" in out
+
+
+def test_hostfile_mode_plan():
+    out = _run(["--hosts", "h0,h1,h2", "--script", "run_training.py",
+                "--script-args", "cfg.json", "--env", "FOO=bar baz"])
+    # one ssh per host, explicit rendezvous pointing at the first host
+    assert out.count("ssh h") == 3
+    assert "HYDRAGNN_MASTER_ADDR=h0" in out
+    assert "SLURM_NPROCS=3" in out
+    assert "SLURM_PROCID=2" in out
+    assert "HYDRAGNN_GS_SHARD_DIR=/mnt/gfm/shard_2" not in out  # no root
+    assert "FOO=" in out and "bar baz" in out
+
+
+def test_plan_executes_nothing(tmp_path):
+    marker = tmp_path / "ran"
+    _run(["--hosts", "localhost",
+          "--script", f"touch {marker}", "--script-args", ""])
+    assert not marker.exists()

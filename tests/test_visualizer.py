@@ -78,3 +78,39 @@ def test_profile_section_captures_target_epoch(tmp_path):
                      recursive=True), "no trace captured"
     # per-task losses now recorded alongside totals
     assert any(k.startswith("task_") for k in history)
+
+
+def test_visualizer_analysis_plot_families(tmp_path):
+    """Round-3 families: global analysis, scalar parity+PDF, per-node
+    error PDFs, per-node vector parity (reference visualizer
+    :134-281,281-387,387-467,519-614)."""
+    rng = np.random.RandomState(0)
+    viz = Visualizer("analysisrun", plot_dir=str(tmp_path),
+                     node_feature=rng.rand(30, 4))
+    # scalar head [S, 1]
+    t_s = rng.randn(30, 1)
+    p_s = t_s + 0.05 * rng.randn(30, 1)
+    viz.create_plot_global_analysis("energy", t_s, p_s)
+    viz.create_parity_plot_and_error_histogram_scalar("energy", t_s, p_s)
+    # per-node scalar [S, N]
+    t_n = rng.randn(30, 4)
+    p_n = t_n + 0.05 * rng.randn(30, 4)
+    viz.create_plot_global_analysis("charge", t_n, p_n)
+    viz.create_parity_plot_and_error_histogram_scalar("charge", t_n, p_n,
+                                                      iepoch=3)
+    viz.create_error_histogram_per_node("charge", t_n, p_n)
+    # scalar head: per-node histogram is a documented no-op
+    viz.create_error_histogram_per_node("energy", t_s, p_s)
+    # per-node 3-vector [S, N*3]
+    t_v = rng.randn(30, 12)
+    p_v = t_v + 0.05 * rng.randn(30, 12)
+    viz.create_parity_plot_per_node_vector("forces", t_v, p_v)
+
+    out = os.path.join(str(tmp_path), "analysisrun", "postprocess")
+    for stem in ("global_analysis_energy", "parity_scalar_energy",
+                 "global_analysis_charge", "parity_scalar_charge_0003",
+                 "error_hist1d_charge", "parity_pernode_vec_forces"):
+        assert os.path.exists(os.path.join(out, stem + ".npz")), stem
+        assert os.path.exists(os.path.join(out, stem + ".png")), stem
+    assert not os.path.exists(
+        os.path.join(out, "error_hist1d_energy.npz"))

@@ -1,0 +1,74 @@
+"""Run the full nightly sweep battery to completion and write the
+per-case artifact (round-2 verdict, Next #9).
+
+Runs `pytest -m sweep` with SWEEP_REPORT set so every case —
+pass or fail — appends its RMSE/MAE vs threshold and budget to a JSONL,
+then compiles SWEEP_r{N}.json:
+
+    {"cases": [...], "passed": N, "failed": M, "wall_s": ...}
+
+Usage: python tools/run_sweep_battery.py [--timeout-h 10] [-k EXPR]
+"""
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ROUND = int(os.environ.get("GRAFT_ROUND", "3"))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--timeout-h", type=float, default=10.0)
+    p.add_argument("-k", default=None, help="pytest -k filter")
+    p.add_argument("--out", default=os.path.join(
+        REPO, f"SWEEP_r{ROUND:02d}.json"))
+    args = p.parse_args()
+
+    report = os.path.join(REPO, "logs", "sweep_cases.jsonl")
+    os.makedirs(os.path.dirname(report), exist_ok=True)
+    if os.path.exists(report):
+        os.remove(report)
+    cmd = [sys.executable, "-m", "pytest", "tests/test_graphs_sweep.py",
+           "-m", "sweep", "-q", "--no-header", "-p", "no:cacheprovider"]
+    if args.k:
+        cmd += ["-k", args.k]
+    env = dict(os.environ, SWEEP_REPORT=report)
+    t0 = time.time()
+    r = subprocess.run(cmd, cwd=REPO, env=env,
+                       capture_output=True, text=True,
+                       timeout=args.timeout_h * 3600)
+    wall = time.time() - t0
+
+    cases = []
+    if os.path.exists(report):
+        with open(report) as f:
+            cases = [json.loads(line) for line in f]
+    out = {
+        "metric": "nightly_sweep_battery",
+        "round": ROUND,
+        "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"),
+        "pytest_rc": r.returncode,
+        "pytest_tail": r.stdout.strip().splitlines()[-1]
+        if r.stdout.strip() else "",
+        "wall_s": round(wall, 1),
+        "passed": sum(c["pass"] for c in cases),
+        "failed": sum(not c["pass"] for c in cases),
+        "cases": cases,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({k: out[k] for k in
+                      ("pytest_rc", "wall_s", "passed", "failed")}))
+    return r.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())
