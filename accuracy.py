@@ -94,9 +94,15 @@ def main():
     splits = split_dataset(samples, 0.7)
 
     if args.all:
-        models = sorted(THRESHOLDS)
-        results = {m: run_model(m, backend, samples, splits)
-                   for m in models}
+        results = {}
+        for m in sorted(THRESHOLDS):
+            # one model crashing must not discard the completed
+            # multi-minute runs before it — record and continue
+            try:
+                results[m] = run_model(m, backend, samples, splits)
+            except Exception as e:  # noqa: BLE001
+                results[m] = {"model": m, "pass": False,
+                              "error": repr(e)[:500]}
         out = {"metric": "lj_energy_force_mae_battery",
                "backend": backend,
                "pass": all(r["pass"] for r in results.values()),

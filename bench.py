@@ -15,10 +15,14 @@ Env knobs:
                        (default 900; probes every 60s)
   BENCH_NBR            dense neighbor-list layout on/off (default 1)
   BENCH_STEPS_PER_CALL lax.scan steps per dispatch (default: 4 on TPU,
-                       1 on CPU; 0/1 = off). The scan amortizes the
-                       ~2.4 ms axon-tunnel dispatch latency, which CPU
-                       doesn't have — measured r2: spc=4 cost CPU 40%
-                       (43.2 -> 25.8 g/s), so defaults are per-backend.
+                       10 on CPU; 0/1 = off). Measured r3 on an idle
+                       CPU box (BENCH_SWEEP.json cpu_clean_rerun):
+                       spc 1/4/10 -> 41.8/47.9/49.6 g/s — the scan cuts
+                       per-step dispatch overhead everywhere, and on TPU
+                       additionally amortizes the ~2.4 ms tunnel
+                       latency. (r2's 43.2->25.8 "spc regression" did
+                       not reproduce; it was box contention, not the
+                       flag.)
   BENCH_SWEEP          =1: sweep NBR x PALLAS x STEPS_PER_CALL in
                        subprocesses, print the winner (full grid written
                        to BENCH_SWEEP_OUT, default BENCH_SWEEP.json)
@@ -173,9 +177,9 @@ def run_bench():
     # (train_step.make_multi_train_step) — amortizes the ~2.4 ms per-call
     # tunnel dispatch latency. Same training math; throughput counts the
     # same BATCH_GRAPHS * STEPS graphs.
-    # per-backend default (see module docstring): the scan pays off only
-    # where per-dispatch latency is material (the axon tunnel)
-    default_spc = "1" if backend.startswith("cpu") else "4"
+    # per-backend default (see module docstring; measured in
+    # BENCH_SWEEP.json): 10 on CPU, 4 on TPU until the on-chip sweep lands
+    default_spc = "10" if backend.startswith("cpu") else "4"
     spc = min(int(os.environ.get("BENCH_STEPS_PER_CALL", default_spc)
                   or 0), STEPS)
     multi_step = None

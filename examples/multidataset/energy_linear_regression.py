@@ -78,7 +78,13 @@ def main():
                             receivers=s.receivers, edge_attr=s.edge_attr,
                             y_graph=np.asarray([residual[i] / len(s.x)],
                                                np.float32),
-                            y_node=s.y_node)
+                            y_node=s.y_node,
+                            # keep the GFM common-schema side channel —
+                            # stores without energy/forces cannot stack
+                            # with other members (loader.py schema check)
+                            energy=np.asarray([residual[i] / len(s.x)],
+                                              np.float32),
+                            forces=s.y_node[:, :3])
                 for i, s in enumerate(samples)]
             to_graphstore(relabeled, os.path.join(
                 here, "dataset", "linreg", name.lower()))

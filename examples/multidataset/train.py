@@ -99,16 +99,29 @@ def _load_member(name: str, here: str, limit: int):
                 not glob.glob(os.path.join(d, "synthetic", "*.hdf5")):
             generate_qm7x_dataset(d)
         # remap to the common x=[Z,pos,forces] / energy / forces schema
+        # (energy = per-atom PBE0 atomization from the loader's side
+        # channel; HLgap would silently train a different quantity and
+        # the missing energy/forces fields broke mixed-member stacking)
         samples = load_qm7x(d, limit=limit)
         import numpy as np
         from hydragnn_tpu.graphs.batch import GraphSample
         out = []
         for s in samples:
             forces = s.y_node[:, :3]
+            if s.energy is None:
+                # HLgap is the only graph label then — mixing eV-scale
+                # gaps into the shared per-atom energy head would train
+                # a different quantity without any visible sign
+                raise ValueError(
+                    "qm7x member files lack ePBE0; cannot derive the "
+                    "GFM per-atom energy label (refusing to fall back "
+                    "to HOMO-LUMO gap)")
+            energy = s.energy
             out.append(GraphSample(
                 x=np.concatenate([s.x[:, :1], s.pos, forces], axis=1),
                 pos=s.pos, senders=s.senders, receivers=s.receivers,
-                edge_attr=s.edge_attr, y_graph=s.y_graph, y_node=forces))
+                edge_attr=s.edge_attr, y_graph=energy, y_node=forces,
+                energy=energy, forces=forces))
         return out
     raise ValueError(f"unknown member dataset '{name}'; known: {_KNOWN}")
 

@@ -30,7 +30,8 @@ EPBE0_ATOM = {1: -13.641404161, 6: -1027.592489146, 7: -1484.274819088,
 
 
 def _conf_to_sample(xyz, z, forces, hchg, hvdip, hrat, hlgap,
-                    radius: float, max_neighbours: int) -> GraphSample:
+                    radius: float, max_neighbours: int,
+                    epbe0=None) -> GraphSample:
     x = np.concatenate([z[:, None], xyz, forces, hchg[:, None],
                         hvdip[:, None], hrat[:, None]], axis=1)
     y_node = np.concatenate([forces, hchg[:, None], hvdip[:, None],
@@ -38,11 +39,21 @@ def _conf_to_sample(xyz, z, forces, hchg, hvdip, hrat, hlgap,
     send, recv = radius_graph(xyz, radius, max_neighbours=max_neighbours)
     vec = xyz[send] - xyz[recv]
     edge_len = np.linalg.norm(vec, axis=1, keepdims=True)
+    # atomization energy per atom on the energy/forces side channel
+    # (reference train.py:57-78 subtracts EPBE0_ATOM references); the
+    # qm7x example's own heads (y_graph=HLgap, y_node=props) unchanged —
+    # the GFM common schema consumes energy/forces instead
+    energy = None
+    if epbe0 is not None:
+        atomization = float(epbe0) - sum(EPBE0_ATOM.get(int(zi), 0.0)
+                                         for zi in z)
+        energy = np.asarray([atomization / len(z)], np.float32)
     return GraphSample(x=x.astype(np.float32), pos=xyz.astype(np.float32),
                        senders=send, receivers=recv,
                        edge_attr=edge_len.astype(np.float32),
                        y_graph=np.asarray([hlgap], np.float32),
-                       y_node=y_node.astype(np.float32))
+                       y_node=y_node.astype(np.float32),
+                       energy=energy, forces=forces.astype(np.float32))
 
 
 def load_qm7x(dirpath: str, radius: float = 5.0, max_neighbours: int = 20,
@@ -71,9 +82,11 @@ def load_qm7x(dirpath: str, radius: float = 5.0, max_neighbours: int = 20,
                     hvdip = np.asarray(g["hVDIP"], np.float32).reshape(-1)
                     hrat = np.asarray(g["hRAT"], np.float32).reshape(-1)
                     hlgap = float(np.asarray(g["HLgap"]).reshape(-1)[0])
+                    epbe0 = (float(np.asarray(g["ePBE0"]).reshape(-1)[0])
+                             if "ePBE0" in g else None)
                     samples.append(_conf_to_sample(
                         xyz, z, forces, hchg, hvdip, hrat, hlgap,
-                        radius, max_neighbours))
+                        radius, max_neighbours, epbe0=epbe0))
                     if len(samples) >= limit:
                         return samples
     return samples

@@ -22,8 +22,11 @@ def _use_pallas() -> bool:
     """Route 2-D segment sums through the Pallas MXU kernel on TPU.
 
     Default: on for TPU backends (measured ~1.6x over the XLA scatter at
-    OC20-like shapes, see kernels/segment_pallas.py); off on CPU (pallas
-    CPU supports interpret mode only). Override with HYDRAGNN_USE_PALLAS=0/1.
+    OC20-like shapes, see kernels/segment_pallas.py); off on CPU — pallas
+    CPU runs interpret mode only, and the r3 sweep measured it
+    pathologically slow there (every HYDRAGNN_USE_PALLAS=1 CPU grid
+    point timed out at 20 min vs ~40 g/s without, BENCH_SWEEP.json).
+    Override with HYDRAGNN_USE_PALLAS=0/1.
     """
     if not _PALLAS_STATE["checked"]:
         env = os.environ.get("HYDRAGNN_USE_PALLAS")

@@ -237,6 +237,10 @@ class Visualizer:
                               f"node:{inode}",
                               c=None if feat is None else feat[:, inode])
             self._scatter(axs[t.shape[1]], t.sum(1), p.sum(1), "SUM")
+            # per-node mean ACROSS samples (axis 0) — N points, one per
+            # site; the SUM panel above is the transpose view (per-sample
+            # sum across sites). Matches the reference's
+            # "SMP_Mean4sites" panel (visualizer.py:435-447).
             self._scatter(axs[t.shape[1] + 1], t.mean(0), p.mean(0),
                           f"SMP_Mean4sites:0-{t.shape[1]}")
         fig.tight_layout()

@@ -90,7 +90,15 @@ def write_v2000_sdf(mols, sdf_path: str, csv_path: str) -> None:
 
 
 def main() -> None:
+    # a wedged axon tunnel hangs the first device op in-process: probe in
+    # a subprocess and pin a working platform before any jax import
+    from hydragnn_tpu.utils.devices import force_cpu_platform, probe_backend
+    platform, _ = probe_backend(timeout_s=90, attempts=1)
+    if platform is None or platform == "cpu":
+        force_cpu_platform()
+        platform = "cpu"
     report = {"metric": "realdata_qm9_ingest_train", "round": ROUND,
+              "backend": platform,
               "attempts": attempt_downloads()}
     egress = any(a.get("ok") for a in report["attempts"])
     report["egress"] = "available" if egress else "blocked"

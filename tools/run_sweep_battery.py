@@ -41,9 +41,21 @@ def main() -> int:
         cmd += ["-k", args.k]
     env = dict(os.environ, SWEEP_REPORT=report)
     t0 = time.time()
-    r = subprocess.run(cmd, cwd=REPO, env=env,
-                       capture_output=True, text=True,
-                       timeout=args.timeout_h * 3600)
+    try:
+        r = subprocess.run(cmd, cwd=REPO, env=env,
+                           capture_output=True, text=True,
+                           timeout=args.timeout_h * 3600)
+        rc, tail = r.returncode, (r.stdout.strip().splitlines()[-1]
+                                  if r.stdout.strip() else "")
+    except subprocess.TimeoutExpired as e:
+        # compile the partial artifact — hours of completed cases are in
+        # the JSONL and must not be lost to an overrun
+        rc = -1
+        out_text = e.stdout or b""
+        if isinstance(out_text, bytes):
+            out_text = out_text.decode(errors="replace")
+        tail = f"TIMEOUT after {args.timeout_h}h; " + \
+            (out_text.strip().splitlines()[-1] if out_text.strip() else "")
     wall = time.time() - t0
 
     cases = []
@@ -55,9 +67,8 @@ def main() -> int:
         "round": ROUND,
         "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
             timespec="seconds"),
-        "pytest_rc": r.returncode,
-        "pytest_tail": r.stdout.strip().splitlines()[-1]
-        if r.stdout.strip() else "",
+        "pytest_rc": rc,
+        "pytest_tail": tail,
         "wall_s": round(wall, 1),
         "passed": sum(c["pass"] for c in cases),
         "failed": sum(not c["pass"] for c in cases),
@@ -67,7 +78,7 @@ def main() -> int:
         json.dump(out, f, indent=1)
     print(json.dumps({k: out[k] for k in
                       ("pytest_rc", "wall_s", "passed", "failed")}))
-    return r.returncode
+    return rc
 
 
 if __name__ == "__main__":
