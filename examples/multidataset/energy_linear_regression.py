@@ -84,7 +84,7 @@ def main():
                             # with other members (loader.py schema check)
                             energy=np.asarray([residual[i] / len(s.x)],
                                               np.float32),
-                            forces=s.y_node[:, :3])
+                            forces=s.forces)
                 for i, s in enumerate(samples)]
             to_graphstore(relabeled, os.path.join(
                 here, "dataset", "linreg", name.lower()))

@@ -127,9 +127,17 @@ def deterministic_samples_for_config(config, num_configs=12, seed=0):
         # inputs: the column blocks input_node_features selects
         x_in = update_atom_features(voi.get("input_node_features", [0]),
                                     node_menu, node_dims)
+        # Architecture.edge_features=["lengths"]: models with a hard
+        # edge-encoder input (PNA/PNAPlus) need edge_attr materialized,
+        # same as preprocess/transforms.py does for real datasets
+        edge_attr = None
+        if arch.get("edge_features"):
+            vec = pos[send] - pos[recv]
+            edge_attr = np.linalg.norm(vec, axis=1,
+                                       keepdims=True).astype(np.float32)
         samples.append(GraphSample(
             x=x_in.astype(np.float32), pos=pos, senders=send, receivers=recv,
-            y_graph=y_graph, y_node=y_node))
+            edge_attr=edge_attr, y_graph=y_graph, y_node=y_node))
     if samples and samples[0].y_graph is not None:
         _minmax_normalize_graph_targets(samples)
     return samples
