@@ -34,38 +34,63 @@ def main():
         setup_cpu_devices()
 
     from examples.LennardJones.lj_data import generate_lj_dataset
+    from hydragnn_tpu.config import build_model_config
+    from hydragnn_tpu.graphs.batch import collate
     from hydragnn_tpu.postprocess.visualizer import Visualizer
     from hydragnn_tpu.preprocess.load_data import split_dataset
-    from hydragnn_tpu.run_prediction import run_prediction
     from hydragnn_tpu.run_training import run_training
+    from hydragnn_tpu.train.train_step import make_eval_step
     from tests.utils import make_config
 
     samples = generate_lj_dataset(num_configs=args.num_configs)
     splits = split_dataset(samples, 0.8, False)
 
-    cfg = make_config(args.model_type, heads=("graph", "node"))
+    # energy-force mode needs the per-atom-energy node head (the same
+    # config shape as LennardJones.py and accuracy.py): graph energy =
+    # masked sum of the node head, forces = -grad(E)
+    cfg = make_config(args.model_type, heads=("node",))
     cfg["NeuralNetwork"]["Training"]["num_epoch"] = args.num_epoch
     cfg["NeuralNetwork"]["Training"]["compute_grad_energy"] = True
     state, history, model, completed = run_training(cfg, datasets=splits)
-    trues, preds = run_prediction(completed, datasets=splits, state=state,
-                                  model=model)
+
+    # EF inference, batched like accuracy.py's eval loop; the triplet
+    # transform keeps DimeNet runnable (run_training wires it internally,
+    # a bare collate would drop idx_kj/idx_ji)
+    from hydragnn_tpu.graphs.triplets import maybe_triplet_transform
+    mcfg = build_model_config(completed)
+    eval_step = make_eval_step(model, mcfg, loss_name="mae",
+                               compute_grad_energy=True)
+    te = splits[2]
+    bs = 16
+    transform = maybe_triplet_transform(args.model_type, samples, bs)
+    t_e, p_e, t_f, p_f = [], [], [], []
+    for i in range(0, len(te), bs):
+        chunk = te[i:i + bs]
+        batch = collate(chunk)
+        if transform is not None:
+            batch = transform(batch)
+        _, outputs = eval_step(state, batch)
+        t_e.extend(float(s.energy[0]) for s in chunk)
+        p_e.extend(np.asarray(outputs[0]).ravel()[:len(chunk)].tolist())
+        mask = np.asarray(batch.node_mask, bool)
+        t_f.append(np.concatenate([s.forces for s in chunk]))
+        p_f.append(np.asarray(outputs[1])[mask])
+    t_e, p_e = np.asarray(t_e)[:, None], np.asarray(p_e)[:, None]
+    t_fc = np.concatenate(t_f)
+    p_fc = np.concatenate(p_f)
 
     name = f"LJ_{args.model_type}"
-    viz = Visualizer(name, num_heads=len(trues),
-                     num_nodes_list=[len(s.x) for s in splits[2]])
+    viz = Visualizer(name, num_heads=2,
+                     num_nodes_list=[len(s.x) for s in te])
     viz.plot_history(history)
     viz.num_nodes_plot()
-    t_e, p_e = np.asarray(trues[0]), np.asarray(preds[0])
-    viz.create_scatter_plots(trues, preds,
+    viz.create_scatter_plots([t_e, t_fc], [p_e, p_fc],
                              output_names=["energy", "forces"])
     viz.create_plot_global_analysis("energy", t_e, p_e)
     viz.create_parity_plot_and_error_histogram_scalar("energy", t_e, p_e)
-    # forces: per-sample [N*3] vectors -> component parity
-    t_f = np.asarray(trues[1]).reshape(len(trues[1]), -1)
-    p_f = np.asarray(preds[1]).reshape(len(preds[1]), -1)
-    viz.create_parity_plot_vector(t_f[:, :3], p_f[:, :3], name="force")
+    viz.create_parity_plot_vector(t_fc, p_fc, name="force")
     e_mae = float(np.mean(np.abs(t_e - p_e)))
-    f_mae = float(np.mean(np.abs(t_f - p_f)))
+    f_mae = float(np.mean(np.abs(t_fc - p_fc)))
     print(f"wrote plots under {viz.outdir}; "
           f"energy_mae={e_mae:.4f} force_mae={f_mae:.4f}")
 

@@ -326,8 +326,11 @@ class Visualizer:
 
     @staticmethod
     def _error_pdf(ax, t, p, title, bins: int = 40):
-        hist, edges = np.histogram(np.asarray(p) - np.asarray(t),
-                                   bins=bins, density=True)
+        err = (np.asarray(p) - np.asarray(t)).ravel()
+        err = err[np.isfinite(err)]  # a diverged model still gets a plot
+        if err.size == 0:
+            err = np.zeros(1)
+        hist, edges = np.histogram(err, bins=bins, density=True)
         ax.plot(0.5 * (edges[:-1] + edges[1:]), hist, "ro")
         ax.set_title(title)
         ax.set_xlabel("error")
