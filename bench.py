@@ -26,6 +26,9 @@ Env knobs:
   BENCH_SWEEP          =1: sweep NBR x PALLAS x STEPS_PER_CALL in
                        subprocesses, print the winner (full grid written
                        to BENCH_SWEEP_OUT, default BENCH_SWEEP.json)
+  BENCH_BATCH / BENCH_NODES / BENCH_HIDDEN
+                       workload scale (default 32/80/128, the CI-sized
+                       OC20-like shape); larger fills the MXU better
   HYDRAGNN_USE_PALLAS  Pallas segment-sum kernel on/off (ops/segment.py)
   BENCH_PEAK_FLOPS     override chip peak FLOP/s for MFU
 """
@@ -40,11 +43,14 @@ import numpy as np
 
 REF_BASELINE_GPS = 250.0  # graphs/sec per GPU-die anchor for this workload
 
-# OC20 S2EF-like shape: ~80 atoms/graph, ~30 neighbors/atom, batch 32
-BATCH_GRAPHS = 32
-NODES_PER_GRAPH = 80
+# OC20 S2EF-like shape: ~80 atoms/graph, ~30 neighbors/atom, batch 32.
+# BENCH_BATCH/BENCH_HIDDEN scale the workload (e.g. 256/256 fills the
+# v5e MXU far better than the CI-sized default; the headline metric is
+# still graphs/sec so results stay comparable per shape).
+BATCH_GRAPHS = int(os.environ.get("BENCH_BATCH", "32"))
+NODES_PER_GRAPH = int(os.environ.get("BENCH_NODES", "80"))
 DEG = 30
-HIDDEN = 128
+HIDDEN = int(os.environ.get("BENCH_HIDDEN", "128"))
 NUM_CONV = 3
 STEPS = 20
 

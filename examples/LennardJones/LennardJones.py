@@ -4,6 +4,9 @@ reference: examples/LennardJones/LennardJones.py:56-331 — argparse driver
 that generates LJ data, builds pickle/adios datasets, trains with the
 energy-force loss (`compute_grad_energy`), and prints GPTL timers.
 
+The base config is LJ.json (reference ships the same file name); CLI
+flags override its model_type / sizes / budget in place.
+
 Usage:
     python examples/LennardJones/LennardJones.py --model_type SchNet \
         --num_configs 200 --num_epoch 20 [--format graphstore] [--cpu]
@@ -18,6 +21,7 @@ sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
 
 def main():
     p = argparse.ArgumentParser()
+    p.add_argument("--inputfile", default="LJ.json")
     p.add_argument("--model_type", default="SchNet",
                    choices=["SchNet", "EGNN", "PAINN", "PNAEq", "MACE",
                             "DimeNet", "PNAPlus"])
@@ -64,56 +68,24 @@ def main():
         return
 
     splits = split_dataset(samples, 0.8)
-    config = {
-        "Verbosity": {"level": 1},
-        "NeuralNetwork": {
-            "Architecture": {
-                "model_type": args.model_type,
-                "radius": 2.0,
-                "max_neighbours": 64,
-                "num_gaussians": 32,
-                "num_filters": args.hidden_dim,
-                "num_radial": 8,
-                "envelope_exponent": 5,
-                "num_spherical": 4,
-                "int_emb_size": 16,
-                "basis_emb_size": 8,
-                "out_emb_size": 32,
-                "num_before_skip": 1,
-                "num_after_skip": 1,
-                "max_ell": 2,
-                "node_max_ell": 1,
-                "correlation": [2],
-                "equivariance": args.model_type in
-                    ("SchNet", "EGNN", "PAINN", "PNAEq", "MACE"),
-                "hidden_dim": args.hidden_dim,
-                "num_conv_layers": args.num_conv_layers,
-                "periodic_boundary_conditions": True,
-                "output_heads": {
-                    "node": {"num_headlayers": 2,
-                             "dim_headlayers": [args.hidden_dim,
-                                                args.hidden_dim],
-                             "type": "mlp"}},
-                "task_weights": [1.0],
-            },
-            "Variables_of_interest": {
-                "input_node_features": [0],
-                "output_index": [0],
-                "type": ["node"],
-                "output_dim": [1],
-                "output_names": ["node_energy"],
-            },
-            "Training": {
-                "num_epoch": args.num_epoch,
-                "batch_size": args.batch_size,
-                "perc_train": 0.8,
-                "loss_function_type": "mae",
-                "compute_grad_energy": True,
-                "Optimizer": {"type": "AdamW",
-                              "learning_rate": args.learning_rate},
-            },
-        },
-    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           args.inputfile)) as f:
+        config = json.load(f)
+    arch = config["NeuralNetwork"]["Architecture"]
+    arch["model_type"] = args.model_type
+    arch["num_filters"] = args.hidden_dim
+    arch["hidden_dim"] = args.hidden_dim
+    arch["num_conv_layers"] = args.num_conv_layers
+    arch["equivariance"] = args.model_type in (
+        "SchNet", "EGNN", "PAINN", "PNAEq", "MACE")
+    for head in arch["output_heads"].values():
+        if "dim_headlayers" in head:
+            head["dim_headlayers"] = [args.hidden_dim] * len(
+                head["dim_headlayers"])
+    train_cfg = config["NeuralNetwork"]["Training"]
+    train_cfg["num_epoch"] = args.num_epoch
+    train_cfg["batch_size"] = args.batch_size
+    train_cfg["Optimizer"]["learning_rate"] = args.learning_rate
     state, history, model, completed = run_training(
         config, datasets=splits, num_shards=args.num_shards)
     print(json.dumps({"final_train_loss": history["train_loss"][-1],

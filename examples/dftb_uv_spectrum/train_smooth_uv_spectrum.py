@@ -1,0 +1,13 @@
+"""Smooth UV-spectrum entry point (reference:
+examples/dftb_uv_spectrum/train_smooth_uv_spectrum.py). Delegates to the
+shared driver with --mode smooth pinned."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+from examples.dftb_uv_spectrum.train_uv_spectrum import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.argv.insert(1, "--mode=smooth")
+    main()

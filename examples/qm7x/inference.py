@@ -1,0 +1,102 @@
+"""QM7-X inference + density-parity plot suite.
+
+reference: examples/qm7x/inference.py — loads the trained QM7-X model
+from its log directory, predicts the test split, and draws
+density-colored parity scatters per head (getcolordensity's hist2d
+interpolation). Here prediction is `run_prediction` (which restores the
+best-val checkpoint for the config's log name when no state is passed)
+and the density parity / conditional-error plots are the Visualizer's
+global-analysis battery, written under logs/<name>/postprocess/.
+
+Usage:
+    python examples/qm7x/inference.py [--inputfile qm7x.json]
+        [--train] [--num_mols 20] [--num_epoch N] [--cpu]
+
+`--train` (or a missing checkpoint) trains first via the same path as
+train.py; afterwards inference always goes through the checkpoint so
+this exercises the restore path end-to-end.
+"""
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+
+def _dataset(config, here, num_mols, limit):
+    from examples.qm7x.qm7x_data import generate_qm7x_dataset, load_qm7x
+    from hydragnn_tpu.preprocess.load_data import split_dataset
+    import glob
+    arch = config["NeuralNetwork"]["Architecture"]
+    datadir = os.path.join(here, "dataset", "qm7x")
+    if not (glob.glob(os.path.join(datadir, "*.hdf5")) or
+            glob.glob(os.path.join(datadir, "synthetic", "*.hdf5"))):
+        generate_qm7x_dataset(datadir, num_mols=num_mols)
+    samples = load_qm7x(datadir, radius=arch["radius"],
+                        max_neighbours=arch["max_neighbours"], limit=limit)
+    return split_dataset(
+        samples, config["NeuralNetwork"]["Training"]["perc_train"], False)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--inputfile", default="qm7x.json")
+    p.add_argument("--train", action="store_true",
+                   help="(re)train before inference")
+    p.add_argument("--num_mols", type=int, default=20)
+    p.add_argument("--limit", type=int, default=1000)
+    p.add_argument("--num_epoch", type=int, default=None)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+    if args.cpu:
+        from examples.cli_utils import setup_cpu_devices
+        setup_cpu_devices()
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, args.inputfile)) as f:
+        config = json.load(f)
+    if args.num_epoch is not None:
+        config["NeuralNetwork"]["Training"]["num_epoch"] = args.num_epoch
+    config.setdefault("Visualization", {})["create_plots"] = False
+
+    from hydragnn_tpu.config import get_log_name_config
+    from hydragnn_tpu.run_prediction import run_prediction
+    from hydragnn_tpu.run_training import run_training
+    from hydragnn_tpu.utils.checkpoint import _ckpt_dir
+
+    splits = _dataset(config, here, args.num_mols, args.limit)
+
+    log_name = get_log_name_config(config)
+    have_ckpt = os.path.isdir(_ckpt_dir(log_name))
+    if args.train or not have_ckpt:
+        run_training(dict(config), datasets=splits)
+
+    # state=None -> run_prediction restores the best-val checkpoint
+    trues, preds = run_prediction(dict(config), datasets=splits)
+
+    from hydragnn_tpu.postprocess.visualizer import Visualizer
+    voi = config["NeuralNetwork"]["Variables_of_interest"]
+    names = voi.get("output_names",
+                    [f"head{i}" for i in range(len(trues))])
+    viz = Visualizer(log_name)
+    summary = {}
+    for name, ht, hp in zip(names, trues, preds):
+        ht = np.concatenate([np.asarray(a).ravel() for a in ht]) \
+            if isinstance(ht, list) else np.asarray(ht).ravel()
+        hp = np.concatenate([np.asarray(a).ravel() for a in hp]) \
+            if isinstance(hp, list) else np.asarray(hp).ravel()
+        viz.create_plot_global_analysis(name, ht, hp)
+        summary[name] = {
+            "mae": float(np.mean(np.abs(ht - hp))),
+            "rmse": float(np.sqrt(np.mean((ht - hp) ** 2))),
+            "n": int(ht.size),
+        }
+    out = {"log_name": log_name, "heads": summary}
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -82,13 +82,26 @@ def _minmax_normalize_graph_targets(samples):
         s.y_graph = ((s.y_graph - lo) / span).astype(np.float32)
 
 
-def deterministic_samples_for_config(config, num_configs=12, seed=0):
+REFERENCE_CELL_RANGES = ((1, 3), (1, 3), (1, 2))
+
+
+def deterministic_samples_for_config(config, num_configs=12, seed=0,
+                                     cell_ranges=((1, 4), (1, 4), (1, 3))):
     """Config-driven variant: builds the full node/graph feature menus the
     Dataset section declares (arbitrary per-feature dims, e.g.
     ci_vectoroutput.json's [2,1,2] vector blocks) and packs targets through
     the real selection path (preprocess.transforms.update_predicted_values,
     honoring any output_index order) — the reference CI's
-    deterministic-dataset + update_predicted_values flow."""
+    deterministic-dataset + update_predicted_values flow.
+
+    `cell_ranges` are numpy-randint (lo, hi-exclusive) bounds per axis for
+    the BCC supercell. The default keeps the larger graphs the quick suite
+    was calibrated on; REFERENCE_CELL_RANGES reproduces the reference
+    fixture's 2-8 node near-complete graphs (reference:
+    tests/deterministic_graph_data.py:24-29, unit cells <= 2x2x1), which the
+    nightly sweep uses so its thresholds are asserted on reference-faithful
+    geometry — conv-head targets are only learnable by no-self-path convs
+    (MFC/SchNet/EGNN/PNAEq) on near-complete graphs."""
     from hydragnn_tpu.preprocess.transforms import (update_atom_features,
                                                      update_predicted_values)
 
@@ -103,8 +116,9 @@ def deterministic_samples_for_config(config, num_configs=12, seed=0):
     rng = np.random.RandomState(seed)
     samples = []
     for _ in range(num_configs):
-        pos = bcc_positions(rng.randint(1, 4), rng.randint(1, 4),
-                            rng.randint(1, 3))
+        (xlo, xhi), (ylo, yhi), (zlo, zhi) = cell_ranges
+        pos = bcc_positions(rng.randint(xlo, xhi), rng.randint(ylo, yhi),
+                            rng.randint(zlo, zhi))
         n = pos.shape[0]
         types = np.arange(n) % 3
         x = (types.astype(np.float32) + 1.0) / 3.0

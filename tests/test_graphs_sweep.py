@@ -26,7 +26,8 @@ from hydragnn_tpu.preprocess.load_data import split_dataset
 from hydragnn_tpu.run_prediction import run_prediction
 from hydragnn_tpu.run_training import run_training
 
-from tests.deterministic_data import deterministic_samples_for_config
+from tests.deterministic_data import (REFERENCE_CELL_RANGES,
+                                      deterministic_samples_for_config)
 
 REF_INPUTS = "/root/reference/tests/inputs"
 
@@ -112,7 +113,8 @@ def _train_and_check(model_type, ci_input, use_lengths=False):
     train_cfg["EarlyStopping"] = False
     cfg.setdefault("Visualization", {})["create_plots"] = False
 
-    samples = deterministic_samples_for_config(cfg, num_configs=num_configs)
+    samples = deterministic_samples_for_config(
+        cfg, num_configs=num_configs, cell_ranges=REFERENCE_CELL_RANGES)
     splits = split_dataset(samples, train_cfg.get("perc_train", 0.7))
     state, history, model, completed = run_training(cfg, datasets=splits,
                                                     num_shards=1)
