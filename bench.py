@@ -14,21 +14,25 @@ Env knobs:
   BENCH_WAIT_TUNNEL_S  bounded wait-for-tunnel window before CPU fallback
                        (default 900; probes every 60s)
   BENCH_NBR            dense neighbor-list layout on/off (default 1)
-  BENCH_STEPS_PER_CALL lax.scan steps per dispatch (default: 4 on TPU,
-                       10 on CPU; 0/1 = off). Measured r3 on an idle
-                       CPU box (BENCH_SWEEP.json cpu_clean_rerun):
-                       spc 1/4/10 -> 41.8/47.9/49.6 g/s — the scan cuts
-                       per-step dispatch overhead everywhere, and on TPU
-                       additionally amortizes the ~2.4 ms tunnel
-                       latency. (r2's 43.2->25.8 "spc regression" did
-                       not reproduce; it was box contention, not the
-                       flag.)
+  BENCH_STEPS_PER_CALL lax.scan steps per dispatch (default: 1 on TPU,
+                       10 on CPU; 0/1 = off). Adjudicated on-chip in r3
+                       (BENCH_SWEEP_TPU.json): on the v5e, spc 1/4/10 ->
+                       4429.6/2194.4/1853.8 g/s with the dense nbr
+                       layout — the scan HURTS on TPU (the stacked
+                       [S, ...] batch breaks XLA's fusion of the
+                       per-step graph and the dispatch latency it
+                       amortizes is already hidden by async dispatch).
+                       On CPU the scan still wins (BENCH_SWEEP.json
+                       cpu_clean_rerun: spc 1/4/10 ->
+                       41.8/47.9/49.6 g/s, dispatch-bound).
   BENCH_SWEEP          =1: sweep NBR x PALLAS x STEPS_PER_CALL in
                        subprocesses, print the winner (full grid written
                        to BENCH_SWEEP_OUT, default BENCH_SWEEP.json)
   BENCH_BATCH / BENCH_NODES / BENCH_HIDDEN
                        workload scale (default 32/80/128, the CI-sized
                        OC20-like shape); larger fills the MXU better
+  BENCH_DTYPE          compute dtype for the train step (default
+                       float32; bfloat16 = mixed precision on the MXU)
   HYDRAGNN_USE_PALLAS  Pallas segment-sum kernel on/off (ops/segment.py)
   BENCH_PEAK_FLOPS     override chip peak FLOP/s for MFU
 """
@@ -54,9 +58,12 @@ HIDDEN = int(os.environ.get("BENCH_HIDDEN", "128"))
 NUM_CONV = 3
 STEPS = 20
 
-# bf16/f32-MXU peak FLOP/s by device kind (public spec sheets); MFU is
-# measured achieved FLOP/s over this peak. Unknown kinds fall back to the
-# v5e figure; override with BENCH_PEAK_FLOPS.
+# bf16-MXU peak FLOP/s by device kind (public spec sheets); MFU is
+# measured achieved FLOP/s over this peak. f32 compute gets half the
+# bf16 peak (the MXU multiplies in bf16; f32 matmuls take 2+ passes) so
+# cross-dtype MFU comparisons rank utilization, not throughput rescaled
+# by one constant. Unknown kinds fall back to the v5e figure; override
+# with BENCH_PEAK_FLOPS.
 PEAK_FLOPS = {
     "TPU v4": 275e12,
     "TPU v5 lite": 197e12,
@@ -173,19 +180,20 @@ def run_bench():
     variables = init_params(model, batch)
     tx = select_optimizer(cfg["NeuralNetwork"]["Training"])
     state = TrainState.create(variables, tx)
-    # f32 compute: this workload is gather/scatter (HBM) bound, so bf16
-    # mixed precision (compute_dtype="bfloat16") measures within noise of f32
+    compute_dtype = os.environ.get("BENCH_DTYPE", "float32")
     train_step = make_train_step(model, mcfg, tx, loss_name="mae",
                                  compute_grad_energy=True, donate=False,
-                                 compute_dtype="float32")
+                                 compute_dtype=compute_dtype)
 
     # BENCH_STEPS_PER_CALL>1: scan S optimizer steps per device dispatch
     # (train_step.make_multi_train_step) — amortizes the ~2.4 ms per-call
     # tunnel dispatch latency. Same training math; throughput counts the
     # same BATCH_GRAPHS * STEPS graphs.
-    # per-backend default (see module docstring; measured in
-    # BENCH_SWEEP.json): 10 on CPU, 4 on TPU until the on-chip sweep lands
-    default_spc = "10" if backend.startswith("cpu") else "4"
+    # per-backend default (see module docstring): 10 on CPU
+    # (BENCH_SWEEP.json), 1 on TPU — the r3 on-chip sweep measured the
+    # scan path at half the spc=1 throughput (BENCH_SWEEP_TPU.json:
+    # 4429.6 vs 2194.4 g/s)
+    default_spc = "10" if backend.startswith("cpu") else "1"
     spc = min(int(os.environ.get("BENCH_STEPS_PER_CALL", default_spc)
                   or 0), STEPS)
     multi_step = None
@@ -194,7 +202,7 @@ def run_bench():
         from hydragnn_tpu.train.train_step import make_multi_train_step
         multi_step = make_multi_train_step(
             model, mcfg, tx, loss_name="mae", compute_grad_energy=True,
-            donate=False, compute_dtype="float32")
+            donate=False, compute_dtype=compute_dtype)
         stacked = _stack_batches([batch] * spc)
 
     flops_per_step = _step_flops(train_step, state, batch)
@@ -234,15 +242,23 @@ def run_bench():
         best_dt = dt if best_dt is None else min(best_dt, dt)
 
     gps = BATCH_GRAPHS * STEPS / best_dt
+    # REF_BASELINE_GPS anchors the default 32/80/128 shape only; with an
+    # overridden workload the ratio is not comparable, so report null and
+    # tag the shape instead (round-3 advisor finding)
+    default_shape = (BATCH_GRAPHS, NODES_PER_GRAPH, HIDDEN) == (32, 80, 128)
     out = {
         "metric": "graphs_per_sec_per_chip_oc20like_pna_ef_train",
         "value": round(gps, 2),
         "unit": "graphs/s",
-        "vs_baseline": round(gps / REF_BASELINE_GPS, 4),
+        "vs_baseline": round(gps / REF_BASELINE_GPS, 4) if default_shape
+        else None,
+        "shape": {"batch": BATCH_GRAPHS, "nodes": NODES_PER_GRAPH,
+                  "hidden": HIDDEN},
         "backend": backend,
         "nbr_layout": use_nbr,
         "steps_per_call": spc if spc > 1 else 1,
         "pallas": os.environ.get("HYDRAGNN_USE_PALLAS", "default"),
+        "dtype": compute_dtype,
     }
     if flops_per_step is not None:
         out["flops_per_step"] = flops_per_step
@@ -250,8 +266,11 @@ def run_bench():
         # invented CPU "peak" is noise (round-2 verdict, Weak #1)
         if not backend.startswith("cpu"):
             kind = jax.devices()[0].device_kind
-            peak = float(os.environ.get("BENCH_PEAK_FLOPS", 0)) or \
-                PEAK_FLOPS.get(kind, PEAK_FLOPS["TPU v5e"])
+            peak = float(os.environ.get("BENCH_PEAK_FLOPS", 0))
+            if not peak:  # table is bf16 peak; explicit override is taken
+                peak = PEAK_FLOPS.get(kind, PEAK_FLOPS["TPU v5e"])
+                if compute_dtype == "float32":
+                    peak /= 2.0
             achieved = flops_per_step * STEPS / best_dt
             out["mfu"] = round(achieved / peak, 5)
             out["peak_flops"] = peak

@@ -19,14 +19,20 @@ _PALLAS_STATE = {"checked": False, "on": False}
 
 
 def _use_pallas() -> bool:
-    """Route 2-D segment sums through the Pallas MXU kernel on TPU.
+    """Route 2-D segment sums through the Pallas MXU kernel.
 
-    Default: on for TPU backends (measured ~1.6x over the XLA scatter at
-    OC20-like shapes, see kernels/segment_pallas.py); off on CPU — pallas
-    CPU runs interpret mode only, and the r3 sweep measured it
-    pathologically slow there (every HYDRAGNN_USE_PALLAS=1 CPU grid
-    point timed out at 20 min vs ~40 g/s without, BENCH_SWEEP.json).
-    Override with HYDRAGNN_USE_PALLAS=0/1.
+    Default: OFF everywhere — adjudicated by the r3 on-chip integration
+    sweep (BENCH_SWEEP_TPU.json): end-to-end PNA energy-force training on
+    the v5e is slower with the kernel at every measured point (spc 1/4/10:
+    1106 vs 1135, 807 vs 1059, 865 vs 1017 g/s), despite the kernel-level
+    microbench win at OC20-like shapes (kernels/segment_pallas.py) — the
+    one-hot-matmul formulation adds FLOPs that XLA's fused scatter doesn't
+    pay, and the winning dense neighbor layout (graphs/batch.py
+    with_neighbor_format) bypasses the scatter entirely. On CPU pallas is
+    interpret-mode only and pathologically slow (r3 CPU sweep: every
+    HYDRAGNN_USE_PALLAS=1 grid point timed out at 20 min, BENCH_SWEEP.json).
+    The kernel stays available behind HYDRAGNN_USE_PALLAS=1 for shapes
+    where a future sweep shows an end-to-end win.
     """
     if not _PALLAS_STATE["checked"]:
         env = os.environ.get("HYDRAGNN_USE_PALLAS")
@@ -35,9 +41,7 @@ def _use_pallas() -> bool:
             _PALLAS_STATE["on"] = env.lower() not in (
                 "0", "false", "no", "off", "")
         else:
-            # the Mosaic kernel lowers only on TPU ("axon" is the tunneled
-            # TPU backend); GPU/CPU use the XLA scatter
-            _PALLAS_STATE["on"] = backend in ("tpu", "axon")
+            _PALLAS_STATE["on"] = False
         _PALLAS_STATE["interpret"] = backend == "cpu"
         _PALLAS_STATE["checked"] = True
     return _PALLAS_STATE["on"]

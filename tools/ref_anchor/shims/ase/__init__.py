@@ -1,0 +1,40 @@
+"""Minimal ase shim for the reference-anchor run.
+
+The reference imports ase for its PBC neighbor list
+(reference: hydragnn/preprocess/graph_samples_checks_and_updates.py:17-18,
+147-176) and for cfg/xyz file readers the anchor never touches. Atoms +
+neighbor_list implement the documented ase semantics in numpy; the io
+readers raise on use.
+"""
+import numpy as np
+
+from . import neighborlist  # noqa: F401
+
+
+class Atoms:
+    def __init__(self, symbols=None, positions=None, numbers=None,
+                 cell=None, pbc=False):
+        self.positions = np.asarray(positions, dtype=np.float64)
+        if cell is None:
+            cell_arr = np.zeros((3, 3))
+        else:
+            cell_arr = np.asarray(cell, dtype=np.float64)
+            if cell_arr.ndim == 1:
+                cell_arr = np.diag(cell_arr)
+        self.cell = cell_arr
+        self.pbc = np.asarray([pbc] * 3 if np.isscalar(pbc) else pbc,
+                              dtype=bool)
+        self.numbers = (np.asarray(numbers) if numbers is not None
+                        else np.ones(len(self.positions), dtype=int))
+
+    def __len__(self):
+        return len(self.positions)
+
+    def get_positions(self):
+        return self.positions
+
+    def get_cell(self):
+        return self.cell
+
+    def get_pbc(self):
+        return self.pbc

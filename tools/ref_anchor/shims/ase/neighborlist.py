@@ -1,0 +1,68 @@
+"""ase.neighborlist.neighbor_list shim: brute-force PBC neighbor list.
+
+Implements the documented quantities ("i", "j", "d", "S", "D") for
+orthorhombic-or-general 3x3 cells by scanning periodic images within the
+cutoff. Matches ase's convention: pairs (i, j) such that
+|pos[j] + S @ cell - pos[i]| < cutoff, each direction listed separately.
+"""
+import itertools
+
+import numpy as np
+
+
+def neighbor_list(quantities, a, cutoff, self_interaction=False):
+    pos = np.asarray(a.positions, dtype=np.float64)
+    cell = np.asarray(a.cell, dtype=np.float64)
+    pbc = np.asarray(a.pbc, dtype=bool)
+    n = len(pos)
+    cut = float(cutoff)
+
+    # how many image repeats are needed per axis to cover the cutoff
+    reps = []
+    for k in range(3):
+        if pbc[k] and np.linalg.norm(cell[k]) > 0:
+            # perpendicular height of the cell along axis k
+            normal = np.cross(cell[(k + 1) % 3], cell[(k + 2) % 3])
+            h = abs(np.dot(cell[k], normal)) / (np.linalg.norm(normal)
+                                                or 1.0)
+            reps.append(int(np.ceil(cut / h)) if h > 0 else 0)
+        else:
+            reps.append(0)
+
+    i_out, j_out, d_out, S_out, D_out = [], [], [], [], []
+    for sx, sy, sz in itertools.product(
+            range(-reps[0], reps[0] + 1),
+            range(-reps[1], reps[1] + 1),
+            range(-reps[2], reps[2] + 1)):
+        S = np.array([sx, sy, sz], dtype=np.float64)
+        shift = S @ cell
+        # D[i, j] = pos[j] + shift - pos[i]
+        D = pos[None, :, :] + shift[None, None, :] - pos[:, None, :]
+        dist = np.linalg.norm(D, axis=-1)
+        mask = dist < cut
+        if sx == 0 and sy == 0 and sz == 0 and not self_interaction:
+            np.fill_diagonal(mask, False)
+        ii, jj = np.nonzero(mask)
+        if len(ii) == 0:
+            continue
+        i_out.append(ii)
+        j_out.append(jj)
+        d_out.append(dist[ii, jj])
+        S_out.append(np.tile(S.astype(int), (len(ii), 1)))
+        D_out.append(D[ii, jj])
+
+    if i_out:
+        i_arr = np.concatenate(i_out)
+        j_arr = np.concatenate(j_out)
+        d_arr = np.concatenate(d_out)
+        S_arr = np.concatenate(S_out)
+        D_arr = np.concatenate(D_out)
+    else:
+        i_arr = np.zeros(0, dtype=int)
+        j_arr = np.zeros(0, dtype=int)
+        d_arr = np.zeros(0)
+        S_arr = np.zeros((0, 3), dtype=int)
+        D_arr = np.zeros((0, 3))
+
+    out = {"i": i_arr, "j": j_arr, "d": d_arr, "S": S_arr, "D": D_arr}
+    return tuple(out[q] for q in quantities)

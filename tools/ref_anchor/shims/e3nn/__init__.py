@@ -1,0 +1,17 @@
+"""e3nn import stub for the reference-anchor run.
+
+The reference's mace_utils modules import e3nn at module scope
+(reference: hydragnn/utils/model/mace_utils/modules/blocks.py:19-20), but
+the anchor never instantiates MACE. Attribute access yields permissive
+dummies so class definitions and annotations resolve; any actual call
+raises at use time.
+"""
+from . import o3, nn, util  # noqa: F401
+
+
+def get_optimization_defaults():
+    return {}
+
+
+def set_optimization_defaults(**kwargs):
+    pass

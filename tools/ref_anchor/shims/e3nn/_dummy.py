@@ -1,0 +1,15 @@
+class Dummy:
+    """Stands in for any e3nn symbol; raises only when actually used."""
+
+    def __init__(self, name="e3nn.?"):
+        self._name = name
+
+    def __call__(self, *a, **k):
+        raise NotImplementedError(
+            f"{self._name} is an anchor-shim stub (MACE not anchored)")
+
+    def __getattr__(self, item):
+        return Dummy(f"{self._name}.{item}")
+
+    def __repr__(self):
+        return f"<shim {self._name}>"

@@ -1,0 +1,5 @@
+from ._dummy import Dummy
+
+
+def __getattr__(name):
+    return Dummy(f"e3nn.nn.{name}")

@@ -1,0 +1,1 @@
+from . import codegen, jit  # noqa: F401

@@ -1,0 +1,2 @@
+class CodeGenMixin:
+    """Real mixin class so mace_utils classes can subclass it."""

@@ -1,0 +1,8 @@
+def compile_mode(mode):
+    def deco(cls):
+        return cls
+    return deco
+
+
+def simplify_if_compile(fn):
+    return fn

@@ -1,0 +1,97 @@
+"""mpi4py.MPI shim: world_size=1 — collectives are identities."""
+import copy
+
+SUM = "sum"
+MAX = "max"
+MIN = "min"
+LAND = "land"
+LOR = "lor"
+IN_PLACE = "in_place"
+ANY_SOURCE = -1
+ANY_TAG = -1
+
+
+class Comm:
+    def Get_rank(self):
+        return 0
+
+    def Get_size(self):
+        return 1
+
+    rank = property(lambda self: 0)
+    size = property(lambda self: 1)
+
+    def Barrier(self):
+        pass
+
+    barrier = Barrier
+
+    def bcast(self, obj, root=0):
+        return obj
+
+    def gather(self, obj, root=0):
+        return [obj]
+
+    def allgather(self, obj):
+        return [obj]
+
+    def allreduce(self, obj, op=SUM):
+        return copy.deepcopy(obj)
+
+    def reduce(self, obj, op=SUM, root=0):
+        return copy.deepcopy(obj)
+
+    def scatter(self, objs, root=0):
+        return objs[0]
+
+    def Bcast(self, buf, root=0):
+        pass
+
+    def Allreduce(self, sendbuf, recvbuf, op=SUM):
+        import numpy as np
+        if sendbuf is IN_PLACE or (isinstance(sendbuf, str)
+                                   and sendbuf == IN_PLACE):
+            return
+        np.copyto(np.asarray(recvbuf), np.asarray(sendbuf))
+
+    def Allgather(self, sendbuf, recvbuf):
+        import numpy as np
+        np.copyto(np.asarray(recvbuf), np.asarray(sendbuf))
+
+    def Split(self, color=0, key=0):
+        return Comm()
+
+    def Dup(self):
+        return Comm()
+
+    def Free(self):
+        pass
+
+    def py2f(self):
+        return 0
+
+    def abort(self, errorcode=1):
+        raise SystemExit(errorcode)
+
+    Abort = abort
+
+
+COMM_WORLD = Comm()
+COMM_SELF = Comm()
+
+
+def Init():
+    pass
+
+
+def Finalize():
+    pass
+
+
+def Is_initialized():
+    return True
+
+
+def Wtime():
+    import time
+    return time.time()

@@ -1,0 +1,22 @@
+"""Single-process mpi4py shim for the reference-anchor run.
+
+The reference imports mpi4py at module scope (examples/LennardJones/
+LennardJones.py:25-31, hydragnn/train/train_validate_test.py:36) but the
+anchor runs world_size=1, so every collective is an identity. Provides the
+rc knobs and the MPI submodule with a COMM_WORLD whose surface covers the
+calls the reference makes on the single-rank path.
+"""
+from . import MPI  # noqa: F401
+
+
+class _RC:
+    thread_level = "serialized"
+    threads = False
+    initialize = True
+    finalize = None
+
+    def __setattr__(self, k, v):  # accept any knob the reference sets
+        object.__setattr__(self, k, v)
+
+
+rc = _RC()

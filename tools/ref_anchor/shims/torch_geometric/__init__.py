@@ -1,0 +1,12 @@
+"""Minimal torch_geometric shim for the reference-anchor run.
+
+Implements — in plain torch, from the documented PyG 2.5 semantics — exactly
+the surface the reference HydraGNN imports (census: grep over
+/root/reference/hydragnn). This exists so the reference can run unmodified
+on this box (no egress, no compiled PyG wheels) and produce a genuine
+cross-framework accuracy anchor (round-3 verdict, Next #6). It is NOT a
+copy of pyg-team/pytorch_geometric.
+"""
+__version__ = "2.5.2-anchor-shim"
+
+from . import data, loader, nn, transforms, typing, utils  # noqa: F401

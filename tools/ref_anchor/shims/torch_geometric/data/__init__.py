@@ -1,0 +1,159 @@
+"""Data / Batch / (legacy) DataLoader for the anchor shim."""
+import copy
+
+import torch
+
+
+class Data:
+    """Attribute-dict graph container with the PyG conventions the
+    reference relies on: .num_nodes, `in` membership, .to(device),
+    .coalesce(), .clone(), iteration over (key, value) pairs."""
+
+    def __init__(self, x=None, edge_index=None, edge_attr=None, y=None,
+                 pos=None, **kwargs):
+        self.x = x
+        self.edge_index = edge_index
+        self.edge_attr = edge_attr
+        self.y = y
+        self.pos = pos
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    # -- PyG-style dict protocol ------------------------------------
+    @property
+    def keys(self):
+        return [k for k, v in self.__dict__.items()
+                if v is not None and not k.startswith("_")]
+
+    def __contains__(self, key):
+        return key in self.__dict__ and self.__dict__[key] is not None
+
+    def __getattr__(self, key):
+        # PyG raises for absent attrs — hasattr(data, "y_loc") probes
+        # (reference config_utils.py:167,186) rely on that
+        raise AttributeError(key)
+
+    def __getitem__(self, key):
+        return getattr(self, key)
+
+    def __setitem__(self, key, value):
+        setattr(self, key, value)
+
+    def __iter__(self):
+        for k in self.keys:
+            yield k, self.__dict__[k]
+
+    # -- shape helpers ----------------------------------------------
+    @property
+    def num_nodes(self):
+        if getattr(self, "_num_nodes", None) is not None:
+            return self._num_nodes
+        if self.x is not None:
+            return self.x.size(0)
+        if self.pos is not None:
+            return self.pos.size(0)
+        if self.edge_index is not None and self.edge_index.numel():
+            return int(self.edge_index.max()) + 1
+        return 0
+
+    @num_nodes.setter
+    def num_nodes(self, v):
+        self._num_nodes = v
+
+    @property
+    def num_edges(self):
+        return self.edge_index.size(1) if self.edge_index is not None else 0
+
+    @property
+    def num_node_features(self):
+        return self.x.size(1) if self.x is not None and self.x.dim() > 1 \
+            else 0
+
+    # -- ops ---------------------------------------------------------
+    def to(self, device, *args, **kwargs):
+        for k, v in list(self.__dict__.items()):
+            if torch.is_tensor(v):
+                self.__dict__[k] = v.to(device)
+        return self
+
+    def cpu(self):
+        return self.to("cpu")
+
+    def clone(self):
+        out = self.__class__()
+        for k, v in self.__dict__.items():
+            out.__dict__[k] = v.clone() if torch.is_tensor(v) \
+                else copy.deepcopy(v)
+        return out
+
+    def coalesce(self):
+        from ..utils import coalesce as _coalesce
+        if self.edge_index is not None:
+            self.edge_index, self.edge_attr = _coalesce(
+                self.edge_index, self.edge_attr, self.num_nodes)
+        return self
+
+    def __repr__(self):
+        fields = ", ".join(
+            f"{k}={list(v.shape)}" if torch.is_tensor(v) else f"{k}={v}"
+            for k, v in self.__dict__.items() if v is not None)
+        return f"Data({fields})"
+
+
+class Batch(Data):
+    """Concatenation of Data objects: node/edge tensors cat along dim 0,
+    edge_index offset per graph and cat along dim 1, plus .batch/.ptr."""
+
+    @classmethod
+    def from_data_list(cls, data_list):
+        batch = cls()
+        keys = set()
+        for d in data_list:
+            keys.update(k for k, _ in d)
+        keys.discard("edge_index")
+        out = {k: [] for k in keys}
+        edge_indices, batch_vec, ptr = [], [], [0]
+        offset = 0
+        for gi, d in enumerate(data_list):
+            n = d.num_nodes
+            if d.edge_index is not None:
+                edge_indices.append(d.edge_index + offset)
+            for k in keys:
+                v = getattr(d, k)
+                if v is None:
+                    out[k] = None
+                    continue
+                if out[k] is not None:
+                    out[k].append(v)
+            batch_vec.append(torch.full((n,), gi, dtype=torch.long))
+            offset += n
+            ptr.append(offset)
+        for k, vs in out.items():
+            if vs is None:
+                continue
+            if torch.is_tensor(vs[0]):
+                setattr(batch, k, torch.cat(vs, dim=0))
+            else:
+                setattr(batch, k, vs)
+        if edge_indices:
+            batch.edge_index = torch.cat(edge_indices, dim=1)
+        batch.batch = torch.cat(batch_vec) if batch_vec else None
+        batch.ptr = torch.tensor(ptr, dtype=torch.long)
+        batch._num_graphs = len(data_list)
+        return batch
+
+    @property
+    def num_graphs(self):
+        return self._num_graphs
+
+
+class Dataset(torch.utils.data.Dataset):
+    def __init__(self, root=None, transform=None, pre_transform=None):
+        self.root = root
+        self.transform = transform
+        self.pre_transform = pre_transform
+
+
+# legacy alias: PyG < 2.0 exposed DataLoader here
+# (reference: hydragnn/preprocess/load_data.py:21-24 try/except)
+from ..loader import DataLoader  # noqa: E402,F401

@@ -1,0 +1,15 @@
+import torch
+
+from ..data import Batch
+
+
+class Collater:
+    def __call__(self, data_list):
+        return Batch.from_data_list(data_list)
+
+
+class DataLoader(torch.utils.data.DataLoader):
+    def __init__(self, dataset, batch_size=1, shuffle=False, **kwargs):
+        kwargs.pop("collate_fn", None)
+        super().__init__(dataset, batch_size=batch_size, shuffle=shuffle,
+                         collate_fn=Collater(), **kwargs)

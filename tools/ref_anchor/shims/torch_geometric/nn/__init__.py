@@ -1,0 +1,9 @@
+from . import aggr, conv, dense, inits, models  # noqa: F401
+from .convs import (CGConv, GATv2Conv, GINConv, MFConv, PNAConv,  # noqa
+                    SAGEConv)
+from .dense.linear import Linear  # noqa: F401
+from .message_passing import MessagePassing  # noqa: F401
+from .pool import (BatchNorm, global_add_pool, global_max_pool,  # noqa
+                   global_mean_pool)
+from .resolver import activation_resolver  # noqa: F401
+from .sequential import Sequential  # noqa: F401

@@ -1,0 +1,78 @@
+"""DegreeScalerAggregation per the PNA formulation (documented in the
+PNA paper and PyG docs): multi-aggregate then degree-scale."""
+import torch
+
+import torch_scatter
+
+from ..inits import reset  # noqa: F401  (parity import)
+
+
+class DegreeScalerAggregation(torch.nn.Module):
+    def __init__(self, aggr, scaler, deg, train_norm=False,
+                 aggr_kwargs=None):
+        super().__init__()
+        self.aggrs = [aggr] if isinstance(aggr, str) else list(aggr)
+        self.scalers = [scaler] if isinstance(scaler, str) else list(scaler)
+        deg = deg.to(torch.float)
+        num_nodes = int(deg.sum())
+        bin_degrees = torch.arange(deg.numel(), dtype=torch.float)
+        # statistics over the training-set degree histogram
+        self.avg_deg_lin = float((bin_degrees * deg).sum()) / num_nodes
+        self.avg_deg_log = float(
+            ((bin_degrees + 1).log() * deg).sum()) / num_nodes
+        if train_norm:
+            self.avg_deg_log = torch.nn.Parameter(
+                torch.tensor(self.avg_deg_log))
+
+    def _one_aggr(self, x, index, dim_size, dim, kind):
+        if kind in ("sum", "add"):
+            return torch_scatter.scatter(x, index, dim=dim,
+                                         dim_size=dim_size, reduce="sum")
+        if kind == "mean":
+            return torch_scatter.scatter(x, index, dim=dim,
+                                         dim_size=dim_size, reduce="mean")
+        if kind == "min":
+            return torch_scatter.scatter(x, index, dim=dim,
+                                         dim_size=dim_size, reduce="min")
+        if kind == "max":
+            return torch_scatter.scatter(x, index, dim=dim,
+                                         dim_size=dim_size, reduce="max")
+        if kind in ("std", "var"):
+            mean = torch_scatter.scatter(x, index, dim=dim,
+                                         dim_size=dim_size, reduce="mean")
+            mean2 = torch_scatter.scatter(x * x, index, dim=dim,
+                                          dim_size=dim_size, reduce="mean")
+            var = (mean2 - mean * mean).clamp_(min=0)
+            return var if kind == "var" else (var + 1e-5).sqrt()
+        raise ValueError(f"unknown aggregator {kind!r}")
+
+    def forward(self, x, index, ptr=None, dim_size=None, dim=0):
+        if dim_size is None:
+            dim_size = int(index.max()) + 1 if index.numel() else 0
+        outs = [self._one_aggr(x, index, dim_size, dim, a)
+                for a in self.aggrs]
+        out = torch.cat(outs, dim=-1)
+
+        deg = torch.zeros(dim_size, dtype=x.dtype, device=x.device)
+        deg.scatter_add_(0, index, torch.ones_like(index, dtype=x.dtype))
+        deg = deg.clamp_(min=1)
+        shape = [1] * out.dim()
+        shape[dim] = -1
+        deg = deg.view(shape)
+        avg_log = self.avg_deg_log if not torch.is_tensor(self.avg_deg_log) \
+            else self.avg_deg_log
+        scaled = []
+        for s in self.scalers:
+            if s == "identity":
+                scaled.append(out)
+            elif s == "amplification":
+                scaled.append(out * ((deg + 1).log() / avg_log))
+            elif s == "attenuation":
+                scaled.append(out * (avg_log / (deg + 1).log()))
+            elif s == "linear":
+                scaled.append(out * (deg / self.avg_deg_lin))
+            elif s == "inverse_linear":
+                scaled.append(out * (self.avg_deg_lin / deg))
+            else:
+                raise ValueError(f"unknown scaler {s!r}")
+        return torch.cat(scaled, dim=-1)

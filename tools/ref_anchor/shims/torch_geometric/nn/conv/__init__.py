@@ -1,0 +1,1 @@
+from ..message_passing import MessagePassing  # noqa: F401

@@ -1,0 +1,207 @@
+"""Functional implementations of the stock PyG convs the reference's
+model zoo instantiates, written from their documented update rules."""
+from typing import Optional
+
+import torch
+import torch.nn.functional as F
+
+import torch_scatter
+
+from .dense.linear import Linear
+from .message_passing import MessagePassing
+from ..utils import degree, softmax
+
+
+class GINConv(MessagePassing):
+    """h_i' = nn((1 + eps) h_i + sum_j h_j)."""
+
+    def __init__(self, nn, eps=0.0, train_eps=False, **kwargs):
+        super().__init__(aggr="add", **kwargs)
+        self.nn = nn
+        if train_eps:
+            self.eps = torch.nn.Parameter(torch.tensor(float(eps)))
+        else:
+            self.register_buffer("eps", torch.tensor(float(eps)))
+
+    def forward(self, x, edge_index):
+        agg = self.propagate(edge_index, x=x)
+        return self.nn((1 + self.eps) * x + agg)
+
+
+class SAGEConv(MessagePassing):
+    """h_i' = W_l mean_j h_j + W_r h_i."""
+
+    def __init__(self, in_channels, out_channels, aggr="mean", **kwargs):
+        super().__init__(aggr=aggr, **kwargs)
+        if isinstance(in_channels, int):
+            in_channels = (in_channels, in_channels)
+        self.lin_l = Linear(in_channels[0], out_channels)
+        self.lin_r = Linear(in_channels[1], out_channels)
+
+    def forward(self, x, edge_index):
+        agg = self.propagate(edge_index, x=x)
+        return self.lin_l(agg) + self.lin_r(x)
+
+
+class MFConv(MessagePassing):
+    """Duvenaud fingerprint conv: per-degree weight matrices."""
+
+    def __init__(self, in_channels, out_channels, max_degree=10, **kwargs):
+        super().__init__(aggr="add", **kwargs)
+        self.max_degree = max_degree
+        self.lins_l = torch.nn.ModuleList(
+            [Linear(in_channels, out_channels) for _ in
+             range(max_degree + 1)])
+        self.lins_r = torch.nn.ModuleList(
+            [Linear(in_channels, out_channels, bias=False) for _ in
+             range(max_degree + 1)])
+
+    def forward(self, x, edge_index):
+        agg = self.propagate(edge_index, x=x)
+        deg = degree(edge_index[1], x.size(0),
+                     dtype=torch.long).clamp_(max=self.max_degree)
+        out = x.new_zeros(x.size(0), self.lins_l[0].out_channels)
+        for d in range(self.max_degree + 1):
+            mask = deg == d
+            if mask.any():
+                out[mask] = self.lins_l[d](x[mask]) + \
+                    self.lins_r[d](agg[mask])
+        return out
+
+
+class CGConv(MessagePassing):
+    """Crystal-graph conv: x_i + sum_j sigma(W_f z) * g(W_s z)."""
+
+    def __init__(self, channels, dim=0, aggr="add", batch_norm=False,
+                 **kwargs):
+        super().__init__(aggr=aggr, **kwargs)
+        if isinstance(channels, int):
+            channels = (channels, channels)
+        self.channels = channels
+        self.lin_f = Linear(sum(channels) + dim, channels[1])
+        self.lin_s = Linear(sum(channels) + dim, channels[1])
+        self.bn = torch.nn.BatchNorm1d(channels[1]) if batch_norm else None
+
+    def forward(self, x, edge_index, edge_attr=None):
+        agg = self.propagate(edge_index, x=x, edge_attr=edge_attr)
+        if self.bn is not None:
+            agg = self.bn(agg)
+        return x + agg
+
+    def message(self, x_i, x_j, edge_attr=None):
+        z = torch.cat([x_i, x_j] +
+                      ([edge_attr] if edge_attr is not None else []),
+                      dim=-1)
+        return torch.sigmoid(self.lin_f(z)) * F.softplus(self.lin_s(z))
+
+
+class GATv2Conv(MessagePassing):
+    """GATv2 attention conv (dynamic attention variant)."""
+
+    def __init__(self, in_channels, out_channels, heads=1, concat=True,
+                 negative_slope=0.2, dropout=0.0, add_self_loops=True,
+                 edge_dim=None, fill_value="mean", bias=True,
+                 share_weights=False, **kwargs):
+        super().__init__(aggr="add", **kwargs)
+        self.heads = heads
+        self.out_channels = out_channels
+        self.concat = concat
+        self.negative_slope = negative_slope
+        self.dropout = dropout
+        self.add_self_loops = add_self_loops
+        if isinstance(in_channels, int):
+            in_channels = (in_channels, in_channels)
+        self.lin_l = Linear(in_channels[0], heads * out_channels)
+        self.lin_r = self.lin_l if share_weights else \
+            Linear(in_channels[1], heads * out_channels)
+        self.att = torch.nn.Parameter(torch.empty(1, heads, out_channels))
+        self.lin_edge = Linear(edge_dim, heads * out_channels, bias=False) \
+            if edge_dim is not None else None
+        out_dim = heads * out_channels if concat else out_channels
+        self.bias = torch.nn.Parameter(torch.zeros(out_dim)) if bias \
+            else None
+        torch.nn.init.xavier_uniform_(self.att)
+
+    def forward(self, x, edge_index, edge_attr=None):
+        from ..utils import add_self_loops as _asl
+        n = x.size(0)
+        if self.add_self_loops:
+            edge_index, edge_attr = _asl(edge_index, edge_attr,
+                                         num_nodes=n)
+        h_l = self.lin_l(x).view(n, self.heads, self.out_channels)
+        h_r = self.lin_r(x).view(n, self.heads, self.out_channels)
+        src, dst = edge_index[0], edge_index[1]
+        z = h_l[src] + h_r[dst]
+        if edge_attr is not None and self.lin_edge is not None:
+            ea = edge_attr.view(-1, 1) if edge_attr.dim() == 1 else \
+                edge_attr
+            z = z + self.lin_edge(ea).view(-1, self.heads,
+                                           self.out_channels)
+        z = F.leaky_relu(z, self.negative_slope)
+        alpha = (z * self.att).sum(dim=-1)
+        alpha = softmax(alpha, dst, num_nodes=n)
+        alpha = F.dropout(alpha, p=self.dropout, training=self.training)
+        out = h_l[src] * alpha.unsqueeze(-1)
+        out = torch_scatter.scatter(out, dst, dim=0, dim_size=n,
+                                    reduce="sum")
+        out = out.reshape(n, self.heads * self.out_channels) if \
+            self.concat else out.mean(dim=1)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class PNAConv(MessagePassing):
+    """Stock PNA conv (towers + degree-scaled multi-aggregation)."""
+
+    def __init__(self, in_channels, out_channels, aggregators, scalers,
+                 deg, edge_dim=None, towers=1, pre_layers=1, post_layers=1,
+                 divide_input=False, act="relu", act_kwargs=None,
+                 train_norm=False, **kwargs):
+        from .aggr import DegreeScalerAggregation
+        from .resolver import activation_resolver
+        aggr = DegreeScalerAggregation(aggregators, scalers, deg,
+                                       train_norm)
+        super().__init__(aggr=aggr, node_dim=0, **kwargs)
+        self.towers = towers
+        self.divide_input = divide_input
+        self.F_in = in_channels // towers if divide_input else in_channels
+        self.F_out = out_channels // towers
+        self.edge_dim = edge_dim
+        self.pre_nns = torch.nn.ModuleList()
+        self.post_nns = torch.nn.ModuleList()
+        for _ in range(towers):
+            ms = [Linear((3 if edge_dim is not None else 2) * self.F_in,
+                         self.F_in)]
+            for _ in range(pre_layers - 1):
+                ms += [activation_resolver(act, **(act_kwargs or {})),
+                       Linear(self.F_in, self.F_in)]
+            self.pre_nns.append(torch.nn.Sequential(*ms))
+            in_ch = (len(aggr.aggrs) * len(aggr.scalers) + 1) * self.F_in
+            ms = [Linear(in_ch, self.F_out)]
+            for _ in range(post_layers - 1):
+                ms += [activation_resolver(act, **(act_kwargs or {})),
+                       Linear(self.F_out, self.F_out)]
+            self.post_nns.append(torch.nn.Sequential(*ms))
+        self.lin = Linear(out_channels, out_channels)
+        self.edge_encoder = Linear(edge_dim, self.F_in) \
+            if edge_dim is not None else None
+
+    def forward(self, x, edge_index, edge_attr=None):
+        if self.divide_input:
+            x = x.view(-1, self.towers, self.F_in)
+        else:
+            x = x.view(-1, 1, self.F_in).repeat(1, self.towers, 1)
+        out = self.propagate(edge_index, x=x, edge_attr=edge_attr)
+        out = torch.cat([x, out], dim=-1)
+        outs = [nn(out[:, i]) for i, nn in enumerate(self.post_nns)]
+        return self.lin(torch.cat(outs, dim=1))
+
+    def message(self, x_i, x_j, edge_attr: Optional[torch.Tensor] = None):
+        h = torch.cat([x_i, x_j], dim=-1)
+        if edge_attr is not None and self.edge_encoder is not None:
+            ea = self.edge_encoder(edge_attr)
+            ea = ea.view(-1, 1, self.F_in).repeat(1, self.towers, 1)
+            h = torch.cat([h, ea], dim=-1)
+        hs = [nn(h[:, i]) for i, nn in enumerate(self.pre_nns)]
+        return torch.stack(hs, dim=1)

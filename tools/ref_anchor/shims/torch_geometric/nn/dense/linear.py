@@ -1,0 +1,47 @@
+"""PyG-style Linear with lazy in_channels=-1 support."""
+import math
+
+import torch
+
+
+class Linear(torch.nn.Module):
+    def __init__(self, in_channels, out_channels, bias=True,
+                 weight_initializer=None, bias_initializer=None):
+        super().__init__()
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        if in_channels > 0:
+            self.weight = torch.nn.Parameter(
+                torch.empty(out_channels, in_channels))
+        else:
+            self.weight = torch.nn.parameter.UninitializedParameter()
+            self._hook = self.register_forward_pre_hook(self._lazy_init)
+        self.bias = torch.nn.Parameter(torch.empty(out_channels)) if bias \
+            else None
+        if in_channels > 0:
+            self.reset_parameters()
+
+    def _lazy_init(self, module, inputs):
+        if isinstance(self.weight, torch.nn.parameter.UninitializedParameter):
+            self.in_channels = inputs[0].shape[-1]
+            self.weight.materialize((self.out_channels, self.in_channels))
+            self.reset_parameters()
+            self._hook.remove()
+
+    def reset_parameters(self):
+        if isinstance(self.weight, torch.nn.parameter.UninitializedParameter):
+            return
+        # glorot (PyG's default weight_initializer for dense.Linear)
+        fan = self.in_channels + self.out_channels
+        std = math.sqrt(6.0 / fan)
+        with torch.no_grad():
+            self.weight.uniform_(-std, std)
+            if self.bias is not None:
+                self.bias.zero_()
+
+    def forward(self, x):
+        return torch.nn.functional.linear(x, self.weight, self.bias)
+
+    def __repr__(self):
+        return (f"Linear({self.in_channels}, {self.out_channels}, "
+                f"bias={self.bias is not None})")

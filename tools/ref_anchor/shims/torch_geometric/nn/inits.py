@@ -1,0 +1,25 @@
+import math
+
+import torch
+
+
+def reset(value):
+    if hasattr(value, "reset_parameters"):
+        value.reset_parameters()
+    else:
+        for child in getattr(value, "children", lambda: [])():
+            reset(child)
+
+
+def glorot(tensor):
+    if tensor is not None:
+        fan = tensor.size(-2) + tensor.size(-1)
+        std = math.sqrt(6.0 / fan)
+        with torch.no_grad():
+            tensor.uniform_(-std, std)
+
+
+def zeros(tensor):
+    if tensor is not None:
+        with torch.no_grad():
+            tensor.zero_()

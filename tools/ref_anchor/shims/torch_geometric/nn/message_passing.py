@@ -1,0 +1,80 @@
+"""MessagePassing base implementing the documented PyG propagate flow
+for the patterns the reference uses (suffix gather _i/_j, str or
+Aggregation-module aggr, node_dim=0)."""
+import inspect
+
+import torch
+
+import torch_scatter
+
+
+class MessagePassing(torch.nn.Module):
+    def __init__(self, aggr="add", flow="source_to_target", node_dim=0,
+                 **kwargs):
+        super().__init__()
+        self.aggr = aggr
+        self.flow = flow
+        self.node_dim = node_dim
+        self._msg_params = None
+
+    def reset_parameters(self):
+        pass
+
+    # -- flow --------------------------------------------------------
+    def propagate(self, edge_index, size=None, **kwargs):
+        if self.flow == "source_to_target":
+            src_idx, dst_idx = edge_index[0], edge_index[1]
+        else:
+            src_idx, dst_idx = edge_index[1], edge_index[0]
+
+        dim_size = None
+        if size is not None:
+            dim_size = size[1] if size[1] is not None else size[0]
+        if dim_size is None:
+            for v in kwargs.values():
+                if torch.is_tensor(v) and v.dim() > self.node_dim:
+                    dim_size = v.size(self.node_dim)
+                    break
+        if dim_size is None:
+            dim_size = int(dst_idx.max()) + 1 if dst_idx.numel() else 0
+
+        if self._msg_params is None:
+            self._msg_params = list(
+                inspect.signature(self.message).parameters.values())
+        msg_kwargs = {}
+        for p in self._msg_params:
+            name = p.name
+            if name.endswith("_i") or name.endswith("_j"):
+                base = name[:-2]
+                val = kwargs.get(base)
+                if val is None:
+                    if p.default is not inspect.Parameter.empty:
+                        msg_kwargs[name] = p.default
+                    continue
+                idx = dst_idx if name.endswith("_i") else src_idx
+                msg_kwargs[name] = val.index_select(self.node_dim, idx)
+            elif name == "index":
+                msg_kwargs[name] = dst_idx
+            elif name == "edge_index":
+                msg_kwargs[name] = edge_index
+            elif name in kwargs:
+                msg_kwargs[name] = kwargs[name]
+        out = self.message(**msg_kwargs)
+        out = self.aggregate(out, dst_idx, dim_size=dim_size)
+        return self.update(out)
+
+    def message(self, x_j):
+        return x_j
+
+    def aggregate(self, inputs, index, dim_size=None):
+        if not isinstance(self.aggr, str) and self.aggr is not None:
+            # an Aggregation module (e.g. DegreeScalerAggregation)
+            return self.aggr(inputs, index, dim_size=dim_size,
+                             dim=self.node_dim)
+        reduce = {"add": "sum", "sum": "sum", "mean": "mean",
+                  "max": "max", "min": "min"}[self.aggr or "add"]
+        return torch_scatter.scatter(inputs, index, dim=self.node_dim,
+                                     dim_size=dim_size, reduce=reduce)
+
+    def update(self, inputs):
+        return inputs

@@ -1,0 +1,1 @@
+from . import dimenet, schnet  # noqa: F401

@@ -1,0 +1,61 @@
+"""DimeNet basis layers. BesselBasisLayer/Envelope are implemented (the
+reference's PNAPlus uses the Bessel basis, PNAPlusStack.py:32); the
+spherical/PP blocks exist for import parity and raise at init — the
+anchor does not run DimeNet."""
+import math
+
+import torch
+
+
+class Envelope(torch.nn.Module):
+    def __init__(self, exponent):
+        super().__init__()
+        p = exponent + 1
+        self.p = p
+        self.a = -(p + 1) * (p + 2) / 2
+        self.b = p * (p + 2)
+        self.c = -p * (p + 1) / 2
+
+    def forward(self, x):
+        p, a, b, c = self.p, self.a, self.b, self.c
+        x_pow_p0 = x.pow(p - 1)
+        x_pow_p1 = x_pow_p0 * x
+        x_pow_p2 = x_pow_p1 * x
+        env = 1.0 / x + a * x_pow_p0 + b * x_pow_p1 + c * x_pow_p2
+        return torch.where(x < 1.0, env, torch.zeros_like(x))
+
+
+class BesselBasisLayer(torch.nn.Module):
+    def __init__(self, num_radial, cutoff=5.0, envelope_exponent=5):
+        super().__init__()
+        self.cutoff = cutoff
+        self.envelope = Envelope(envelope_exponent)
+        self.freq = torch.nn.Parameter(
+            math.pi * torch.arange(1, num_radial + 1, dtype=torch.float))
+
+    def reset_parameters(self):
+        with torch.no_grad():
+            self.freq.copy_(math.pi * torch.arange(
+                1, self.freq.numel() + 1, dtype=torch.float))
+
+    def forward(self, dist):
+        dist = dist.unsqueeze(-1) / self.cutoff
+        return self.envelope(dist) * (self.freq * dist).sin()
+
+
+class SphericalBasisLayer(torch.nn.Module):
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "SphericalBasisLayer not in anchor shim (DimeNet not anchored)")
+
+
+class InteractionPPBlock(torch.nn.Module):
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "InteractionPPBlock not in anchor shim (DimeNet not anchored)")
+
+
+class OutputPPBlock(torch.nn.Module):
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "OutputPPBlock not in anchor shim (DimeNet not anchored)")

@@ -1,0 +1,47 @@
+import torch
+
+import torch_scatter
+
+
+def global_mean_pool(x, batch, size=None):
+    if batch is None:
+        return x.mean(dim=0, keepdim=True)
+    size = size or (int(batch.max()) + 1 if batch.numel() else 0)
+    return torch_scatter.scatter(x, batch, dim=0, dim_size=size,
+                                 reduce="mean")
+
+
+def global_add_pool(x, batch, size=None):
+    if batch is None:
+        return x.sum(dim=0, keepdim=True)
+    size = size or (int(batch.max()) + 1 if batch.numel() else 0)
+    return torch_scatter.scatter(x, batch, dim=0, dim_size=size,
+                                 reduce="sum")
+
+
+def global_max_pool(x, batch, size=None):
+    if batch is None:
+        return x.max(dim=0, keepdim=True).values
+    size = size or (int(batch.max()) + 1 if batch.numel() else 0)
+    return torch_scatter.scatter(x, batch, dim=0, dim_size=size,
+                                 reduce="max")
+
+
+class BatchNorm(torch.nn.Module):
+    def __init__(self, in_channels, eps=1e-5, momentum=0.1, affine=True,
+                 track_running_stats=True, allow_single_element=False):
+        super().__init__()
+        self.module = torch.nn.BatchNorm1d(in_channels, eps, momentum,
+                                           affine, track_running_stats)
+        self.allow_single_element = allow_single_element
+
+    def reset_parameters(self):
+        self.module.reset_parameters()
+
+    def forward(self, x):
+        if self.allow_single_element and x.size(0) <= 1:
+            return torch.nn.functional.batch_norm(
+                x, self.module.running_mean, self.module.running_var,
+                self.module.weight, self.module.bias, False,
+                0.0, self.module.eps)
+        return self.module(x)

@@ -1,0 +1,31 @@
+import torch
+
+
+_ACTS = {
+    "relu": torch.nn.ReLU,
+    "elu": torch.nn.ELU,
+    "leaky_relu": torch.nn.LeakyReLU,
+    "leakyrelu": torch.nn.LeakyReLU,
+    "prelu": torch.nn.PReLU,
+    "sigmoid": torch.nn.Sigmoid,
+    "tanh": torch.nn.Tanh,
+    "gelu": torch.nn.GELU,
+    "silu": torch.nn.SiLU,
+    "swish": torch.nn.SiLU,
+    "softplus": torch.nn.Softplus,
+    "identity": torch.nn.Identity,
+}
+
+
+def activation_resolver(query="relu", *args, **kwargs):
+    if query is None:
+        return torch.nn.Identity()
+    if isinstance(query, torch.nn.Module):
+        return query
+    if callable(query) and not isinstance(query, str):
+        return query(*args, **kwargs) if isinstance(query, type) else query
+    name = query.lower().replace("_", "")
+    for key, cls in _ACTS.items():
+        if key.replace("_", "") == name:
+            return cls(*args, **kwargs)
+    raise ValueError(f"unknown activation {query!r}")

@@ -1,0 +1,46 @@
+"""PyG's string-signature Sequential DSL, e.g.
+Sequential("x, pos, batch", [(mod, "pos, batch -> edge_index, w"), ...]).
+A bare *args form (Sequential(mod1, mod2)) degrades to torch Sequential —
+the reference's CFConv builds its coord_mlp that way."""
+import torch
+
+
+def _split(sig):
+    return [s.strip() for s in sig.split(",") if s.strip()]
+
+
+class Sequential(torch.nn.Module):
+    def __new__(cls, *args, **kwargs):
+        if args and not isinstance(args[0], str):
+            return torch.nn.Sequential(*args)
+        return super().__new__(cls)
+
+    def __init__(self, input_args, modules):
+        super().__init__()
+        self._input_names = _split(input_args)
+        self._steps = []
+        for i, entry in enumerate(modules):
+            if isinstance(entry, (tuple, list)):
+                fn, sig = entry
+                ins, outs = [s.strip() for s in sig.split("->")]
+                in_names, out_names = _split(ins), _split(outs)
+            else:
+                fn = entry
+                in_names, out_names = ["__prev__"], ["__prev__"]
+            if isinstance(fn, torch.nn.Module):
+                self.add_module(f"step_{i}", fn)
+            self._steps.append((fn, in_names, out_names))
+
+    def forward(self, *args, **kwargs):
+        env = dict(zip(self._input_names, args))
+        env.update(kwargs)
+        out = args[-1] if args else None
+        for fn, in_names, out_names in self._steps:
+            ins = [env[n] if n != "__prev__" else out for n in in_names]
+            out = fn(*ins)
+            if len(out_names) == 1:
+                env[out_names[0]] = out
+            else:
+                for n, v in zip(out_names, out):
+                    env[n] = v
+        return out

@@ -1,0 +1,93 @@
+import torch
+
+
+class BaseTransform:
+    def __call__(self, data):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}()"
+
+
+class RadiusGraph(BaseTransform):
+    """Non-PBC radius graph (PyG semantics: edges j->i for all pairs
+    within r, excluding self loops unless loop=True). Brute force —
+    anchor graphs are small."""
+
+    def __init__(self, r, loop=False, max_num_neighbors=32,
+                 flow="source_to_target"):
+        self.r = r
+        self.loop = loop
+        self.max_num_neighbors = max_num_neighbors
+        self.flow = flow
+
+    def __call__(self, data):
+        pos = data.pos
+        n = pos.size(0)
+        d = torch.cdist(pos, pos)
+        mask = d < self.r
+        if not self.loop:
+            mask.fill_diagonal_(False)
+        # cap neighbors per target node
+        if n > self.max_num_neighbors:
+            dm = torch.where(mask, d, torch.full_like(d, float("inf")))
+            keep_rank = dm.argsort(dim=1).argsort(dim=1)
+            mask &= keep_rank < self.max_num_neighbors
+        tgt, src = torch.nonzero(mask, as_tuple=True)
+        data.edge_index = torch.stack([src, tgt], dim=0)
+        data.edge_attr = None
+        return data
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}(r={self.r})"
+
+
+class Distance(BaseTransform):
+    def __init__(self, norm=True, max_value=None, cat=True):
+        self.norm = norm
+        self.max_value = max_value
+        self.cat = cat
+
+    def __call__(self, data):
+        row, col = data.edge_index
+        dist = (data.pos[col] - data.pos[row]).norm(p=2, dim=-1).view(-1, 1)
+        if self.norm and dist.numel() > 0:
+            dist = dist / (self.max_value or dist.max())
+        if data.edge_attr is not None and self.cat:
+            ea = data.edge_attr
+            ea = ea.view(-1, 1) if ea.dim() == 1 else ea
+            data.edge_attr = torch.cat([ea, dist.type_as(ea)], dim=-1)
+        else:
+            data.edge_attr = dist
+        return data
+
+
+class NormalizeRotation(BaseTransform):
+    def __init__(self, max_points=-1, sort=False):
+        self.max_points = max_points
+        self.sort = sort
+
+    def __call__(self, data):
+        pos = data.pos
+        mean = pos.mean(dim=0, keepdim=True)
+        centered = pos - mean
+        _, _, v = torch.linalg.svd(centered)
+        data.pos = centered @ v.T
+        if getattr(data, "norm", None) is not None:
+            data.norm = data.norm @ v.T
+        return data
+
+
+class Spherical(BaseTransform):
+    def __call__(self, data):
+        raise NotImplementedError("Spherical transform not in anchor shim")
+
+
+class PointPairFeatures(BaseTransform):
+    def __call__(self, data):
+        raise NotImplementedError("PointPairFeatures not in anchor shim")
+
+
+class LocalCartesian(BaseTransform):
+    def __call__(self, data):
+        raise NotImplementedError("LocalCartesian not in anchor shim")

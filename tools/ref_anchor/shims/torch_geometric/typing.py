@@ -1,0 +1,15 @@
+from typing import Optional
+
+import torch
+
+Adj = torch.Tensor
+OptTensor = Optional[torch.Tensor]
+PairTensor = tuple
+OptPairTensor = tuple
+
+
+class SparseTensor:
+    """Placeholder: the reference only references this in type hints."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError("SparseTensor not available in shim")

@@ -1,0 +1,68 @@
+import torch
+
+import torch_scatter
+
+
+def scatter(src, index, dim=0, dim_size=None, reduce="sum"):
+    return torch_scatter.scatter(src, index, dim=dim, dim_size=dim_size,
+                                 reduce=reduce)
+
+
+def degree(index, num_nodes=None, dtype=None):
+    if num_nodes is None:
+        num_nodes = int(index.max()) + 1 if index.numel() else 0
+    out = torch.zeros(num_nodes, dtype=dtype or torch.long,
+                      device=index.device)
+    ones = torch.ones(index.numel(), dtype=out.dtype, device=index.device)
+    return out.scatter_add_(0, index, ones)
+
+
+def remove_self_loops(edge_index, edge_attr=None):
+    mask = edge_index[0] != edge_index[1]
+    edge_index = edge_index[:, mask]
+    if edge_attr is not None:
+        edge_attr = edge_attr[mask]
+    return edge_index, edge_attr
+
+
+def add_self_loops(edge_index, edge_attr=None, fill_value=None,
+                   num_nodes=None):
+    if num_nodes is None:
+        num_nodes = int(edge_index.max()) + 1 if edge_index.numel() else 0
+    loops = torch.arange(num_nodes, device=edge_index.device)
+    loop_index = torch.stack([loops, loops], dim=0)
+    edge_index = torch.cat([edge_index, loop_index], dim=1)
+    if edge_attr is not None:
+        fill = torch.full((num_nodes,) + edge_attr.shape[1:],
+                          float(fill_value or 0.0), dtype=edge_attr.dtype,
+                          device=edge_attr.device)
+        edge_attr = torch.cat([edge_attr, fill], dim=0)
+    return edge_index, edge_attr
+
+
+def softmax(src, index, ptr=None, num_nodes=None, dim=0):
+    """Edge-softmax grouped by index (used by attention convs)."""
+    if num_nodes is None:
+        num_nodes = int(index.max()) + 1 if index.numel() else 0
+    src_max = torch_scatter.scatter(src.detach(), index, dim=dim,
+                                    dim_size=num_nodes, reduce="max")
+    out = src - src_max.index_select(dim, index)
+    out = out.exp()
+    out_sum = torch_scatter.scatter(out, index, dim=dim,
+                                    dim_size=num_nodes, reduce="sum")
+    return out / (out_sum.index_select(dim, index) + 1e-16)
+
+
+def coalesce(edge_index, edge_attr=None, num_nodes=None):
+    if num_nodes is None:
+        num_nodes = int(edge_index.max()) + 1 if edge_index.numel() else 0
+    key = edge_index[0] * num_nodes + edge_index[1]
+    order = torch.argsort(key)
+    key = key[order]
+    keep = torch.ones_like(key, dtype=torch.bool)
+    keep[1:] = key[1:] != key[:-1]
+    perm = order[keep]
+    edge_index = edge_index[:, perm]
+    if edge_attr is not None:
+        edge_attr = edge_attr[perm]
+    return edge_index, edge_attr

@@ -1,0 +1,65 @@
+"""Minimal torch_scatter shim for the reference-anchor run.
+
+Implements exactly the surface the reference HydraGNN uses
+(reference: hydragnn/models/Base.py:18, EGCLStack.py, utils/model/model.py)
+on top of torch.scatter_reduce — no compiled extension. Written from the
+documented torch_scatter semantics; NOT a copy of the rusty1s package.
+"""
+import torch
+
+
+def _broadcast(index, src, dim):
+    if index.dim() == 1 and src.dim() > 1:
+        shape = [1] * src.dim()
+        shape[dim] = src.shape[dim]
+        index = index.view(shape).expand_as(src)
+    return index
+
+
+def scatter(src, index, dim=0, out=None, dim_size=None, reduce="sum"):
+    if dim < 0:
+        dim = src.dim() + dim
+    if dim_size is None:
+        dim_size = int(index.max()) + 1 if index.numel() else 0
+    reduce_map = {"sum": "sum", "add": "sum", "mean": "mean",
+                  "max": "amax", "min": "amin", "mul": "prod"}
+    tr = reduce_map[reduce]
+    shape = list(src.shape)
+    shape[dim] = dim_size
+    idx = _broadcast(index, src, dim)
+    if out is None:
+        out = torch.zeros(shape, dtype=src.dtype, device=src.device)
+        result = out.scatter_reduce(dim, idx, src, tr, include_self=False)
+    else:
+        result = out.scatter_reduce(dim, idx, src, tr, include_self=True)
+    if reduce in ("max", "min"):
+        # torch_scatter fills empty segments with 0, scatter_reduce with
+        # +/-inf identity when include_self=False; normalize to 0
+        counts = torch.zeros(dim_size, dtype=torch.long, device=src.device)
+        counts.scatter_add_(0, index, torch.ones_like(index))
+        empty = counts == 0
+        if empty.any():
+            sel = [slice(None)] * result.dim()
+            sel[dim] = empty
+            result[tuple(sel)] = 0
+    return result
+
+
+def scatter_add(src, index, dim=0, out=None, dim_size=None):
+    return scatter(src, index, dim=dim, out=out, dim_size=dim_size,
+                   reduce="sum")
+
+
+def scatter_mean(src, index, dim=0, out=None, dim_size=None):
+    return scatter(src, index, dim=dim, out=out, dim_size=dim_size,
+                   reduce="mean")
+
+
+def scatter_max(src, index, dim=0, out=None, dim_size=None):
+    return scatter(src, index, dim=dim, out=out, dim_size=dim_size,
+                   reduce="max")
+
+
+def scatter_min(src, index, dim=0, out=None, dim_size=None):
+    return scatter(src, index, dim=dim, out=out, dim_size=dim_size,
+                   reduce="min")
