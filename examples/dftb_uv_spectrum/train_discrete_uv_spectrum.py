@@ -9,5 +9,8 @@ sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
 from examples.dftb_uv_spectrum.train_uv_spectrum import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.argv.insert(1, "--mode=discrete")
+    # append so the pin wins: argparse takes the LAST occurrence, so a
+    # user-supplied --mode would otherwise silently override the pin
+    # (r3 advisor)
+    sys.argv.append("--mode=discrete")
     main()

@@ -94,7 +94,19 @@ def _load_member(name: str, here: str, limit: int):
     if name == "qm7x":
         from examples.qm7x.qm7x_data import generate_qm7x_dataset, load_qm7x
         import glob
-        d = _member_dir(here, "qm7x", "qm7x", "*.hdf5")
+        # the qm7x downloader's canonical layout is dataset/qm7x/*.hdf5
+        # (examples/qm7x/train.py:59) — one level deeper than the other
+        # members' example_dir. Keep _member_dir's contract: real files
+        # in the multidataset-local FLAT layout still win over the qm7x
+        # example's downloaded corpus.
+        local = os.path.join(here, "dataset", "qm7x")
+        example_deep = os.path.join(os.path.dirname(here), "qm7x",
+                                    "dataset", "qm7x")
+        if not glob.glob(os.path.join(local, "*.hdf5")) and \
+                glob.glob(os.path.join(example_deep, "*.hdf5")):
+            d = example_deep
+        else:
+            d = local
         if not glob.glob(os.path.join(d, "*.hdf5")) and \
                 not glob.glob(os.path.join(d, "synthetic", "*.hdf5")):
             generate_qm7x_dataset(d)

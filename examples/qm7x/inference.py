@@ -72,7 +72,14 @@ def main():
     log_name = get_log_name_config(config)
     have_ckpt = os.path.isdir(_ckpt_dir(log_name))
     if args.train or not have_ckpt:
-        run_training(dict(config), datasets=splits)
+        # run_training only writes checkpoints when Training.Checkpoint
+        # is set (run_training.py), and the qm7x configs don't set it —
+        # without this, the restore below finds no checkpoint (r3
+        # advisor, high). The reference's run_training saves
+        # unconditionally (reference run_training.py:180).
+        train_config = json.loads(json.dumps(config))
+        train_config["NeuralNetwork"]["Training"]["Checkpoint"] = True
+        run_training(train_config, datasets=splits)
 
     # state=None -> run_prediction restores the best-val checkpoint
     trues, preds = run_prediction(dict(config), datasets=splits)

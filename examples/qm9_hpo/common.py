@@ -1,0 +1,89 @@
+"""Shared plumbing for the qm9_hpo entry points.
+
+The reference ships three HPO drivers over the same QM9 objective —
+qm9_optuna.py (optuna TPE), qm9_deephyper.py (DeepHyper CBO, in-process
+evaluator), qm9_deephyper_multi.py (DeepHyper CBO, srun subprocess per
+trial). The TPU counterparts (qm9_optuna.py / qm9_deephyper.py /
+qm9_deephyper_multi.py here) share this module: config+data loading and
+the trial objective are identical across drivers, only the search
+strategy differs.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# reference search space (qm9_optuna.py:52-58: model_type categorical,
+# hidden_dim, num_conv_layers, head depth/width), bounded to CI scale
+SPACE = {
+    "model_type": ["EGNN", "PNA", "SchNet"],
+    "hidden_dim": (16, 64),
+    "num_conv_layers": (1, 5),
+    "num_headlayers": (1, 3),
+    "dim_headlayer": (16, 64),
+}
+
+
+def load_base_config():
+    with open(os.path.join(HERE, "qm9.json")) as f:
+        return json.load(f)
+
+
+def load_splits(num_samples, base_config):
+    from examples.qm9.qm9_data import load_qm9
+    from hydragnn_tpu.preprocess.load_data import split_dataset
+    arch0 = base_config["NeuralNetwork"]["Architecture"]
+    samples = load_qm9(root=os.path.join(HERE, "dataset", "qm9"),
+                       num_samples=num_samples,
+                       radius=arch0["radius"],
+                       max_neighbours=arch0["max_neighbours"])
+    return split_dataset(
+        samples, base_config["NeuralNetwork"]["Training"]["perc_train"],
+        False)
+
+
+def make_objective(base_config, splits, trial_epochs):
+    """params -> final validation loss (inf on trial failure, the
+    reference's "F" objective convention)."""
+    from hydragnn_tpu.run_training import run_training
+
+    def objective(params):
+        config = json.loads(json.dumps(base_config))
+        arch = config["NeuralNetwork"]["Architecture"]
+        arch["model_type"] = params["model_type"]
+        arch["hidden_dim"] = int(params["hidden_dim"])
+        arch["num_conv_layers"] = int(params["num_conv_layers"])
+        head = arch["output_heads"]["graph"]
+        head["num_headlayers"] = int(params["num_headlayers"])
+        head["dim_headlayers"] = [int(params["dim_headlayer"])] * int(
+            params["num_headlayers"])
+        if params["model_type"] == "SchNet":
+            arch.setdefault("num_gaussians", 32)
+            arch.setdefault("num_filters", int(params["hidden_dim"]))
+        config["NeuralNetwork"]["Training"]["num_epoch"] = trial_epochs
+        config["NeuralNetwork"]["Training"]["EarlyStopping"] = False
+        config["Verbosity"] = {"level": 0}
+        try:
+            _, history, _, _ = run_training(config, datasets=splits)
+            return float(history["val_loss"][-1])
+        except Exception as e:          # failed trial -> worst score
+            print(f"trial failed: {e}")
+            return float("inf")
+
+    return objective
+
+
+def write_trials_csv(history, path):
+    """Per-trial results table, the reference's trial_results DataFrame
+    artifact (qm9_optuna.py:139-147) without requiring pandas."""
+    if not history:
+        return
+    keys = sorted({k for rec in history for k in rec["params"]})
+    with open(path, "w") as f:
+        f.write(",".join(["trial_id"] + keys + ["value"]) + "\n")
+        for i, rec in enumerate(history):
+            row = [str(i)] + [str(rec["params"].get(k, "")) for k in keys]
+            f.write(",".join(row + [str(rec["value"])]) + "\n")

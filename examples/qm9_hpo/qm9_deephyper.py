@@ -1,0 +1,60 @@
+"""QM9 HPO, CBO driver (the DeepHyper variant).
+
+reference: examples/qm9_hpo/qm9_deephyper.py:150-182 — a DeepHyper CBO
+search with an in-process evaluator over the qm9 objective. The TPU
+counterpart drives the in-tree CBO (utils/bayes_opt.py: Matern-5/2 GP +
+UCB + constant liar — the same algorithm family DeepHyper's CBO wraps)
+directly, bypassing search()'s optuna preference so this entry point is
+deterministic about its strategy.
+
+Usage:
+    python examples/qm9_hpo/qm9_deephyper.py [--num_trials 10]
+        [--num_samples 200] [--trial_epochs 4] [--cpu]
+Artifacts: qm9_deephyper_results.json + qm9_deephyper_trials.csv.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num_trials", type=int, default=10)
+    p.add_argument("--num_samples", type=int, default=200)
+    p.add_argument("--trial_epochs", type=int, default=4)
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+    if args.cpu:
+        from examples.cli_utils import setup_cpu_devices
+        setup_cpu_devices()
+
+    from examples.qm9_hpo import common
+    from hydragnn_tpu.utils.bayes_opt import CBO
+
+    base_config = common.load_base_config()
+    splits = common.load_splits(args.num_samples, base_config)
+    objective = common.make_objective(base_config, splits,
+                                      args.trial_epochs)
+    opt = CBO(common.SPACE, seed=42)
+    history = []
+    for _ in range(args.num_trials):
+        params = opt.ask()
+        val = objective(params)
+        opt.tell(params, val)
+        history.append({"params": params, "value": val})
+    best = opt.best[0] if opt.best else None
+    with open(os.path.join(common.HERE, "qm9_deephyper_results.json"),
+              "w") as f:
+        json.dump({"best": best, "history": history}, f, indent=2,
+                  default=str)
+    common.write_trials_csv(history, os.path.join(
+        common.HERE, "qm9_deephyper_trials.csv"))
+    print(json.dumps({"best_params": best, "num_trials": len(history)},
+                     default=str))
+
+
+if __name__ == "__main__":
+    main()

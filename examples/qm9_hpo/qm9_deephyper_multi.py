@@ -1,0 +1,78 @@
+"""QM9 HPO, CBO + subprocess-per-trial driver (the DeepHyper-multi
+variant).
+
+reference: examples/qm9_hpo/qm9_deephyper_multi.py:17-94 — DeepHyper CBO
+where each trial is an `srun` subprocess on a leased node subset. The TPU
+counterpart is utils/hpo.orchestrate: the same CBO, trials launched as
+subprocesses of this script's --run_one mode, pinned to disjoint
+TPU_VISIBLE_CHIPS slices via --chips_per_trial (chip-slice leasing
+replaces srun node leasing), crash-resumable via trials.jsonl.
+
+Usage:
+    python examples/qm9_hpo/qm9_deephyper_multi.py [--num_trials 6]
+        [--concurrent 2] [--chips_per_trial 1] [--num_samples 200]
+        [--trial_epochs 4] [--cpu]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num_trials", type=int, default=6)
+    p.add_argument("--concurrent", type=int, default=2)
+    p.add_argument("--chips_per_trial", type=int, default=0)
+    p.add_argument("--num_samples", type=int, default=200)
+    p.add_argument("--trial_epochs", type=int, default=4)
+    p.add_argument("--trial_timeout", type=int, default=600)
+    p.add_argument("--cpu", action="store_true")
+    # single-trial mode (the orchestrator's trial script)
+    p.add_argument("--run_one", action="store_true")
+    p.add_argument("--model_type", default="SchNet")
+    p.add_argument("--hidden_dim", type=int, default=32)
+    p.add_argument("--num_conv_layers", type=int, default=2)
+    p.add_argument("--num_headlayers", type=int, default=2)
+    p.add_argument("--dim_headlayer", type=int, default=32)
+    args = p.parse_args()
+    if args.cpu:
+        from examples.cli_utils import setup_cpu_devices
+        setup_cpu_devices()
+
+    from examples.qm9_hpo import common
+
+    if args.run_one:
+        base_config = common.load_base_config()
+        splits = common.load_splits(args.num_samples, base_config)
+        objective = common.make_objective(base_config, splits,
+                                          args.trial_epochs)
+        val = objective({
+            "model_type": args.model_type,
+            "hidden_dim": args.hidden_dim,
+            "num_conv_layers": args.num_conv_layers,
+            "num_headlayers": args.num_headlayers,
+            "dim_headlayer": args.dim_headlayer})
+        print(json.dumps({"final_val_loss": val}))
+        return
+
+    from hydragnn_tpu.utils.hpo import orchestrate
+    repo = os.path.dirname(os.path.dirname(common.HERE))
+    extra = {"run_one": "", "trial_epochs": args.trial_epochs,
+             "num_samples": args.num_samples}
+    if args.cpu:
+        extra["cpu"] = ""
+    result = orchestrate(
+        os.path.abspath(__file__), common.SPACE,
+        num_trials=args.num_trials, concurrent=args.concurrent,
+        log_dir=os.path.join(repo, "logs", "hpo_qm9"),
+        chips_per_trial=args.chips_per_trial or None,
+        extra_args=extra, timeout_s=args.trial_timeout)
+    print(json.dumps({"best_params": (result["best"] or {}).get("params"),
+                      "num_trials": len(result["history"])}, default=str))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,12 +1,13 @@
-"""QM9 hyperparameter-search example CLI.
+"""QM9 hyperparameter-search example CLI (the umbrella driver).
 
 reference: examples/qm9_hpo/qm9_optuna.py (optuna objective over
 model_type/hidden_dim/num_conv_layers/head widths, short trainings) and
 qm9_deephyper*.py (the same space driven by DeepHyper CBO over SLURM
 node subsets). TPU path: hydragnn_tpu.utils.hpo.search — optuna TPE when
-importable, otherwise the built-in random search; trials run in-process
-on the local mesh (the reference's srun-per-trial layer maps to
-create_launch_command for multi-host fleets).
+importable, otherwise the built-in CBO; trials run in-process on the
+local mesh. Strategy-specific flag-compatible entry points live next to
+this file: qm9_optuna.py, qm9_deephyper.py, qm9_deephyper_multi.py
+(subprocess-per-trial with chip-slice leasing).
 
 Usage:
     python examples/qm9_hpo/qm9_hpo.py [--num_trials 10]
@@ -32,58 +33,17 @@ def main():
         from examples.cli_utils import setup_cpu_devices
         setup_cpu_devices()
 
-    here = os.path.dirname(os.path.abspath(__file__))
-    with open(os.path.join(here, "qm9.json")) as f:
-        base_config = json.load(f)
-
-    from examples.qm9.qm9_data import load_qm9
-    from hydragnn_tpu.preprocess.load_data import split_dataset
-    from hydragnn_tpu.run_training import run_training
+    from examples.qm9_hpo import common
     from hydragnn_tpu.utils.hpo import search
 
-    arch0 = base_config["NeuralNetwork"]["Architecture"]
-    samples = load_qm9(root=os.path.join(here, "dataset", "qm9"),
-                       num_samples=args.num_samples,
-                       radius=arch0["radius"],
-                       max_neighbours=arch0["max_neighbours"])
-    splits = split_dataset(
-        samples, base_config["NeuralNetwork"]["Training"]["perc_train"],
-        False)
-
-    # reference search space (qm9_optuna.py:52-58)
-    space = {
-        "model_type": ["EGNN", "PNA", "SchNet"],
-        "hidden_dim": (16, 64),
-        "num_conv_layers": (1, 5),
-        "num_headlayers": (1, 3),
-        "dim_headlayer": (16, 64),
-    }
-
-    def objective(params):
-        config = json.loads(json.dumps(base_config))
-        arch = config["NeuralNetwork"]["Architecture"]
-        arch["model_type"] = params["model_type"]
-        arch["hidden_dim"] = int(params["hidden_dim"])
-        arch["num_conv_layers"] = int(params["num_conv_layers"])
-        head = arch["output_heads"]["graph"]
-        head["num_headlayers"] = int(params["num_headlayers"])
-        head["dim_headlayers"] = [int(params["dim_headlayer"])] * int(
-            params["num_headlayers"])
-        if params["model_type"] == "SchNet":
-            arch.setdefault("num_gaussians", 32)
-            arch.setdefault("num_filters", int(params["hidden_dim"]))
-        config["NeuralNetwork"]["Training"]["num_epoch"] = args.trial_epochs
-        config["NeuralNetwork"]["Training"]["EarlyStopping"] = False
-        config["Verbosity"] = {"level": 0}
-        try:
-            _, history, _, _ = run_training(config, datasets=splits)
-            return float(history["val_loss"][-1])
-        except Exception as e:          # failed trial -> worst score
-            print(f"trial failed: {e}")
-            return float("inf")
-
-    best, history = search(objective, space, num_trials=args.num_trials,
-                           log_path=os.path.join(here, "hpo_results.json"))
+    base_config = common.load_base_config()
+    splits = common.load_splits(args.num_samples, base_config)
+    objective = common.make_objective(base_config, splits,
+                                      args.trial_epochs)
+    best, history = search(objective, common.SPACE,
+                           num_trials=args.num_trials,
+                           log_path=os.path.join(common.HERE,
+                                                 "hpo_results.json"))
     print(json.dumps({"best_params": best, "num_trials": len(history)},
                      default=str))
 

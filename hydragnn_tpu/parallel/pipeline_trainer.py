@@ -19,16 +19,19 @@ Layer params/apply reuse the zoo conv modules (models/convs.py) — the
 pipelined math IS the sequential math, asserted by
 tests/test_pipeline_config.py.
 
-Scope (documented limits): conv kinds below (incl. the flagship PNA),
-graph/node MLP heads, Architecture.dtype mixed precision (bf16 compute,
-f32 masters — the main path's policy). Eval/prediction run the sequential
+Scope (documented limits): conv kinds below (incl. the flagship PNA and
+the EF flagship SchNet, invariant form), graph/node MLP heads,
+Architecture.dtype mixed precision (bf16 compute, f32 masters — the main
+path's policy), freeze_conv_layers. Eval/prediction run the sequential
 forward.
 
-ARCHITECTURAL DIVERGENCE (surfaced loudly at config time by
-run_training): the pipelined stack normalizes with LayerNorm, not
-BaseStack's MaskedBatchNorm — running statistics don't compose with GPipe
-microbatching — so `pipeline_stages: 4` trains a DIFFERENT (LayerNorm)
-model than `pipeline_stages: 1` of the same config, on purpose.
+ARCHITECTURAL DIVERGENCE (enforced at config time by run_training via
+require_pipeline_norm_optin): the pipelined stack normalizes with
+LayerNorm, not BaseStack's MaskedBatchNorm — running statistics don't
+compose with GPipe microbatching — so `pipeline_stages: 4` trains a
+DIFFERENT (LayerNorm) model than `pipeline_stages: 1` of the same config,
+on purpose; configs must acknowledge with
+`Training.pipeline_norm: "layernorm"`.
 """
 from __future__ import annotations
 
@@ -52,14 +55,63 @@ from ..train.train_step import (TrainState, _cast_floats,
                                 _resolve_compute_dtype)
 from .pipeline import make_pipeline_apply, stack_stage_params
 
-# factories take (hidden, cfg): PNA needs the degree histogram. PNAPlus
-# is excluded — its per-conv Bessel radial embedding rides conv_args,
-# which the homogeneous pipelined block does not thread.
+# factories take (hidden, cfg): PNA needs the degree histogram; SchNet's
+# CFConv additionally needs per-batch edge lengths, threaded through the
+# block's cargs_fn (computed per microbatch inside the pipelined layer —
+# SCFStack.conv_args does the same on the sequential path). PNAPlus is
+# excluded — its per-conv Bessel radial embedding carries learnable
+# parameters outside the homogeneous stacked-layer structure.
 PIPELINE_CONV_TYPES = {
     "GIN": lambda hidden, cfg: GINConv(out_dim=hidden),
     "SAGE": lambda hidden, cfg: SAGEConv(out_dim=hidden),
     "PNA": lambda hidden, cfg: PNAConv(out_dim=hidden,
                                        deg_hist=cfg.pna_deg),
+    "SchNet": lambda hidden, cfg: _schnet_conv(hidden, cfg),
+}
+
+
+def _schnet_conv(hidden, cfg):
+    from ..models.schnet import CFConv
+    # coordinate updates (equivariant=True) mutate pos across layers,
+    # which the homogeneous block does not thread — EF training uses the
+    # sequential path
+    return CFConv(out_dim=hidden,
+                  num_filters=int(cfg.num_filters or 128),
+                  num_gaussians=int(cfg.num_gaussians or 50),
+                  cutoff=float(cfg.radius or 1.0), equivariant=False)
+
+
+def _edge_length_cargs(batch: GraphBatch):
+    # the forward precompute (PIPELINE_PRECOMPUTE) stashes once-per-
+    # microbatch edge lengths in edge_attr so the pipeline scan body
+    # doesn't redo the gather+norm per LAYER (XLA can't CSE across scan
+    # iterations); the fallback recompute only runs at init time
+    if batch.edge_attr is not None:
+        return {"edge_length": batch.edge_attr[:, 0]}
+    from ..ops.geometry import edge_vectors
+    _, length = edge_vectors(batch.pos, batch.senders, batch.receivers,
+                             batch.edge_shifts)
+    return {"edge_length": length}
+
+
+def _precompute_edge_length(batch: GraphBatch) -> GraphBatch:
+    from ..ops.geometry import edge_vectors
+    _, length = edge_vectors(batch.pos, batch.senders, batch.receivers,
+                             batch.edge_shifts)
+    # pipelined SchNet ignores dataset edge_attr (its CFConv is built
+    # with no edge encoder), so the slot is free to carry the lengths
+    return batch.replace(edge_attr=length[:, None])
+
+
+# per-model conv_args builder (defaults to {}): what BaseStack.conv_args
+# provides on the sequential path
+PIPELINE_CONV_CARGS = {
+    "SchNet": _edge_length_cargs,
+}
+
+# per-model once-per-forward batch precompute (defaults to identity)
+PIPELINE_PRECOMPUTE = {
+    "SchNet": _precompute_edge_length,
 }
 
 
@@ -68,14 +120,18 @@ class _ConvBlock(nn.Module):
     stateless stand-in for BaseStack's MaskedBatchNorm — running statistics
     don't compose with GPipe microbatching, and GIN's eps=100 init
     (reference: GINStack.py:26-34) needs per-layer normalization to keep
-    activations bounded."""
+    activations bounded. `model_type` selects the PIPELINE_CONV_CARGS
+    builder (e.g. SchNet's per-batch edge lengths)."""
     conv: nn.Module
     activation: str
+    model_type: str = ""
 
     @nn.compact
     def __call__(self, h, batch: GraphBatch):
         act = activation_function_selection(self.activation)
-        h2, _ = self.conv(h, batch.pos, batch, {})
+        cargs_fn = PIPELINE_CONV_CARGS.get(self.model_type)
+        cargs = cargs_fn(batch) if cargs_fn else {}
+        h2, _ = self.conv(h, batch.pos, batch, cargs)
         h2 = nn.LayerNorm()(h2)
         return act(h2)
 
@@ -100,7 +156,8 @@ def init_pipeline_params(rng, cfg: ModelConfig, sample_batch: GraphBatch):
     p_embed = embed.init(k_embed, sample_batch.x)["params"]
     x_h = jnp.zeros(sample_batch.x.shape[:-1] + (hidden,), jnp.float32)
 
-    block = _ConvBlock(conv=conv_fn(hidden, cfg), activation=cfg.activation)
+    block = _ConvBlock(conv=conv_fn(hidden, cfg), activation=cfg.activation,
+                       model_type=cfg.model_type)
     per_layer = []
     for i in range(cfg.num_conv_layers):
         ki = jax.random.fold_in(k_conv, i)
@@ -147,7 +204,8 @@ def make_pipeline_forward(cfg: ModelConfig, mesh: Mesh, num_stages: int,
     conv_fn = PIPELINE_CONV_TYPES[cfg.model_type]
     hidden = cfg.hidden_dim
     act = activation_function_selection(cfg.activation)
-    block = _ConvBlock(conv=conv_fn(hidden, cfg), activation=cfg.activation)
+    block = _ConvBlock(conv=conv_fn(hidden, cfg), activation=cfg.activation,
+                       model_type=cfg.model_type)
     embed = _embed(hidden)
     cdtype = _resolve_compute_dtype(cfg, compute_dtype)
     mixed = cdtype != jnp.float32
@@ -160,10 +218,15 @@ def make_pipeline_forward(cfg: ModelConfig, mesh: Mesh, num_stages: int,
         pipe_apply = make_pipeline_apply(mesh, layer_fn,
                                          cfg.num_conv_layers, axis="pipe")
 
+    precompute = PIPELINE_PRECOMPUTE.get(cfg.model_type)
+
     def forward(params, stacked: GraphBatch):
         if mixed:
             params = _cast_floats(params, cdtype)
             stacked = _cast_floats(stacked, cdtype)
+        if precompute is not None:
+            # once per forward, not once per layer inside the scan body
+            stacked = jax.vmap(precompute)(stacked)
         x = jax.vmap(lambda xb: embed.apply({"params": params["embed"]}, xb)
                      )(stacked.x)
         if pipelined:
@@ -208,11 +271,23 @@ def make_pipeline_train_step(cfg: ModelConfig, mesh: Mesh, num_stages: int,
             metrics[f"task_{i}"] = jnp.mean(tasks[:, i])
         return jnp.mean(losses), metrics
 
+    def freeze(tree):
+        """freeze_conv_layers on the pipelined pytree: the conv stack is
+        the {"convs"} subtree (heads/embed stay trainable — same split as
+        train_step.freeze_conv_grads; reference Base.py:139-143). Applied
+        to UPDATES too: AdamW weight decay moves params at zero grad."""
+        if not getattr(cfg, "freeze_conv", False):
+            return tree
+        return {k: (jax.tree_util.tree_map(jnp.zeros_like, v)
+                    if k == "convs" else v) for k, v in tree.items()}
+
     @jax.jit
     def train_step(state: TrainState, stacked: GraphBatch):
         (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, stacked)
+        grads = freeze(grads)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        updates = freeze(updates)
         new_params = optax.apply_updates(state.params, updates)
         return state.replace(params=new_params, opt_state=new_opt,
                              step=state.step + 1), metrics
@@ -280,6 +355,31 @@ def validate_pipeline_config(cfg: ModelConfig, num_stages: int,
         if head.head_type != "graph" and head.node_arch not in ("mlp",):
             raise ValueError(
                 "pipelined path supports graph heads and mlp node heads")
-    if getattr(cfg, "freeze_conv", False):
+    if getattr(cfg, "equivariance", False):
+        # the homogeneous pipelined block drops per-layer coordinate
+        # updates (_ConvBlock discards the pos return) — silently
+        # training a non-equivariant variant would contradict the
+        # loud-divergence policy (require_pipeline_norm_optin)
         raise ValueError(
-            "pipeline_stages does not support freeze_conv_layers yet")
+            "Training.pipeline_stages does not support "
+            "Architecture.equivariance (coordinate updates do not "
+            "thread through the homogeneous pipelined block); train "
+            "equivariant models on the sequential path")
+
+
+def require_pipeline_norm_optin(train_cfg: dict):
+    """Config-time gate for the LayerNorm divergence (module docstring):
+    `pipeline_stages > 1` trains a LayerNorm stack, architecturally
+    different from the sequential MaskedBatchNorm model, and checkpoints
+    are not interchangeable. That must be an explicit choice, not a
+    mid-train log line (r3 verdict, Next #8) — the config must say
+    `Training.pipeline_norm: "layernorm"`."""
+    norm = train_cfg.get("pipeline_norm")
+    if norm != "layernorm":
+        raise ValueError(
+            "Training.pipeline_stages > 1 trains the pipelined LayerNorm "
+            "stack — a DIFFERENT architecture from pipeline_stages=1 "
+            "(MaskedBatchNorm; running stats do not compose with GPipe "
+            "microbatching), with non-interchangeable checkpoints. "
+            "Acknowledge by setting Training.pipeline_norm: \"layernorm\" "
+            f"(got {norm!r}).")

@@ -145,14 +145,11 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     if pipeline_stages > 1:
         # validate before the loader asserts on batch/shard divisibility
         # with a less actionable message
-        from .parallel.pipeline_trainer import validate_pipeline_config
+        from .parallel.pipeline_trainer import (
+            require_pipeline_norm_optin, validate_pipeline_config)
+        require_pipeline_norm_optin(train_cfg)
         validate_pipeline_config(mcfg, pipeline_stages, batch_size,
                                  microbatches)
-        log("NOTICE: pipeline_stages > 1 trains the pipelined stack "
-            "(conv + LayerNorm blocks) — NOT the same architecture as "
-            "pipeline_stages=1 (MaskedBatchNorm): running stats do not "
-            "compose with GPipe microbatching. Checkpoints are not "
-            "interchangeable between the two.")
         num_shards = microbatches  # loader stacking = microbatch axis
     else:
         num_shards = resolve_num_shards(

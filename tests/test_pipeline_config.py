@@ -25,6 +25,7 @@ def _cfg(stages, model_type="GIN", num_conv_layers=4, heads=("graph",)):
     cfg = make_config(model_type, heads=heads,
                       num_conv_layers=num_conv_layers)
     cfg["NeuralNetwork"]["Training"]["pipeline_stages"] = stages
+    cfg["NeuralNetwork"]["Training"]["pipeline_norm"] = "layernorm"
     cfg["NeuralNetwork"]["Training"]["num_epoch"] = 3
     return cfg
 
@@ -75,6 +76,86 @@ def test_pipeline_validation_errors():
         run_training(_cfg(3, num_conv_layers=4), datasets=_splits())
     with pytest.raises(ValueError, match="supports model_type"):
         run_training(_cfg(2, model_type="GAT"), datasets=_splits())
+
+
+def test_pipeline_norm_optin_required():
+    """The LayerNorm divergence is a config-time error without the
+    explicit Training.pipeline_norm acknowledgement (r3 verdict Next #8)
+    — not a mid-train NOTICE."""
+    cfg = _cfg(2)
+    del cfg["NeuralNetwork"]["Training"]["pipeline_norm"]
+    with pytest.raises(ValueError, match="pipeline_norm"):
+        run_training(cfg, datasets=_splits())
+    cfg["NeuralNetwork"]["Training"]["pipeline_norm"] = "batchnorm"
+    with pytest.raises(ValueError, match="pipeline_norm"):
+        run_training(cfg, datasets=_splits())
+
+
+def test_pipeline_equivariance_rejected():
+    """Equivariant coordinate updates don't thread through the
+    homogeneous pipelined block — must be a config-time error, not a
+    silently different architecture."""
+    cfg = _cfg(2, model_type="SchNet")
+    cfg["NeuralNetwork"]["Architecture"]["equivariance"] = True
+    with pytest.raises(ValueError, match="equivariance"):
+        run_training(cfg, datasets=_splits())
+
+
+def test_pipeline_schnet_config_trains():
+    """SchNet (the EF flagship) pipelines: its CFConv needs per-batch
+    edge lengths, threaded via PIPELINE_CONV_CARGS. Assert on val loss
+    over a few epochs — the 3-epoch train series is too noisy for a
+    strict first-vs-last comparison."""
+    cfg = _cfg(2, model_type="SchNet")
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 6
+    state, history, _, _ = run_training(cfg, datasets=_splits())
+    assert all(np.isfinite(v) for v in history["train_loss"])
+    assert history["val_loss"][-1] < history["val_loss"][0]
+
+
+def test_pipeline_freeze_conv():
+    """freeze_conv_layers freezes the pipelined conv stack (heads/embed
+    keep training) — including under AdamW weight decay, which moves
+    params even at zero gradient if updates aren't masked."""
+    from hydragnn_tpu.config import build_model_config, update_config
+    from hydragnn_tpu.graphs.batch import collate
+    from hydragnn_tpu.datasets.loader import _stack_batches
+    from hydragnn_tpu.parallel.mesh import make_mesh
+    from hydragnn_tpu.parallel.pipeline_trainer import (
+        init_pipeline_params, make_pipeline_train_step)
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.train_step import TrainState
+
+    samples = deterministic_graph_dataset(num_configs=16)
+    cfg = make_config("GIN", num_conv_layers=4)
+    cfg["NeuralNetwork"]["Architecture"]["freeze_conv_layers"] = True
+    train_cfg = cfg["NeuralNetwork"]["Training"]
+    train_cfg["Optimizer"] = {"type": "AdamW", "learning_rate": 1e-2}
+    cfg = update_config(cfg, samples)
+    mcfg = build_model_config(cfg)
+    assert mcfg.freeze_conv
+
+    micro = [collate(samples[i:i + 4], n_node=128, n_edge=2048, n_graph=5)
+             for i in range(0, 16, 4)]
+    stacked = _stack_batches(micro)
+    params = init_pipeline_params(jax.random.PRNGKey(0), mcfg, micro[0])
+    tx = select_optimizer(train_cfg)
+    state = TrainState.create({"params": params}, tx)
+    mesh = make_mesh((("pipe", 2),))
+    step = make_pipeline_train_step(mcfg, mesh, 2, tx)
+    for _ in range(3):
+        state, metrics = step(state, stacked)
+    assert np.isfinite(float(np.asarray(metrics["loss"])))
+    conv0 = jax.tree_util.tree_leaves(params["convs"])
+    conv1 = jax.tree_util.tree_leaves(state.params["convs"])
+    for a, b in zip(conv0, conv1):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    head0 = np.concatenate([np.ravel(l) for l in
+                            jax.tree_util.tree_leaves(params["heads"])])
+    head1 = np.concatenate([np.ravel(l) for l in
+                            jax.tree_util.tree_leaves(
+                                state.params["heads"])])
+    assert not np.allclose(head0, head1)
 
 
 def test_pipeline_pna_forward_matches_sequential():

@@ -1,0 +1,78 @@
+"""Merge the per-model anchor runs (logs/anchor_ref.jsonl +
+logs/anchor_tpu.jsonl) into ANCHOR_r{N}.json with ours-vs-reference MAE
+ratios — the cross-framework evaluation of BASELINE.md's "<=5% MAE
+regression" clause (round-3 verdict, Next #6).
+
+Usage: python tools/ref_anchor/assemble.py [--round 4]
+"""
+import argparse
+import json
+import os
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def load_jsonl(path):
+    out = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                rec = json.loads(line)
+                out[rec["model"]] = rec  # last run per model wins
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--round", type=int,
+                   default=int(os.environ.get("GRAFT_ROUND", "4")))
+    args = p.parse_args()
+    ref = load_jsonl(os.path.join(REPO, "logs", "anchor_ref.jsonl"))
+    tpu = load_jsonl(os.path.join(REPO, "logs", "anchor_tpu.jsonl"))
+    models = sorted(set(ref) | set(tpu))
+    rows, evaluated = {}, 0
+    for m in models:
+        r, t = ref.get(m), tpu.get(m)
+        row = {}
+        if t:
+            row.update(energy_mae=t["energy_mae"], force_mae=t["force_mae"],
+                       energy_mae_rel=t["energy_mae_rel"],
+                       force_mae_rel=t["force_mae_rel"],
+                       train_secs=t["train_secs"])
+        if r:
+            row.update(reference_energy_mae=r["energy_mae"],
+                       reference_force_mae=r["force_mae"],
+                       reference_energy_mae_rel=r["energy_mae_rel"],
+                       reference_force_mae_rel=r["force_mae_rel"],
+                       reference_train_secs=r["train_secs"])
+        if r and t:
+            row["energy_ratio_ours_over_ref"] = round(
+                t["energy_mae"] / max(r["energy_mae"], 1e-12), 4)
+            row["force_ratio_ours_over_ref"] = round(
+                t["force_mae"] / max(r["force_mae"], 1e-12), 4)
+            row["parity_le_1.05"] = bool(
+                row["energy_ratio_ours_over_ref"] <= 1.05
+                and row["force_ratio_ours_over_ref"] <= 1.05)
+            evaluated += 1
+        rows[m] = row
+    budget = (ref or tpu)[models[0]]["budget"] if models else {}
+    out = {
+        "metric": "lj_anchor_cross_framework_mae",
+        "round": args.round,
+        "protocol": ("identical workload (our LJ generator, 64-atom 4^3 "
+                     "PBC cells), identical budget and split on both "
+                     "sides; the reference runs UNMODIFIED on the "
+                     "tools/ref_anchor/shims dependency surface"),
+        "budget": budget,
+        "models": rows,
+        "models_evaluated": evaluated,
+        "parity_claim": "ours <= 1.05x reference MAE (BASELINE.md)",
+    }
+    path = os.path.join(REPO, f"ANCHOR_r{args.round:02d}.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -20,7 +20,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-ROUND = int(os.environ.get("GRAFT_ROUND", "3"))
+ROUND = int(os.environ.get("GRAFT_ROUND", "4"))
 
 
 def main() -> int:
