@@ -38,13 +38,17 @@ def main():
     splits = common.load_splits(args.num_samples, base_config)
     objective = common.make_objective(base_config, splits,
                                       args.trial_epochs)
+    import math
     opt = CBO(common.SPACE, seed=42)
     history = []
     for _ in range(args.num_trials):
         params = opt.ask()
         val = objective(params)
         opt.tell(params, val)
-        history.append({"params": params, "value": val})
+        # strict JSON: a failed trial records null (json.dump would emit
+        # bare Infinity otherwise — same guard as utils/hpo.orchestrate)
+        history.append({"params": params,
+                        "value": val if math.isfinite(val) else None})
     best = opt.best[0] if opt.best else None
     with open(os.path.join(common.HERE, "qm9_deephyper_results.json"),
               "w") as f:

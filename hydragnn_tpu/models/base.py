@@ -162,19 +162,33 @@ class BaseStack(nn.Module):
                 hdims = list(head.dim_headlayers) + [head.output_dim * widen]
                 hin = h.shape[-1]
                 for li, hd in enumerate(hdims):
+                    last = li == len(hdims) - 1
                     conv = self.make_conv(hin, hd, cfg.num_conv_layers + 100 * ih + li,
-                                          final=(li == len(hdims) - 1))
+                                          final=last)
                     h, hpos = conv(h, hpos, batch, cargs)
-                    # head-conv batchnorm is unconditional: the reference
-                    # creates BatchNorm1d for conv heads in EVERY stack
-                    # (_init_node_conv, Base.py:240-260 + forward :336-341)
-                    # — use_batch_norm only governs encoder feature layers.
-                    # Without it the unnormalized stacks (EGNN/PAINN/
-                    # PNAEq/DimeNet) explode through the head convs and
-                    # die at relu(0) (constant-zero predictions)
+                    # Hidden head layers: batchnorm unconditionally (the
+                    # reference creates BatchNorm1d for conv heads in
+                    # EVERY stack, _init_node_conv Base.py:240-260 —
+                    # use_batch_norm only governs encoder feature
+                    # layers; without it the unnormalized stacks
+                    # EGNN/PAINN/PNAEq/DimeNet explode through the head
+                    # convs) + activation.
+                    # INTENTIONAL DIVERGENCE on the final layer: the
+                    # reference also applies the ACTIVATION to the last
+                    # head conv (forward, Base.py:336-341), leaving a
+                    # relu-ranged regression output. On small graphs
+                    # that trains unstably — the r4 conv-head ablation
+                    # measured MFC at RMSE 0.43 (worse than the mean
+                    # predictor, train loss stuck at 3x the mean floor)
+                    # with final BN+act, 0.15 with final BN only, 0.26
+                    # with neither (and the unnormalized stacks PNAEq/
+                    # PAINN need the final BN to keep the head's output
+                    # scale trainable at all). So: BN everywhere, no
+                    # activation after the final conv.
                     h = MaskedBatchNorm(name=f"head_{ih}_norm_{li}")(
                         h, batch.node_mask, use_running_average=not train)
-                    h = act(h)
+                    if not last:
+                        h = act(h)
                     hin = hd
                 out = h
             else:

@@ -23,7 +23,9 @@ def test_gcloud_mode_plan():
     assert "gcloud compute tpus tpu-vm ssh" in out and "pod-a" in out
     assert "--worker=all" in out
     assert "--zone=us-central2-b" in out
-    assert "HYDRAGNN_STEPS_PER_CALL=8" in out
+    # default inherits the measured on-chip adjudication (spc=1,
+    # BENCH_SWEEP_TPU.json) instead of an unmeasured pod constant
+    assert "HYDRAGNN_STEPS_PER_CALL=1" in out
     # one identical command everywhere: shard root resolved at runtime
     assert "HYDRAGNN_GS_SHARD_ROOT=/mnt/gfm" in out
     assert "python -u examples/multidataset/train.py --ddstore" in out

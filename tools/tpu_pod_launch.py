@@ -29,10 +29,13 @@ import shlex
 import subprocess
 import sys
 
-# per-dispatch tunnel/pod launch overhead is amortized by scanning
-# multiple optimizer steps per call; 8 is a good pod starting point
-# (bench.py's per-backend default table; tune with BENCH_SWEEP=1)
-DEFAULT_STEPS_PER_CALL = 8
+# steps-per-call default follows the measured single-chip adjudication
+# (BENCH_SWEEP_TPU.json: spc=1 wins decisively on-chip — the scan's
+# stacked batch breaks XLA fusion and costs more than the dispatch it
+# amortizes; bench.py's per-backend default table). A pod MAY differ
+# (DCN dispatch amortization) but that is unmeasured — prefer the
+# measured number over a guess and tune per pod with BENCH_SWEEP=1.
+DEFAULT_STEPS_PER_CALL = 1
 
 
 def build_worker_command(args, process_id=None, num_hosts=None):
