@@ -55,8 +55,7 @@ def main():
                 and row["force_ratio_ours_over_ref"] <= 1.05)
             evaluated += 1
         rows[m] = row
-    any_rec = next(iter((ref or tpu).values()), None) if (ref or tpu) \
-        else None
+    any_rec = next(iter((ref or tpu).values()), None)
     budget = any_rec["budget"] if any_rec else {}
     out = {
         "metric": "lj_anchor_cross_framework_mae",
