@@ -391,6 +391,14 @@ def build_model_config(config: Dict[str, Any]) -> ModelConfig:
         else:
             n = oh.get("node", {})
             dh = n.get("dim_headlayers", [32] * n.get("num_headlayers", 2))
+            if n.get("type", "mlp") == "conv" and not dh:
+                # a conv head with zero conv layers would silently
+                # degenerate to a linear readout of the encoder
+                # (base.py decode builds one conv per dim_headlayers
+                # entry + the output Dense)
+                raise ValueError(
+                    "output_heads.node.type='conv' requires "
+                    "num_headlayers >= 1 / non-empty dim_headlayers")
             heads.append(HeadConfig(
                 head_type="node", output_dim=int(od), offset=noff,
                 num_headlayers=n.get("num_headlayers", len(dh)),

@@ -112,7 +112,14 @@ def init_params(model: BaseStack, sample_batch, seed: int = 0):
 
         for key in params:
             if key.startswith("head_"):
-                set_final_bias(params[key])
+                if key.endswith("_out"):
+                    # conv-type node heads project through a bare Dense
+                    # (base.py decode: head_{ih}_out = {kernel, bias})
+                    if "bias" in params[key]:
+                        params[key]["bias"] = jnp.full_like(
+                            params[key]["bias"], float(bias0))
+                else:
+                    set_final_bias(params[key])
         variables = dict(variables)
         variables["params"] = params
     return variables
