@@ -27,20 +27,29 @@ class MessagePassing(torch.nn.Module):
         else:
             src_idx, dst_idx = edge_index[1], edge_index[0]
 
+        if self._msg_params is None:
+            self._msg_params = list(
+                inspect.signature(self.message).parameters.values())
+
         dim_size = None
         if size is not None:
             dim_size = size[1] if size[1] is not None else size[0]
         if dim_size is None:
-            for v in kwargs.values():
-                if torch.is_tensor(v) and v.dim() > self.node_dim:
-                    dim_size = v.size(self.node_dim)
+            # kwargs gathered via message()'s _i/_j params are node-sized
+            # by definition; an edge-sized kwarg (edge_attr, W) ordered
+            # first would silently size the output to num_edges
+            gathered = {p.name[:-2] for p in self._msg_params
+                        if p.name.endswith(("_i", "_j"))}
+            for pool in (gathered, kwargs.keys()):
+                for name in pool:
+                    v = kwargs.get(name)
+                    if torch.is_tensor(v) and v.dim() > self.node_dim:
+                        dim_size = v.size(self.node_dim)
+                        break
+                if dim_size is not None:
                     break
         if dim_size is None:
             dim_size = int(dst_idx.max()) + 1 if dst_idx.numel() else 0
-
-        if self._msg_params is None:
-            self._msg_params = list(
-                inspect.signature(self.message).parameters.values())
         msg_kwargs = {}
         for p in self._msg_params:
             name = p.name

@@ -31,6 +31,11 @@ def scatter(src, index, dim=0, out=None, dim_size=None, reduce="sum"):
         out = torch.zeros(shape, dtype=src.dtype, device=src.device)
         result = out.scatter_reduce(dim, idx, src, tr, include_self=False)
     else:
+        # torch_scatter treats out as an accumulator only for sum-like
+        # reduces; folding out into a mean/max would be silently wrong
+        if reduce not in ("sum", "add"):
+            raise NotImplementedError(
+                "shim scatter(out=...) supports only sum/add")
         result = out.scatter_reduce(dim, idx, src, tr, include_self=True)
     if reduce in ("max", "min"):
         # torch_scatter fills empty segments with 0, scatter_reduce with

@@ -145,6 +145,21 @@ def capture_accuracy() -> bool:
     return ok
 
 
+def capture_trace() -> bool:
+    """Op-level jax.profiler trace of the CI shape (r4 verdict Next #1:
+    the 4x-residual hypothesis in docs/MFU_ANALYSIS.md needs op-level
+    attribution, which only an on-chip trace provides)."""
+    res, note = run_json_line(
+        [sys.executable, "tools/profile_step.py",
+         "--trace-dir", "logs/profile_tpu"],
+        {"HYDRAGNN_COMPILE_CACHE": ".jax_cache"},
+        timeout_s=1800)
+    ok = bool(res) and "error" not in res and res.get("trace_dir")
+    log_attempt({"event": "trace", "ok": bool(ok), "note": note,
+                 "result": res})
+    return bool(ok)
+
+
 _MFU_DONE = {}  # (batch, hidden, dtype) -> TPU-backend result, accrued
 #                 across up-windows so a mid-grid tunnel drop never
 #                 discards completed measurements
@@ -215,7 +230,7 @@ def main() -> None:
     lockf.flush()
 
     done = {"bench": False, "sweep": False, "accuracy": False,
-            "mfu": False}
+            "mfu": False, "trace": False}
     probes = 0
     while time.time() < DEADLINE:
         # one transient error must not end the standing watch — log it
@@ -240,6 +255,11 @@ def main() -> None:
                     done["mfu"] = capture_mfu()
                 if done["bench"] and not done["accuracy"]:
                     done["accuracy"] = capture_accuracy()
+                # trace after accuracy: a repeatedly-failing 30 min trace
+                # attempt must not starve the 1 h accuracy capture in a
+                # brief up-window; sweep last (an r3 grid already exists)
+                if done["bench"] and not done["trace"]:
+                    done["trace"] = capture_trace()
                 if done["bench"] and not done["sweep"]:
                     done["sweep"] = capture_sweep()
                 if all(done.values()):
