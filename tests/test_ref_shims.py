@@ -1,0 +1,131 @@
+"""Equivariance property tests for the anchor shims' e3nn subset.
+
+The reference MACE's correctness under the shims rests on the shim
+o3 module using ONE self-consistent real basis across spherical
+harmonics, wigner_3j, and the TensorProduct (reference counterparts:
+e3nn o3 used at hydragnn/models/MACEStack.py:57 and
+mace_utils/tools/cg.py:58). These tests certify that consistency:
+
+  1. Y_l(Rv) = D_l(R) Y_l(v) for an orthogonal D_l (SH transform as a
+     representation);
+  2. the wigner_3j tensor intertwines those same D_l blocks
+     (sum_kij C[k,i,j] D3[k,k'] D1[i,i'] D2[j,j'] = C[k',i',j']);
+  3. the shim TensorProduct therefore maps rotated inputs to rotated
+     outputs (checked end-to-end on a "uvu" instruction set).
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import os
+import sys
+
+SHIMS = os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "ref_anchor", "shims")
+sys.path.insert(0, SHIMS)
+
+from e3nn import o3  # noqa: E402  (the shim, not the real package)
+
+
+def _rotation(rng):
+    """Random SO(3) matrix via QR with det fix."""
+    q, _ = np.linalg.qr(rng.randn(3, 3))
+    if np.linalg.det(q) < 0:
+        q[:, 0] = -q[:, 0]
+    return torch.tensor(q, dtype=torch.float64)
+
+
+def _d_block(l, R, rng, n=256):
+    """Solve Y_l(Rv) = D_l Y_l(v) by least squares; return D_l and the
+    residual. Uses the shim's own SH so the test certifies the basis
+    the shim actually computes in."""
+    v = torch.tensor(rng.randn(n, 3), dtype=torch.float64)
+    y = o3._rsh(v, l)[:, l * l:(l + 1) * (l + 1)]
+    yr = o3._rsh(v @ R.T, l)[:, l * l:(l + 1) * (l + 1)]
+    D = torch.linalg.lstsq(y, yr).solution.T          # yr = y @ D.T
+    resid = (y @ D.T - yr).abs().max().item()
+    return D, resid
+
+
+def test_sh_transforms_as_representation():
+    rng = np.random.RandomState(0)
+    R = _rotation(rng)
+    for l in range(4):
+        D, resid = _d_block(l, R, rng)
+        assert resid < 1e-6, (l, resid)
+        eye = D @ D.T
+        assert torch.allclose(eye, torch.eye(2 * l + 1,
+                                             dtype=torch.float64),
+                              atol=1e-6), f"D_{l} not orthogonal"
+
+
+def test_wigner_intertwines_sh_basis():
+    rng = np.random.RandomState(1)
+    R = _rotation(rng)
+    for (l1, l2, l3) in [(1, 1, 0), (1, 1, 1), (1, 1, 2), (2, 1, 1),
+                         (2, 2, 2), (3, 2, 1)]:
+        C = o3.wigner_3j(l3, l1, l2, dtype=torch.float64)  # [d3, d1, d2]
+        D1, _ = _d_block(l1, R, rng)
+        D2, _ = _d_block(l2, R, rng)
+        D3, _ = _d_block(l3, R, rng)
+        lhs = torch.einsum("kij,ka,ib,jc->abc", C, D3, D1, D2)
+        assert torch.allclose(lhs, C, atol=1e-6), (l1, l2, l3)
+
+
+def test_tensor_product_equivariance():
+    rng = np.random.RandomState(2)
+    R = _rotation(rng)
+    irreps1 = o3.Irreps("4x0e+4x1o")
+    irreps2 = o3.Irreps.spherical_harmonics(2)
+    target = o3.Irreps("4x0e+4x1o+4x2e")
+    # connected uvu instructions, as irreps_tools builds them
+    instructions, out_list = [], []
+    for i, (mul, ir1) in enumerate(irreps1):
+        for j, (_, ir2) in enumerate(irreps2):
+            for ir_out in ir1 * ir2:
+                if ir_out in target:
+                    instructions.append((i, j, len(out_list), "uvu", True))
+                    out_list.append((mul, ir_out))
+    tp = o3.TensorProduct(irreps1, o3.Irreps(irreps2),
+                          o3.Irreps(out_list), instructions).double()
+
+    n = 8
+    x1 = torch.tensor(rng.randn(n, irreps1.dim))
+    x2 = torch.tensor(rng.randn(n, o3.Irreps(irreps2).dim))
+    w = torch.tensor(rng.randn(n, tp.weight_numel))
+
+    def rotate(x, irreps):
+        blocks = []
+        for mi, sl in zip(irreps, irreps.slices()):
+            D, _ = _d_block(mi.ir.l, R, rng)
+            blk = x[:, sl].reshape(n, mi.mul, mi.ir.dim)
+            blocks.append(torch.einsum("num,am->nua", blk, D)
+                          .reshape(n, -1))
+        return torch.cat(blocks, dim=-1)
+
+    out = tp(x1, x2, w)
+    out_rot = tp(rotate(x1, irreps1), rotate(x2, o3.Irreps(irreps2)), w)
+    assert torch.allclose(rotate(out, o3.Irreps(out_list)), out_rot,
+                          atol=1e-6)
+
+
+def test_linear_preserves_irreps_and_variance():
+    torch.manual_seed(0)
+    lin = o3.Linear(o3.Irreps("8x0e+8x1o"), o3.Irreps("16x0e+4x1o"))
+    x = torch.randn(1024, 8 + 24)
+    y = lin(x)
+    assert y.shape == (1024, 16 + 12)
+    # e3nn normalization keeps unit variance through the map
+    assert 0.5 < y.var().item() < 2.0
+
+
+def test_irreps_algebra():
+    ir = o3.Irreps("32x0e+8x1o") + o3.Irreps("4x0e")
+    assert ir.dim == 32 + 24 + 4 and ir.num_irreps == 44
+    s, p, inv = ir.sort()
+    assert str(s.simplify()) == "36x0e+8x1o"
+    assert [p[i] for i in range(3)] == [0, 2, 1]
+    assert o3.Irrep(0, 1) in ir and ir.count((0, 1)) == 36
+    assert str(o3.Irreps.spherical_harmonics(2)) == "1x0e+1x1o+1x2e"
+    assert (o3.Irreps("1x0e+1x1o") * 2).dim == 8

@@ -1,0 +1,79 @@
+"""Assemble logs/shim_fidelity.jsonl into SHIM_FIDELITY_r{N}.json
+(round-4 verdict, Next #3): per-model pass/fail of the reference's OWN
+CI battery (tests/test_graphs.py, thresholds at :139-162) run under the
+tools/ref_anchor/shims dependency surface.
+
+Usage: python tools/ref_anchor/assemble_fidelity.py [--round 5]
+"""
+import argparse
+import json
+import os
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+# shims that intentionally stub a dependency subset no anchor model needs;
+# a NotImplementedError from these is a documented scope boundary, not a
+# fidelity failure — but it does mean that model's row is unvalidated.
+# (MACE is NOT here: the e3nn shim is fully functional for it, so a MACE
+# error is a real fidelity failure.)
+KNOWN_STUBS = {"DimeNet": "InteractionPPBlock not in anchor shim"}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--round", type=int,
+                   default=int(os.environ.get("GRAFT_ROUND", "5")))
+    p.add_argument("--log", default=os.path.join(REPO, "logs",
+                                                 "shim_fidelity.jsonl"))
+    args = p.parse_args()
+
+    rows = {}
+    with open(args.log) as f:
+        for line in f:
+            rec = json.loads(line)
+            rows[(rec["model"], rec["ci_input"])] = rec  # last run wins
+
+    cells, n_pass, n_fail, n_stub = {}, 0, 0, 0
+    for (model, ci), rec in sorted(rows.items()):
+        cell = cells.setdefault(model, {})
+        entry = {"status": rec["status"],
+                 "thresholds_ref": rec["thresholds_ref"]}
+        for k in ("total_rmse", "head_rmse", "head_sample_mae",
+                  "train_secs", "detail"):
+            if k in rec:
+                entry[k] = rec[k]
+        if rec["status"] == "pass":
+            n_pass += 1
+        elif rec["status"] == "error" and model in KNOWN_STUBS:
+            entry["known_stub"] = KNOWN_STUBS[model]
+            n_stub += 1
+        else:
+            n_fail += 1
+        cell[ci] = entry
+
+    out = {
+        "metric": "reference_ci_battery_under_anchor_shims",
+        "round": args.round,
+        "protocol": (
+            "the reference's tests/test_graphs.py::unittest_train_model "
+            "run UNMODIFIED (its own configs, data generator, budget, and "
+            "thresholds) with tools/ref_anchor/shims supplying the "
+            "torch_geometric/torch_scatter/mpi4py surface — validates "
+            "that the shims reproduce the training behavior the "
+            "reference's CI certifies, discharging the ANCHOR artifacts' "
+            "fidelity assumption"),
+        "cells_pass": n_pass, "cells_fail": n_fail,
+        "cells_known_stub": n_stub,
+        "models": cells,
+        "conclusion": (
+            "shims faithful" if n_fail == 0 else "fidelity gaps present"),
+    }
+    path = os.path.join(REPO, f"SHIM_FIDELITY_r{args.round:02d}.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"path": path, "cells_pass": n_pass,
+                      "cells_fail": n_fail, "cells_known_stub": n_stub}))
+
+
+if __name__ == "__main__":
+    main()

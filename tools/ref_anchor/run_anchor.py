@@ -48,7 +48,7 @@ HIDDEN = 64
 NUM_CONV = 3
 LR = 2e-3
 
-MODELS = ["SchNet", "EGNN", "PAINN", "PNAPlus"]
+MODELS = ["SchNet", "EGNN", "PAINN", "PNAPlus", "MACE"]
 
 
 def make_samples():
@@ -84,7 +84,7 @@ def anchor_config(model_type):
                 "envelope_exponent": 5, "int_emb_size": 16,
                 "basis_emb_size": 8, "out_emb_size": 32,
                 "num_before_skip": 1, "num_after_skip": 1,
-                "max_ell": 2, "node_max_ell": 1,
+                "max_ell": 2, "node_max_ell": 1, "correlation": [2],
                 "equivariance": model_type in ("EGNN", "SchNet", "PAINN"),
                 "output_heads": {"node": {
                     "num_headlayers": 2,
@@ -115,6 +115,10 @@ def anchor_config(model_type):
 
 # ----------------------------------------------------------------- ref side
 def run_reference(model_type):
+    # per-process DDP master port: two concurrent ref-side runs (e.g. the
+    # anchor next to the shim-fidelity battery) must not race the default
+    os.environ.setdefault("HYDRAGNN_MASTER_PORT",
+                          str(20000 + os.getpid() % 20000))
     sys.path.insert(0, SHIMS)
     sys.path.insert(0, "/root/reference")
     samples, (tr, va, te) = make_samples()

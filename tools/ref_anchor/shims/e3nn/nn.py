@@ -1,5 +1,66 @@
-from ._dummy import Dummy
+"""Functional e3nn.nn subset for the reference MACE under the shims:
+Activation (scalar-irrep activations) and FullyConnectedNet (MLP with
+e3nn's normalized-weight convention). Reference usage:
+hydragnn/utils/model/mace_utils/modules/blocks.py:71,325 and
+hydragnn/models/MACEStack.py:546-591. Written from the documented
+semantics; NOT a copy of e3nn.
+"""
+import math
+
+import torch
+
+from .o3 import Irreps
+
+
+class Activation(torch.nn.Module):
+    """Apply scalar activations entry-wise to the scalar (l=0) irreps;
+    non-scalar entries pass through unchanged (the reference only ever
+    activates scalar stacks). `acts` has one entry per irreps entry;
+    None means identity."""
+
+    def __init__(self, irreps_in, acts):
+        super().__init__()
+        self.irreps_in = Irreps(irreps_in)
+        if len(acts) == 1 and len(self.irreps_in) > 1:
+            acts = list(acts) * len(self.irreps_in)
+        assert len(acts) == len(self.irreps_in), (self.irreps_in, acts)
+        for mi, act in zip(self.irreps_in, acts):
+            if act is not None and mi.ir.l != 0:
+                raise ValueError(
+                    f"Activation on non-scalar irrep {mi.ir}")
+        self.acts = list(acts)
+        self._slices = self.irreps_in.slices()
+        self.irreps_out = self.irreps_in
+
+    def forward(self, x):
+        parts = []
+        for sl, act in zip(self._slices, self.acts):
+            blk = x[..., sl]
+            parts.append(act(blk) if act is not None else blk)
+        return torch.cat(parts, dim=-1) if len(parts) > 1 else parts[0]
+
+
+class FullyConnectedNet(torch.nn.Module):
+    """MLP over scalars with e3nn's convention: weights ~ N(0,1), each
+    layer divides by sqrt(fan_in), activation between layers (none after
+    the last). `hs` is the [in, hidden..., out] width list."""
+
+    def __init__(self, hs, act=None):
+        super().__init__()
+        self.hs = list(hs)
+        self.act = act
+        self.weights = torch.nn.ParameterList(
+            torch.nn.Parameter(torch.randn(h_in, h_out))
+            for h_in, h_out in zip(self.hs[:-1], self.hs[1:]))
+
+    def forward(self, x):
+        for i, w in enumerate(self.weights):
+            x = x @ w / math.sqrt(w.shape[0])
+            if self.act is not None and i + 1 < len(self.weights):
+                x = self.act(x)
+        return x
 
 
 def __getattr__(name):
+    from ._dummy import Dummy
     return Dummy(f"e3nn.nn.{name}")

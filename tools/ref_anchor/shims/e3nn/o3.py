@@ -1,5 +1,442 @@
-from ._dummy import Dummy
+"""Functional e3nn.o3 subset for running the reference's MACE under the
+anchor shims (round-4 verdict Next #8: add MACE to the cross-framework
+anchor, which requires the unmodified reference MACEStack to train).
+
+Implements exactly the surface MACEStack + mace_utils exercise
+(reference: hydragnn/models/MACEStack.py:57,124-180, mace_utils/modules/
+blocks.py:41-349, mace_utils/tools/cg.py:22-136, utils/model/
+irreps_tools.py:15-86): Irrep/Irreps algebra, wigner_3j, Linear,
+a "uvu" TensorProduct, and SphericalHarmonics.
+
+Everything is derived from first principles (sympy complex CG + the
+complex->real change of basis; associated-Legendre recurrences for the
+real spherical harmonics) — the same derivation hydragnn_tpu/ops/
+irreps.py uses on the JAX side, re-rendered in torch. The conventions
+are internally self-consistent (one real basis, m = -l..l, component
+normalization), which is what training fidelity requires; overall signs
+of individual wigner blocks are free (absorbed by trainable weights).
+NOT a copy of e3nn.
+"""
+import collections
+import functools
+import math
+
+import numpy as np
+import torch
+
+
+# --------------------------------------------------------------------------
+# Irrep / Irreps
+# --------------------------------------------------------------------------
+
+@functools.total_ordering
+class Irrep:
+    __slots__ = ("l", "p")
+
+    def __init__(self, l, p=None):
+        if p is None:
+            if isinstance(l, Irrep):
+                l, p = l.l, l.p
+            elif isinstance(l, str):
+                s = l.strip()
+                p = {"e": 1, "o": -1}[s[-1]]
+                l = int(s[:-1])
+            elif isinstance(l, (tuple, list)):
+                l, p = l
+            else:
+                raise ValueError(f"cannot parse Irrep from {l!r}")
+        assert p in (1, -1) and int(l) >= 0, (l, p)
+        object.__setattr__(self, "l", int(l))
+        object.__setattr__(self, "p", int(p))
+
+    def __setattr__(self, *a):
+        raise AttributeError("Irrep is immutable")
+
+    @property
+    def dim(self):
+        return 2 * self.l + 1
+
+    def __mul__(self, other):
+        other = Irrep(other)
+        p = self.p * other.p
+        return [Irrep(l, p) for l in
+                range(abs(self.l - other.l), self.l + other.l + 1)]
+
+    def __eq__(self, other):
+        try:
+            other = Irrep(other)
+        except (ValueError, KeyError, TypeError, IndexError, AssertionError):
+            return NotImplemented
+        return (self.l, self.p) == (other.l, other.p)
+
+    def __hash__(self):
+        return hash((self.l, self.p))
+
+    def __lt__(self, other):
+        other = Irrep(other)
+        # e3nn ordering: for each l the natural parity (-1)^l sorts first
+        return (self.l, -self.p * (-1) ** self.l) < \
+            (other.l, -other.p * (-1) ** other.l)
+
+    def __repr__(self):
+        return f"{self.l}{'e' if self.p == 1 else 'o'}"
+
+    def __iter__(self):
+        # allows tuple(ir) / l, p = ir
+        yield self.l
+        yield self.p
+
+
+class _MulIr(collections.namedtuple("_MulIr", ["mul", "ir"])):
+    @property
+    def dim(self):
+        return self.mul * self.ir.dim
+
+    def __repr__(self):
+        return f"{self.mul}x{self.ir}"
+
+
+class Irreps(tuple):
+    def __new__(cls, irreps=None):
+        if irreps is None:
+            return super().__new__(cls, ())
+        if isinstance(irreps, Irreps):
+            return super().__new__(cls, irreps)
+        if isinstance(irreps, Irrep):
+            return super().__new__(cls, (_MulIr(1, irreps),))
+        if isinstance(irreps, str):
+            entries = []
+            for part in irreps.split("+"):
+                part = part.strip()
+                if not part:
+                    continue
+                if "x" in part:
+                    mul, ir = part.split("x")
+                    entries.append(_MulIr(int(mul), Irrep(ir.strip())))
+                else:
+                    entries.append(_MulIr(1, Irrep(part)))
+            return super().__new__(cls, entries)
+        entries = []
+        for item in irreps:
+            if isinstance(item, _MulIr):
+                entries.append(item)
+            elif isinstance(item, Irrep):
+                entries.append(_MulIr(1, item))
+            elif isinstance(item, str):
+                entries.extend(Irreps(item))
+            else:
+                mul, ir = item
+                entries.append(_MulIr(int(mul), Irrep(ir)))
+        return super().__new__(cls, entries)
+
+    @property
+    def dim(self):
+        return sum(mi.dim for mi in self)
+
+    @property
+    def num_irreps(self):
+        return sum(mi.mul for mi in self)
+
+    @property
+    def lmax(self):
+        return max(mi.ir.l for mi in self)
+
+    @property
+    def ls(self):
+        return [mi.ir.l for mi in self for _ in range(mi.mul)]
+
+    def count(self, ir):
+        ir = Irrep(ir)
+        return sum(mi.mul for mi in self if mi.ir == ir)
+
+    def __contains__(self, item):
+        try:
+            ir = Irrep(item)
+        except (ValueError, KeyError, TypeError, IndexError, AssertionError):
+            return super().__contains__(item)
+        return any(mi.ir == ir for mi in self)
+
+    def slices(self):
+        out, i = [], 0
+        for mi in self:
+            out.append(slice(i, i + mi.dim))
+            i += mi.dim
+        return out
+
+    def sort(self):
+        Ret = collections.namedtuple("sort", ["irreps", "p", "inv"])
+        order = sorted(range(len(self)), key=lambda i: self[i].ir)
+        inv = tuple(order)                       # inv[new] = old
+        p = tuple(inv.index(i) for i in range(len(self)))  # p[old] = new
+        return Ret(Irreps([self[i] for i in order]), p, inv)
+
+    def simplify(self):
+        out = []
+        for mi in self:
+            if out and out[-1].ir == mi.ir:
+                out[-1] = _MulIr(out[-1].mul + mi.mul, mi.ir)
+            elif mi.mul > 0:
+                out.append(mi)
+        return Irreps(out)
+
+    def __add__(self, other):
+        return Irreps(tuple(self) + tuple(Irreps(other)))
+
+    def __mul__(self, n):
+        # e3nn: Irreps * k repeats the entry list k times
+        return Irreps(tuple.__mul__(self, n))
+
+    def __rmul__(self, n):
+        return Irreps(tuple.__mul__(self, n))
+
+    def __repr__(self):
+        return "+".join(f"{mi}" for mi in self)
+
+    @classmethod
+    def spherical_harmonics(cls, lmax, p=-1):
+        return cls([(1, (l, p ** l)) for l in range(lmax + 1)])
+
+
+# --------------------------------------------------------------------------
+# wigner_3j (real basis, unit Frobenius norm)
+# --------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _complex_to_real(l):
+    """Unitary U with Y_real = U @ Y_complex, rows m = -l..l."""
+    dim = 2 * l + 1
+    U = np.zeros((dim, dim), complex)
+    for m in range(-l, l + 1):
+        i = m + l
+        if m < 0:
+            U[i, -m + l] = 1j / np.sqrt(2) * (-1) ** m * -1
+            U[i, m + l] = 1j / np.sqrt(2)
+        elif m == 0:
+            U[i, l] = 1.0
+        else:
+            U[i, m + l] = (-1) ** m / np.sqrt(2)
+            U[i, -m + l] = 1 / np.sqrt(2)
+    return U
+
+
+@functools.lru_cache(maxsize=None)
+def _real_cg(l1, l2, l3):
+    """Real-basis CG C[m1, m2, m3] for l1 x l2 -> l3, unit Frobenius norm."""
+    from sympy import S
+    from sympy.physics.quantum.cg import CG
+    d1, d2, d3 = 2 * l1 + 1, 2 * l2 + 1, 2 * l3 + 1
+    Cc = np.zeros((d1, d2, d3), complex)
+    for m1 in range(-l1, l1 + 1):
+        for m2 in range(-l2, l2 + 1):
+            m3 = m1 + m2
+            if abs(m3) > l3:
+                continue
+            Cc[m1 + l1, m2 + l2, m3 + l3] = float(
+                CG(S(l1), S(m1), S(l2), S(m2), S(l3), S(m3)).doit())
+    U1, U2, U3 = (_complex_to_real(l) for l in (l1, l2, l3))
+    C = np.einsum("am,bn,co,mno->abc", U1.conj(), U2.conj(), U3, Cc)
+    C = C.imag if np.abs(C.imag).max() > np.abs(C.real).max() else C.real
+    n = np.linalg.norm(C)
+    return (C / n if n > 0 else C).astype(np.float64)
+
+
+def wigner_3j(l1, l2, l3, dtype=None, device=None):
+    """[d1, d2, d3] invariant tensor, ||.||_F = 1 (a basis of the 1-D
+    invariant subspace of l1 x l2 x l3 — e3nn's wigner_3j up to overall
+    sign, which trainable weights absorb)."""
+    if abs(l2 - l3) > l1 or l1 > l2 + l3:
+        C = np.zeros((2 * l1 + 1, 2 * l2 + 1, 2 * l3 + 1))
+    else:
+        # our CG is C[m2, m3, m1] for l2 x l3 -> l1; permute to (l1, l2, l3)
+        C = np.transpose(_real_cg(l2, l3, l1), (2, 0, 1))
+    return torch.tensor(C, dtype=dtype or torch.get_default_dtype(),
+                        device=device)
+
+
+# --------------------------------------------------------------------------
+# Real spherical harmonics (component normalization)
+# --------------------------------------------------------------------------
+
+def _rsh(vec, lmax, normalize=True, eps=1e-9):
+    """vec [..., 3] -> [..., (lmax+1)^2]; m = -l..l, component norm
+    (sum_m Y_lm^2 = 2l+1 on the sphere). Associated-Legendre recurrence."""
+    if normalize:
+        r = torch.sqrt((vec * vec).sum(-1, keepdim=True) + eps)
+        vec = vec / r
+    x, y, z = vec[..., 0], vec[..., 1], vec[..., 2]
+    A, B = [torch.ones_like(x)], [torch.zeros_like(x)]
+    for m in range(1, lmax + 1):
+        A.append(x * A[m - 1] - y * B[m - 1])
+        B.append(x * B[m - 1] + y * A[m - 1])
+    q = [dict() for _ in range(lmax + 1)]
+    dfact = 1.0
+    for m in range(lmax + 1):
+        if m > 0:
+            dfact *= (2 * m - 1)
+        q[m][m] = torch.full_like(z, dfact)
+        if m + 1 <= lmax:
+            q[m][m + 1] = (2 * m + 1) * z * q[m][m]
+        for l in range(m + 2, lmax + 1):
+            q[m][l] = ((2 * l - 1) * z * q[m][l - 1]
+                       - (l + m - 1) * q[m][l - 2]) / (l - m)
+    cols = []
+    for l in range(lmax + 1):
+        for m in range(-l, l + 1):
+            am = abs(m)
+            n = math.sqrt((2 * l + 1) * math.factorial(l - am)
+                          / math.factorial(l + am))
+            if m != 0:
+                n *= math.sqrt(2.0)
+            azi = B[am] if m < 0 else A[am]
+            cols.append(n * q[am][l] * azi)
+    return torch.stack(cols, dim=-1)
+
+
+class SphericalHarmonics(torch.nn.Module):
+    def __init__(self, irreps_out, normalize=True,
+                 normalization="component"):
+        super().__init__()
+        if isinstance(irreps_out, int):
+            irreps_out = Irreps.spherical_harmonics(irreps_out)
+        self.irreps_out = Irreps(irreps_out)
+        self.lmax = self.irreps_out.lmax
+        self.normalize = normalize
+        assert normalization == "component", normalization
+
+    def forward(self, vec):
+        return _rsh(vec, self.lmax, normalize=self.normalize)
+
+
+def spherical_harmonics(irreps_out, vec, normalize=True,
+                        normalization="component"):
+    return SphericalHarmonics(irreps_out, normalize, normalization)(vec)
+
+
+# --------------------------------------------------------------------------
+# Linear (irrep-wise channel mixing, e3nn path normalization)
+# --------------------------------------------------------------------------
+
+class Linear(torch.nn.Module):
+    def __init__(self, irreps_in, irreps_out, internal_weights=True,
+                 shared_weights=True, biases=False):
+        super().__init__()
+        assert internal_weights and shared_weights, \
+            "shim o3.Linear supports internal shared weights only"
+        assert not biases, "shim o3.Linear has no bias (e3nn default)"
+        self.irreps_in = Irreps(irreps_in)
+        self.irreps_out = Irreps(irreps_out)
+        in_slices = self.irreps_in.slices()
+        self.paths = []   # (in_slice, out_entry_index, ir_dim, w_idx, norm)
+        self.weights = torch.nn.ParameterList()
+        for oi, mi_out in enumerate(self.irreps_out):
+            fan_in = self.irreps_in.count(mi_out.ir)
+            for mi_in, sl_in in zip(self.irreps_in, in_slices):
+                if mi_in.ir != mi_out.ir:
+                    continue
+                self.weights.append(torch.nn.Parameter(
+                    torch.randn(mi_in.mul, mi_out.mul)))
+                norm = 1.0 / math.sqrt(fan_in) if fan_in else 0.0
+                self.paths.append(
+                    (sl_in, oi, mi_out.ir.dim,
+                     len(self.weights) - 1, norm))
+        self.weight_numel = sum(w.numel() for w in self.weights)
+
+    def forward(self, x):
+        # accumulate per output entry and cat once: in-place slice
+        # assignment made autograd spend its time in SliceBackward copies
+        acc = [None] * len(self.irreps_out)
+        for sl_in, out_idx, d, wi, norm in self.paths:
+            w = self.weights[wi]
+            blk = x[..., sl_in].reshape(*x.shape[:-1], -1, d)  # [..., u, m]
+            y = torch.einsum("...um,uv->...vm", blk, w) * norm
+            y = y.reshape(*x.shape[:-1], -1)
+            acc[out_idx] = y if acc[out_idx] is None else acc[out_idx] + y
+        parts = []
+        for mi_out, a in zip(self.irreps_out, acc):
+            parts.append(a if a is not None else
+                         x.new_zeros(*x.shape[:-1], mi_out.dim))
+        return torch.cat(parts, dim=-1) if len(parts) != 1 else parts[0]
+
+
+# --------------------------------------------------------------------------
+# TensorProduct ("uvu" instructions, external per-edge weights)
+# --------------------------------------------------------------------------
+
+class TensorProduct(torch.nn.Module):
+    """The single configuration the reference builds (blocks.py:301-308):
+    connected "uvu" trainable instructions, shared_weights=False,
+    internal_weights=False — weights arrive per-edge from the radial MLP.
+    """
+
+    def __init__(self, irreps_in1, irreps_in2, irreps_out, instructions,
+                 shared_weights=False, internal_weights=False):
+        super().__init__()
+        assert not shared_weights and not internal_weights, \
+            "shim TensorProduct expects external per-sample weights"
+        self.irreps_in1 = Irreps(irreps_in1)
+        self.irreps_in2 = Irreps(irreps_in2)
+        self.irreps_out = Irreps(irreps_out)
+        sl1 = self.irreps_in1.slices()
+        sl2 = self.irreps_in2.slices()
+
+        # fan-in per output slot for variance-preserving normalization:
+        # number of (path, v-channel) contributions into each k
+        fan = [0] * len(self.irreps_out)
+        for (i, j, k, mode, train) in instructions:
+            assert mode == "uvu" and train, (mode, train)
+            fan[k] += self.irreps_in2[j].mul
+        self.instr = []
+        w_off = 0
+        for (i, j, k, mode, train) in instructions:
+            mi1, mi2, mi3 = (self.irreps_in1[i], self.irreps_in2[j],
+                             self.irreps_out[k])
+            assert mi3.mul == mi1.mul, "uvu keeps in1 multiplicity"
+            C = wigner_3j(mi3.ir.l, mi1.ir.l, mi2.ir.l) \
+                * math.sqrt(mi3.ir.dim)          # component normalization
+            nw = mi1.mul * mi2.mul
+            # pre-flatten to the [d2, d3*d1] matmul layout forward uses
+            self.register_buffer(
+                f"_cg_{len(self.instr)}",
+                C.permute(2, 0, 1).reshape(mi2.ir.dim, -1).contiguous())
+            self.instr.append((sl1[i], sl2[j], k, mi1.mul, mi2.mul,
+                               mi1.ir.dim, mi2.ir.dim, mi3.ir.dim,
+                               slice(w_off, w_off + nw),
+                               1.0 / math.sqrt(fan[k])))
+            w_off += nw
+        self.weight_numel = w_off
+
+    def forward(self, x1, x2, weight):
+        n = x1.shape[0]
+        acc = [None] * len(self.irreps_out)
+        for idx, (s1, s2, k, u, v, d1, d2, d3, sw, norm) in \
+                enumerate(self.instr):
+            Cm = getattr(self, f"_cg_{idx}")     # [d2, d3*d1]
+            a = x1[:, s1].reshape(n, u, d1)
+            w = weight[:, sw].reshape(n, u, v)
+            # BLAS-shaped path (the generic 4-operand einsum was the
+            # anchor's CPU bottleneck): weight-contract the v channels,
+            # matmul against the flattened CG, then a batched dot over i
+            if v == 1:
+                # one GEMM for the CG contraction, then a [u,d1]@[d1,d3]
+                # bmm batched over edges only — batching over edges*u
+                # made bmm the bottleneck (3.3M tiny matmuls)
+                m = (x2[:, s2] @ Cm).reshape(n, d3, d1)
+                q = torch.bmm(a, m.transpose(1, 2))       # [n, u, d3]
+                y = (q * w.reshape(n, u, 1) * norm).reshape(n, u * d3)
+            else:
+                b = x2[:, s2].reshape(n, v, d2)
+                t = (w @ b).reshape(n * u, d2)            # [n*u, d2]
+                z = t @ Cm                                # [n*u, d3*d1]
+                y = torch.bmm(z.reshape(n * u, d3, d1),
+                              a.reshape(n * u, d1, 1)) \
+                    .reshape(n, u * d3) * norm
+            acc[k] = y if acc[k] is None else acc[k] + y
+        parts = [a if a is not None else
+                 x1.new_zeros(n, mi.dim)
+                 for mi, a in zip(self.irreps_out, acc)]
+        return torch.cat(parts, dim=-1) if len(parts) != 1 else parts[0]
 
 
 def __getattr__(name):
+    from ._dummy import Dummy
     return Dummy(f"e3nn.o3.{name}")

@@ -62,10 +62,13 @@ class MFConv(MessagePassing):
                      dtype=torch.long).clamp_(max=self.max_degree)
         out = x.new_zeros(x.size(0), self.lins_l[0].out_channels)
         for d in range(self.max_degree + 1):
+            # apply to empty buckets too: the zero-row matmul keeps every
+            # per-degree linear in the autograd graph (zero grads), which
+            # is what real PyG MFConv does and what torch DDP's reducer
+            # requires — a conditional skip makes DDP raise unused-params
             mask = deg == d
-            if mask.any():
-                out[mask] = self.lins_l[d](x[mask]) + \
-                    self.lins_r[d](agg[mask])
+            out[mask] = self.lins_l[d](x[mask]) + \
+                self.lins_r[d](agg[mask])
         return out
 
 
