@@ -1,0 +1,148 @@
+"""Multi-process (multi-host) SPMD wiring for run_training.
+
+The reference scales across nodes with MPI ranks + DDP + DistributedSampler
+(reference: hydragnn/utils/distributed/distributed.py:101-160 setup_ddp,
+preprocess/load_data.py:236-244); here every process holds a slice of the
+data, all processes execute ONE program over a global device mesh, and the
+per-process batch slices are assembled into global arrays with
+`jax.make_array_from_process_local_data` — the collectives ride the mesh
+(ICI within a host, DCN across hosts), not explicit NCCL calls.
+
+Used by run_training when jax.process_count() > 1 on the plain-SPMD path:
+  * validate_multiprocess_spmd  — split the global shard/batch budget into
+    per-process loader settings;
+  * allreduce_max_int / sync_config_stats — dataset statistics that shape
+    the padded batch or the model (bucket sizes, neighbor K, pna_deg,
+    normalization ranges) must be GLOBAL, or processes would compile
+    different programs and diverge;
+  * make_multiprocess_place_fn — per-process [D_local, ...] stacks ->
+    global [D_global, ...] arrays on the mesh;
+  * slice_by_process — contiguous per-process slice for replicated inputs
+    (HYDRAGNN_MP_DATA=replicated; per-host GraphStore shards are already
+    local and skip this).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+
+
+def is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def validate_multiprocess_spmd(num_shards: int, batch_size: int):
+    """Per-process (loader) shard count and batch size for a global SPMD
+    run: every process feeds its local devices' slice of the global batch."""
+    nproc = jax.process_count()
+    nlocal = jax.local_device_count()
+    if num_shards % nproc:
+        raise ValueError(
+            f"num_shards {num_shards} must divide evenly over "
+            f"{nproc} processes")
+    if batch_size % nproc:
+        raise ValueError(
+            f"batch_size {batch_size} must divide evenly over "
+            f"{nproc} processes")
+    local_shards = num_shards // nproc
+    if local_shards > nlocal:
+        raise ValueError(
+            f"{local_shards} shards per process > {nlocal} local devices")
+    return local_shards, batch_size // nproc
+
+
+def allreduce_max_int(*vals: int):
+    """Element-wise max of small int tuples across processes (bucket
+    sizes, neighbor K — anything that shapes the compiled program)."""
+    from jax.experimental import multihost_utils
+    arr = multihost_utils.process_allgather(
+        np.asarray(vals, np.int64), tiled=False)
+    return tuple(int(v) for v in np.asarray(arr).reshape(
+        jax.process_count(), len(vals)).max(axis=0))
+
+
+def assert_equal_across_processes(value: int, what: str):
+    from jax.experimental import multihost_utils
+    arr = np.asarray(multihost_utils.process_allgather(
+        np.asarray([value], np.int64))).reshape(-1)
+    if not (arr == arr[0]).all():
+        raise ValueError(
+            f"{what} differs across processes ({arr.tolist()}): every "
+            "process must run the same number of steps or the collectives "
+            "deadlock — equalize the per-host dataset shards")
+
+
+def sync_config_stats(config: dict) -> dict:
+    """Globally reduce data-derived config statistics when each process
+    computed them from only its local shard: pna_deg histograms add
+    (exact-sum merge, same policy as parallel/multidataset.py), minmax
+    ranges widen. No-op single-process."""
+    if not is_multiprocess():
+        return config
+    from jax.experimental import multihost_utils
+    arch = config["NeuralNetwork"]["Architecture"]
+    deg = arch.get("pna_deg")
+    if deg is not None:
+        local = np.asarray(deg, np.int64)
+        n = allreduce_max_int(len(local))[0]
+        padded = np.zeros(n, np.int64)
+        padded[:len(local)] = local
+        gathered = np.asarray(multihost_utils.process_allgather(padded))
+        merged = gathered.reshape(jax.process_count(), n).sum(axis=0)
+        arch["pna_deg"] = [int(v) for v in merged]
+        arch["max_neighbours"] = len(merged) - 1
+    voi = config["NeuralNetwork"].get("Variables_of_interest", {})
+    for key, reduce_cols in (("x_minmax", None), ("y_minmax", None)):
+        mm = voi.get(key)
+        if mm is None:
+            continue
+        local = np.asarray(mm, np.float64)  # [2, F] rows (min, max)
+        gathered = np.asarray(multihost_utils.process_allgather(local))
+        gathered = gathered.reshape(jax.process_count(), *local.shape)
+        voi[key] = np.stack([gathered[:, 0].min(axis=0),
+                             gathered[:, 1].max(axis=0)]).tolist()
+    return config
+
+
+def spmd_mesh_devices(num_shards: int):
+    """Device list for a multi-process data mesh: local_shards devices
+    from EVERY process, in process order. jax.devices()[:n] would take
+    them all from process 0 and leave later processes with no
+    addressable shard (make_array_from_process_local_data then fails)."""
+    nproc = jax.process_count()
+    per = num_shards // nproc
+    devs = []
+    for p in range(nproc):
+        devs.extend([d for d in jax.devices()
+                     if d.process_index == p][:per])
+    return devs
+
+
+def make_multiprocess_place_fn(mesh, axis: str = "data"):
+    """Assemble each process's [D_local, ...] stacked batch into a global
+    [D_global, ...] jax.Array sharded over `axis` (the cross-host
+    DistributedSampler+DDP input path, re-done as global arrays)."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+    sh = NamedSharding(mesh, P(axis))
+
+    def place(batch):
+        return jax.tree_util.tree_map(
+            lambda a: None if a is None else
+            jax.make_array_from_process_local_data(sh, np.asarray(a)),
+            batch)
+    return place
+
+
+def slice_by_process(ds, nproc: Optional[int] = None,
+                     rank: Optional[int] = None):
+    """Contiguous per-process slice (equal sizes; the tail is dropped so
+    every process runs the same step count)."""
+    ds = list(ds)
+    nproc = nproc or jax.process_count()
+    rank = jax.process_index() if rank is None else rank
+    per = len(ds) // nproc
+    return ds[rank * per:(rank + 1) * per]

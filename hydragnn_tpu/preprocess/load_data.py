@@ -59,24 +59,43 @@ def _split_by_order(dataset, order, perc_train):
     return tr, va, te
 
 
+def loader_budgets(all_samples, graphs_per_shard: int,
+                   neighbor_format: bool = False, reduce_fn=None):
+    """The static shapes that define the compiled program: padded
+    node/edge budgets per shard and the dense neighbor K. `reduce_fn`
+    lets a multi-process caller globally max-reduce the RAW statistics
+    before bucketing, so every process compiles the same shapes."""
+    from ..graphs.batch import BucketSpec, neighbor_budget_for_dataset
+    mx_n = max(s.num_nodes for s in all_samples)
+    mx_e = max(s.num_edges for s in all_samples)
+    k = neighbor_budget_for_dataset(all_samples) if neighbor_format else 0
+    if reduce_fn is not None:
+        mx_n, mx_e, k = reduce_fn(mx_n, mx_e, k)
+    b = BucketSpec(multiple=64)
+    return (b.bucket(mx_n * graphs_per_shard + 1),
+            b.bucket(mx_e * graphs_per_shard + 1),
+            k if neighbor_format else None)
+
+
 def create_dataloaders(trainset, valset, testset, batch_size: int,
                        num_shards: int = 1, seed: int = 0,
                        n_node_per_shard: Optional[int] = None,
                        n_edge_per_shard: Optional[int] = None,
-                       batch_transform=None, neighbor_format: bool = False):
+                       batch_transform=None, neighbor_format: bool = False,
+                       neighbor_k: Optional[int] = None):
     """reference: load_data.py:225-296 — DataLoader + DistributedSampler;
     here one static-shape loader per split, all sharing the max padded shape
     so train/val/test reuse one compiled program."""
     all_samples = list(trainset) + list(valset) + list(testset)
     if n_node_per_shard is None or n_edge_per_shard is None:
         g = max(batch_size // num_shards, 1)
-        from ..graphs.batch import BucketSpec
-        b = BucketSpec(multiple=64)
-        n_node_per_shard = b.bucket(max(s.num_nodes for s in all_samples) * g + 1)
-        n_edge_per_shard = b.bucket(max(s.num_edges for s in all_samples) * g + 1)
-    neighbor_k = None
-    if neighbor_format:
+        n_node_per_shard, n_edge_per_shard, k = loader_budgets(
+            all_samples, g, neighbor_format)
+        if neighbor_k is None:
+            neighbor_k = k
+    if neighbor_format and neighbor_k is None:
         # one K for all three splits so they share one compiled program
+        # (a multi-process caller passes the globally-reduced K instead)
         from ..graphs.batch import neighbor_budget_for_dataset
         neighbor_k = neighbor_budget_for_dataset(all_samples)
     mk = lambda ds, shuffle: GraphDataLoader(

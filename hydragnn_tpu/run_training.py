@@ -110,6 +110,29 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     datasets = (trainset, valset, testset)
 
     config = update_config(config, trainset, valset, testset)
+
+    # multi-process (multi-host) data wiring: with replicated inputs every
+    # process keeps its contiguous slice (stats above saw the full data);
+    # with per-host shards (GraphStore shard dirs) the data is already
+    # local and the data-derived config stats must be globally reduced
+    # instead (reference analogue: DistributedSampler + the MPI allreduces
+    # in AbstractRawDataset, load_data.py:236-244 / raw_dataset_loader)
+    from .parallel.multiprocess import is_multiprocess
+    if is_multiprocess():
+        from .parallel.multiprocess import (slice_by_process,
+                                            sync_config_stats)
+        mp_data = os.environ.get(
+            "HYDRAGNN_MP_DATA",
+            "local" if (os.environ.get("HYDRAGNN_GS_SHARD_DIR")
+                        or os.environ.get("HYDRAGNN_GS_SHARD_ROOT"))
+            else "replicated")
+        if mp_data == "replicated":
+            trainset = slice_by_process(trainset)
+            valset = slice_by_process(valset)
+            testset = slice_by_process(testset)
+            datasets = (trainset, valset, testset)
+        else:
+            config = sync_config_stats(config)
     log_name = get_log_name_config(config)
     setup_log(log_name)
     save_config(config, log_name)
@@ -157,6 +180,25 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
             device_budget=(ndev // graph_shards) if graph_shards > 1
             else None)
 
+    # multi-process SPMD: the global shard/batch budget splits across
+    # processes — each loader feeds only its local devices' slice
+    mp_spmd = (is_multiprocess() and pipeline_stages == 1
+               and graph_shards == 1 and num_shards > 1)
+    if is_multiprocess() and not mp_spmd:
+        # per-process data + local loader budgets compose ONLY with the
+        # plain SPMD path; on any other path processes would compile
+        # different programs over the shared mesh (or skip gradient sync)
+        raise ValueError(
+            "multi-process runs support the plain SPMD data-parallel "
+            "path only: pipeline_stages and graph_shards must be 1 and "
+            f"num_shards > 1 (got pipeline_stages={pipeline_stages}, "
+            f"graph_shards={graph_shards}, num_shards={num_shards})")
+    local_shards, local_batch = num_shards, batch_size
+    if mp_spmd:
+        from .parallel.multiprocess import validate_multiprocess_spmd
+        local_shards, local_batch = validate_multiprocess_spmd(
+            num_shards, batch_size)
+
     from .graphs.triplets import maybe_triplet_transform
     batch_transform = maybe_triplet_transform(
         nn["Architecture"]["model_type"], trainset + valset + testset,
@@ -188,9 +230,39 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         dd.populate(trainset, 0, len(trainset), [0, len(trainset)])
         train_source = dd
 
+    # the padded batch shape and neighbor K shape the compiled program —
+    # in a multi-process run they must be computed from GLOBAL statistics
+    # or processes would compile different programs and deadlock
+    mp_loader_kwargs = {}
+    if mp_spmd:
+        if batch_transform is not None:
+            raise ValueError(
+                "multi-process SPMD does not support triplet-transform "
+                "models yet (the static triplet budget is not globally "
+                "reduced; train DimeNet single-process)")
+        from .parallel.multiprocess import allreduce_max_int
+        from .preprocess.load_data import loader_budgets
+        n_node, n_edge, k_glob = loader_budgets(
+            trainset + valset + testset,
+            max(local_batch // local_shards, 1), nbr_fmt,
+            reduce_fn=lambda *v: allreduce_max_int(*v))
+        mp_loader_kwargs = dict(n_node_per_shard=n_node,
+                                n_edge_per_shard=n_edge)
+        if nbr_fmt:
+            mp_loader_kwargs["neighbor_k"] = k_glob
+
     train_loader, val_loader, test_loader = create_dataloaders(
-        train_source, valset, testset, batch_size, num_shards=num_shards,
-        batch_transform=batch_transform, neighbor_format=nbr_fmt)
+        train_source, valset, testset, local_batch,
+        num_shards=local_shards,
+        batch_transform=batch_transform, neighbor_format=nbr_fmt,
+        **mp_loader_kwargs)
+
+    if mp_spmd:
+        # unequal per-host step counts deadlock the collectives
+        from .parallel.multiprocess import assert_equal_across_processes
+        for name, ld in (("train", train_loader), ("validate", val_loader),
+                         ("test", test_loader)):
+            assert_equal_across_processes(len(ld), f"{name} batches/epoch")
 
     # init on one shard-shaped batch
     from .graphs.batch import collate
@@ -250,16 +322,23 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     loss_name = train_cfg.get("loss_function_type", "mse")
     cge = bool(train_cfg.get("compute_grad_energy", False))
     if pipeline_stages > 1:
-        if cge:
-            raise ValueError("pipeline_stages does not support "
-                             "compute_grad_energy yet")
-        from .parallel.pipeline_trainer import (make_pipeline_eval_step,
+        from .parallel.pipeline_trainer import (make_pipeline_ef_eval_step,
+                                                make_pipeline_ef_train_step,
+                                                make_pipeline_eval_step,
                                                 make_pipeline_train_step)
         mesh = make_mesh((("pipe", pipeline_stages),))
-        train_step = make_pipeline_train_step(mcfg, mesh, pipeline_stages,
-                                              tx, loss_name)
-        eval_step = make_pipeline_eval_step(mcfg, mesh, pipeline_stages,
-                                            loss_name)
+        if cge:
+            # energy-force through the pipeline: the force grad and the
+            # params grad both differentiate through the GPipe schedule
+            train_step = make_pipeline_ef_train_step(
+                mcfg, mesh, pipeline_stages, tx, loss_name)
+            eval_step = make_pipeline_ef_eval_step(
+                mcfg, mesh, pipeline_stages, loss_name)
+        else:
+            train_step = make_pipeline_train_step(
+                mcfg, mesh, pipeline_stages, tx, loss_name)
+            eval_step = make_pipeline_eval_step(mcfg, mesh, pipeline_stages,
+                                                loss_name)
     elif graph_shards > 1:
         from .parallel.composite import (make_composed_eval_step,
                                          make_composed_train_step)
@@ -272,7 +351,12 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         eval_step = make_composed_eval_step(model, mcfg, loss_name,
                                             compute_grad_energy=cge)
     elif num_shards > 1:
-        mesh = make_mesh((("data", num_shards),))
+        if mp_spmd:
+            from .parallel.multiprocess import spmd_mesh_devices
+            mesh = make_mesh((("data", num_shards),),
+                             devices=spmd_mesh_devices(num_shards))
+        else:
+            mesh = make_mesh((("data", num_shards),))
         # ZeRO-equivalent optimizer-state sharding (reference:
         # Training.Optimizer.use_zero_redundancy, optimizer.py:104-113)
         opt_cfg = train_cfg.get("Optimizer", {})
@@ -295,9 +379,9 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     # math to the per-batch loop; amortizes host dispatch latency.
     multi_step = multi_eval = place_group_fn = None
     steps_per_call = resolve_steps_per_call(train_cfg)
-    if graph_shards > 1 or pipeline_stages > 1:
+    if graph_shards > 1 or pipeline_stages > 1 or mp_spmd:
         steps_per_call = 1  # dispatch grouping not composed with the
-        # (data x graph) / pipeline meshes yet
+        # (data x graph) / pipeline meshes or multi-process placement yet
     elif num_shards == 1 and steps_per_call > 1:
         from .train.train_step import (make_multi_eval_step,
                                        make_multi_train_step)
@@ -314,7 +398,9 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
             zero_min_size=zero_min)
 
     ckpt_fn = None
-    if train_cfg.get("Checkpoint", False):
+    if train_cfg.get("Checkpoint", False) and jax.process_index() == 0:
+        # multi-process: params/opt state are replicated, so rank 0's copy
+        # is the complete checkpoint; concurrent writers would race the dir
         # mid-training best-val saves run async so the epoch loop never
         # blocks on filesystem writes; the final save below synchronizes.
         # A failed optional save (the error surfaces on the NEXT save, when
@@ -367,8 +453,20 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
                     lambda a: None if a is None else a[None], b)
             return place_composed_batch(b, mesh)
     elif num_shards > 1:
-        from .parallel.mesh import shard_batch
-        place_fn = lambda b: shard_batch(b, mesh)
+        if mp_spmd:
+            from .parallel.multiprocess import make_multiprocess_place_fn
+            mp_place = make_multiprocess_place_fn(mesh)
+            if local_shards == 1:
+                # one data shard per process: the loader emits UNSTACKED
+                # batches — restore the leading shard axis before the
+                # global assembly or P("data") would shard the node axis
+                place_fn = lambda b: mp_place(jax.tree_util.tree_map(
+                    lambda a: None if a is None else a[None], b))
+            else:
+                place_fn = mp_place
+        else:
+            from .parallel.mesh import shard_batch
+            place_fn = lambda b: shard_batch(b, mesh)
     else:
         place_fn = lambda b: jax.tree_util.tree_map(
             lambda a: None if a is None else jax.device_put(a), b)

@@ -227,10 +227,10 @@ def train_validate_test(
         if run_valtest:
             val_loss, val_tasks = _eval_epoch(
                 eval_step, state, val_loader, tr, "validate",
-                multi_eval_step, steps_per_call)
+                multi_eval_step, steps_per_call, place_fn=place_fn)
             test_loss, test_tasks = _eval_epoch(
                 eval_step, state, test_loader, tr, "test",
-                multi_eval_step, steps_per_call)
+                multi_eval_step, steps_per_call, place_fn=place_fn)
         else:
             val_loss = test_loss = float("nan")
             val_tasks = test_tasks = {}
@@ -332,7 +332,8 @@ def _eval_one(eval_step, state, batch, acc: Dict[str, float]):
 
 
 def _eval_epoch(eval_step, state, loader, tr, name: str,
-                multi_eval_step=None, steps_per_call: int = 1):
+                multi_eval_step=None, steps_per_call: int = 1,
+                place_fn=None):
     """Returns (mean loss, {metric: mean}) over the loader — per-task
     losses included (reference: task_loss_val/test tracking,
     train_validate_test.py:93-96,180-187)."""
@@ -359,6 +360,10 @@ def _eval_epoch(eval_step, state, loader, tr, name: str,
                 nb += n
         else:
             for batch in loader:
+                # multi-process meshes need explicit global placement; a
+                # single process auto-places per the step's in_specs
+                if place_fn is not None:
+                    batch = place_fn(batch)
                 _eval_one(eval_step, state, batch, acc)
                 nb += 1
     means = {k: v / max(nb, 1) for k, v in acc.items()}

@@ -73,8 +73,15 @@ def build_plan(args):
         for pid, host in enumerate(args.hosts):
             inner = build_worker_command(args, process_id=pid,
                                          num_hosts=len(args.hosts))
-            plan.append((f"host {host} (process {pid})",
-                         ["ssh", host, inner]))
+            if args.local_spawn:
+                # rehearsal mode: same per-host command plan, executed by
+                # local shells instead of ssh (CI boxes without sshd —
+                # the multi-process rendezvous is still real)
+                plan.append((f"host {host} (process {pid}, local spawn)",
+                             ["bash", "-c", inner]))
+            else:
+                plan.append((f"host {host} (process {pid})",
+                             ["ssh", host, inner]))
     else:
         inner = build_worker_command(args)
         cmd = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", args.tpu,
@@ -116,6 +123,10 @@ def main(argv=None):
                         "(shard_<pid> per process)")
     p.add_argument("--env", action="append", default=[],
                    metavar="KEY=VAL", help="extra env for every worker")
+    p.add_argument("--local-spawn", action="store_true",
+                   help="hostfile mode: run each per-host command in a "
+                        "local shell instead of ssh (multi-process "
+                        "rehearsal on one box)")
     p.add_argument("--dry-run", action="store_true",
                    help="print the command plan, execute nothing")
     args = p.parse_args(argv)
