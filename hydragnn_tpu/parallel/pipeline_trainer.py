@@ -72,13 +72,14 @@ PIPELINE_CONV_TYPES = {
 
 def _schnet_conv(hidden, cfg):
     from ..models.schnet import CFConv
-    # coordinate updates (equivariant=True) mutate pos across layers,
-    # which the homogeneous block does not thread — EF training uses the
-    # sequential path
+    # equivariant SchNet threads its per-layer coordinate updates through
+    # the pipeline by riding pos in the carried activation ([N, F+3] —
+    # see _ConvBlock.carry_pos); invariant SchNet carries features only
     return CFConv(out_dim=hidden,
                   num_filters=int(cfg.num_filters or 128),
                   num_gaussians=int(cfg.num_gaussians or 50),
-                  cutoff=float(cfg.radius or 1.0), equivariant=False)
+                  cutoff=float(cfg.radius or 1.0),
+                  equivariant=bool(getattr(cfg, "equivariance", False)))
 
 
 def _edge_length_cargs(batch: GraphBatch):
@@ -121,14 +122,30 @@ class _ConvBlock(nn.Module):
     don't compose with GPipe microbatching, and GIN's eps=100 init
     (reference: GINStack.py:26-34) needs per-layer normalization to keep
     activations bounded. `model_type` selects the PIPELINE_CONV_CARGS
-    builder (e.g. SchNet's per-batch edge lengths)."""
+    builder (e.g. SchNet's per-batch edge lengths).
+
+    `carry_pos`: equivariant mode — the carried activation is [N, F+3]
+    with the (layer-updated) coordinates in the last 3 channels, so the
+    per-layer coordinate update threads stage-to-stage over the ring and
+    stays differentiable for force training. Filter edge lengths come
+    from the ORIGINAL batch positions (the cargs precompute), exactly
+    like the sequential stack: BaseStack computes conv_args once from
+    batch.pos (models/base.py:97) and only the coordinate update inside
+    CFConv sees the carried, layer-updated pos (models/schnet.py:52-60)."""
     conv: nn.Module
     activation: str
     model_type: str = ""
+    carry_pos: bool = False
 
     @nn.compact
     def __call__(self, h, batch: GraphBatch):
         act = activation_function_selection(self.activation)
+        if self.carry_pos:
+            h, pos = h[..., :-3], h[..., -3:]
+            h2, pos2 = self.conv(h, pos, batch,
+                                 _edge_length_cargs(batch))
+            h2 = act(nn.LayerNorm()(h2))
+            return jnp.concatenate([h2, pos2], axis=-1)
         cargs_fn = PIPELINE_CONV_CARGS.get(self.model_type)
         cargs = cargs_fn(batch) if cargs_fn else {}
         h2, _ = self.conv(h, batch.pos, batch, cargs)
@@ -145,6 +162,11 @@ def _head_mlp(head, act, widen):
     return MLP(dims, activation=act)
 
 
+def _carries_pos(cfg: ModelConfig) -> bool:
+    return bool(getattr(cfg, "equivariance", False)) \
+        and cfg.model_type == "SchNet"
+
+
 def init_pipeline_params(rng, cfg: ModelConfig, sample_batch: GraphBatch):
     """Parameter pytree: {"embed", "convs" ([L, ...]-stacked), "heads"}."""
     conv_fn = PIPELINE_CONV_TYPES[cfg.model_type]
@@ -156,12 +178,15 @@ def init_pipeline_params(rng, cfg: ModelConfig, sample_batch: GraphBatch):
     p_embed = embed.init(k_embed, sample_batch.x)["params"]
     x_h = jnp.zeros(sample_batch.x.shape[:-1] + (hidden,), jnp.float32)
 
+    carry_pos = _carries_pos(cfg)
     block = _ConvBlock(conv=conv_fn(hidden, cfg), activation=cfg.activation,
-                       model_type=cfg.model_type)
+                       model_type=cfg.model_type, carry_pos=carry_pos)
+    x_init = (jnp.concatenate([x_h, jnp.asarray(sample_batch.pos)], -1)
+              if carry_pos else x_h)
     per_layer = []
     for i in range(cfg.num_conv_layers):
         ki = jax.random.fold_in(k_conv, i)
-        per_layer.append(block.init(ki, x_h, sample_batch)["params"])
+        per_layer.append(block.init(ki, x_init, sample_batch)["params"])
     p_convs = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_layer)
 
     p_heads = {}
@@ -204,8 +229,9 @@ def make_pipeline_forward(cfg: ModelConfig, mesh: Mesh, num_stages: int,
     conv_fn = PIPELINE_CONV_TYPES[cfg.model_type]
     hidden = cfg.hidden_dim
     act = activation_function_selection(cfg.activation)
+    carry_pos = _carries_pos(cfg)
     block = _ConvBlock(conv=conv_fn(hidden, cfg), activation=cfg.activation,
-                       model_type=cfg.model_type)
+                       model_type=cfg.model_type, carry_pos=carry_pos)
     embed = _embed(hidden)
     cdtype = _resolve_compute_dtype(cfg, compute_dtype)
     mixed = cdtype != jnp.float32
@@ -229,6 +255,8 @@ def make_pipeline_forward(cfg: ModelConfig, mesh: Mesh, num_stages: int,
             stacked = jax.vmap(precompute)(stacked)
         x = jax.vmap(lambda xb: embed.apply({"params": params["embed"]}, xb)
                      )(stacked.x)
+        if carry_pos:
+            x = jnp.concatenate([x, stacked.pos], axis=-1)
         if pipelined:
             stage_params = jax.tree_util.tree_map(
                 lambda a: a.reshape((num_stages,
@@ -242,6 +270,8 @@ def make_pipeline_forward(cfg: ModelConfig, mesh: Mesh, num_stages: int,
                     lambda hm, bm: layer_fn(layer_params, hm, bm)
                 )(h, stacked), None
             x, _ = jax.lax.scan(scan_layer, x, params["convs"])
+        if carry_pos:
+            x = x[..., :-3]   # decode consumes features; pos served its role
         outs = jax.vmap(lambda xm, bm: _decode(params, cfg, xm, bm, act)
                         )(x, stacked)
         if mixed:  # losses/metrics accumulate in f32
@@ -271,15 +301,7 @@ def make_pipeline_train_step(cfg: ModelConfig, mesh: Mesh, num_stages: int,
             metrics[f"task_{i}"] = jnp.mean(tasks[:, i])
         return jnp.mean(losses), metrics
 
-    def freeze(tree):
-        """freeze_conv_layers on the pipelined pytree: the conv stack is
-        the {"convs"} subtree (heads/embed stay trainable — same split as
-        train_step.freeze_conv_grads; reference Base.py:139-143). Applied
-        to UPDATES too: AdamW weight decay moves params at zero grad."""
-        if not getattr(cfg, "freeze_conv", False):
-            return tree
-        return {k: (jax.tree_util.tree_map(jnp.zeros_like, v)
-                    if k == "convs" else v) for k, v in tree.items()}
+    freeze = _make_freeze(cfg)
 
     @jax.jit
     def train_step(state: TrainState, stacked: GraphBatch):
@@ -293,6 +315,108 @@ def make_pipeline_train_step(cfg: ModelConfig, mesh: Mesh, num_stages: int,
                              step=state.step + 1), metrics
 
     return train_step
+
+
+def _make_freeze(cfg: ModelConfig):
+    """freeze_conv_layers on the pipelined pytree: the conv stack is the
+    {"convs"} subtree (heads/embed stay trainable — same split as
+    train_step.freeze_conv_grads; reference Base.py:139-143). Applied to
+    UPDATES too: AdamW weight decay moves params at zero grad."""
+    def freeze(tree):
+        if not getattr(cfg, "freeze_conv", False):
+            return tree
+        return {k: (jax.tree_util.tree_map(jnp.zeros_like, v)
+                    if k == "convs" else v) for k, v in tree.items()}
+    return freeze
+
+
+def _ef_losses(cfg: ModelConfig, loss_name, forward, params,
+               stacked: GraphBatch, energy_weight, force_weight):
+    """Energy-force loss over the stacked microbatch axis, differentiating
+    THROUGH the (pipelined or sequential) forward — graph energy = masked
+    sum of node energies, forces = -dE/dpos (the pipelined analogue of
+    train/loss.energy_force_loss; reference: Base.energy_force_loss,
+    Base.py:359-411). Returns per-microbatch (total, e_loss, f_loss)."""
+    from ..ops.segment import global_sum_pool
+    from ..train.loss import masked_loss
+
+    def total_energy(pos_stack):
+        st = stacked.replace(pos=pos_stack)
+        outputs, _ = forward(params, st)
+        node_e = outputs[0][..., :1]                      # [M, N, 1]
+        graph_e = jax.vmap(
+            lambda ne, bm: global_sum_pool(ne, bm.node_graph,
+                                           bm.num_graphs, bm.node_mask)
+        )(node_e, stacked)                                # [M, G, 1]
+        tot = jnp.sum(jnp.where(stacked.graph_mask[..., None],
+                                graph_e, 0.0))
+        return tot, graph_e
+
+    (_, graph_e), neg_f = jax.value_and_grad(
+        total_energy, has_aux=True)(stacked.pos)
+    forces_pred = -neg_f
+
+    def per_micro(ge, fp, b):
+        e_loss = masked_loss(loss_name, ge, b.energy, b.graph_mask)
+        f_loss = masked_loss(loss_name, fp, b.forces, b.node_mask)
+        return energy_weight * e_loss + force_weight * f_loss, \
+            e_loss, f_loss
+    return jax.vmap(per_micro)(graph_e, forces_pred, stacked)
+
+
+def make_pipeline_ef_train_step(cfg: ModelConfig, mesh: Mesh,
+                                num_stages: int,
+                                tx: optax.GradientTransformation,
+                                loss_name: str = "mse",
+                                energy_weight: float = 1.0,
+                                force_weight: float = 1.0):
+    """Energy-force training on the pipelined stack: the params-grad is a
+    second derivative through the GPipe schedule (ppermute/psum transpose
+    cleanly), so compute_grad_energy composes with pipeline_stages."""
+    forward = make_pipeline_forward(cfg, mesh, num_stages, pipelined=True)
+
+    def loss_fn(params, stacked: GraphBatch):
+        totals, e_l, f_l = _ef_losses(cfg, loss_name, forward, params,
+                                      stacked, energy_weight, force_weight)
+        return jnp.mean(totals), {"loss": jnp.mean(totals),
+                                  "energy_loss": jnp.mean(e_l),
+                                  "force_loss": jnp.mean(f_l)}
+
+    freeze = _make_freeze(cfg)
+
+    @jax.jit
+    def train_step(state: TrainState, stacked: GraphBatch):
+        (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, stacked)
+        grads = freeze(grads)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        updates = freeze(updates)
+        new_params = optax.apply_updates(state.params, updates)
+        return state.replace(params=new_params, opt_state=new_opt,
+                             step=state.step + 1), metrics
+
+    return train_step
+
+
+def make_pipeline_ef_eval_step(cfg: ModelConfig, mesh: Mesh,
+                               num_stages: int, loss_name: str = "mse",
+                               energy_weight: float = 1.0,
+                               force_weight: float = 1.0):
+    forward = make_pipeline_forward(cfg, mesh, num_stages, pipelined=False)
+
+    @jax.jit
+    def eval_step(state: TrainState, batch: GraphBatch):
+        if batch.x.ndim == 2:
+            batch = jax.tree_util.tree_map(lambda a: a[None], batch)
+        totals, e_l, f_l = _ef_losses(cfg, loss_name, forward, state.params,
+                                      batch, energy_weight, force_weight)
+        w = jnp.sum(batch.graph_mask.astype(jnp.float32), axis=1)
+        wsum = jnp.maximum(jnp.sum(w), 1.0)
+        return {"loss": jnp.sum(totals * w) / wsum,
+                "energy_loss": jnp.sum(e_l * w) / wsum,
+                "force_loss": jnp.sum(f_l * w) / wsum}
+
+    return eval_step
 
 
 def make_pipeline_eval_step(cfg: ModelConfig, mesh: Mesh, num_stages: int,
@@ -355,16 +479,17 @@ def validate_pipeline_config(cfg: ModelConfig, num_stages: int,
         if head.head_type != "graph" and head.node_arch not in ("mlp",):
             raise ValueError(
                 "pipelined path supports graph heads and mlp node heads")
-    if getattr(cfg, "equivariance", False):
-        # the homogeneous pipelined block drops per-layer coordinate
-        # updates (_ConvBlock discards the pos return) — silently
-        # training a non-equivariant variant would contradict the
-        # loud-divergence policy (require_pipeline_norm_optin)
+    if getattr(cfg, "equivariance", False) and not _carries_pos(cfg):
+        # equivariant SchNet threads its coordinate updates through the
+        # carried activation (_ConvBlock.carry_pos); the other conv kinds
+        # here have no pos-threading path, and silently training a
+        # non-equivariant variant would contradict the loud-divergence
+        # policy (require_pipeline_norm_optin)
         raise ValueError(
-            "Training.pipeline_stages does not support "
-            "Architecture.equivariance (coordinate updates do not "
-            "thread through the homogeneous pipelined block); train "
-            "equivariant models on the sequential path")
+            "Training.pipeline_stages supports Architecture.equivariance "
+            "only for SchNet (coordinate updates ride the carried "
+            "activation); train other equivariant models on the "
+            "sequential path")
 
 
 def require_pipeline_norm_optin(train_cfg: dict):

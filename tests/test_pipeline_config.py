@@ -92,12 +92,13 @@ def test_pipeline_norm_optin_required():
 
 
 def test_pipeline_equivariance_rejected():
-    """Equivariant coordinate updates don't thread through the
-    homogeneous pipelined block — must be a config-time error, not a
-    silently different architecture."""
-    cfg = _cfg(2, model_type="SchNet")
+    """Non-SchNet equivariant models have no pos-threading path through
+    the pipelined block — config-time error, not a silently different
+    architecture. (SchNet equivariance is supported: pos rides the
+    carried activation — test_pipeline_ef_*.)"""
+    cfg = _cfg(2, model_type="EGNN")
     cfg["NeuralNetwork"]["Architecture"]["equivariance"] = True
-    with pytest.raises(ValueError, match="equivariance"):
+    with pytest.raises(ValueError, match="pipeline_stages"):
         run_training(cfg, datasets=_splits())
 
 
@@ -205,3 +206,75 @@ def test_pipeline_bf16_trains():
     leaves = jax.tree_util.tree_leaves(state.params)
     assert all(l.dtype == np.float32 for l in leaves
                if np.issubdtype(l.dtype, np.floating))
+
+
+def _ef_cfg(stages, epochs=4):
+    """SchNet equivariant energy-force config on the pipelined path (the
+    flagship EF workload; r4 verdict Next #7)."""
+    cfg = make_config("SchNet", heads=("node",), equivariance=True,
+                      num_conv_layers=4)
+    arch = cfg["NeuralNetwork"]["Architecture"]
+    arch["radius"] = 2.0
+    arch["max_neighbours"] = 64
+    voi = cfg["NeuralNetwork"]["Variables_of_interest"]
+    voi["type"] = ["node"]
+    voi["output_names"] = ["node_energy"]
+    voi["output_index"] = [0]
+    voi["output_dim"] = [1]
+    tr = cfg["NeuralNetwork"]["Training"]
+    tr["pipeline_stages"] = stages
+    tr["pipeline_norm"] = "layernorm"
+    tr["num_epoch"] = epochs
+    tr["compute_grad_energy"] = True
+    tr["task_weights"] = [1.0]
+    return cfg
+
+
+def _lj_splits(n=24):
+    from examples.LennardJones.lj_data import generate_lj_dataset
+    samples = generate_lj_dataset(num_configs=n)
+    k = int(n * 2 / 3)
+    return samples[:k], samples[k:k + n // 6], samples[k + n // 6:]
+
+
+def test_pipeline_ef_matches_sequential():
+    """Energy-force losses computed through the GPipe schedule equal the
+    sequential-scan losses on the same params — the force grad (d/dpos)
+    and its params-grad both differentiate through ppermute cleanly."""
+    from hydragnn_tpu.config import build_model_config, update_config
+    from hydragnn_tpu.graphs.batch import collate
+    from hydragnn_tpu.datasets.loader import _stack_batches
+    from hydragnn_tpu.parallel.mesh import make_mesh
+    from hydragnn_tpu.parallel.pipeline_trainer import (
+        _ef_losses, init_pipeline_params, make_pipeline_forward)
+
+    tr, va, te = _lj_splits()
+    samples = tr[:16]
+    cfg = _ef_cfg(2)
+    cfg = update_config(cfg, samples)
+    mcfg = build_model_config(cfg)
+    micro = [collate(samples[i:i + 4], n_node=128, n_edge=4096, n_graph=5)
+             for i in range(0, 16, 4)]
+    stacked = _stack_batches(micro)
+    params = init_pipeline_params(jax.random.PRNGKey(0), mcfg, micro[0])
+
+    mesh = make_mesh((("pipe", 2),))
+    fwd_pipe = make_pipeline_forward(mcfg, mesh, 2, pipelined=True)
+    fwd_seq = make_pipeline_forward(mcfg, mesh, 2, pipelined=False)
+    tot_p, e_p, f_p = _ef_losses(mcfg, "mse", fwd_pipe, params, stacked,
+                                 1.0, 1.0)
+    tot_s, e_s, f_s = _ef_losses(mcfg, "mse", fwd_seq, params, stacked,
+                                 1.0, 1.0)
+    np.testing.assert_allclose(np.asarray(tot_p), np.asarray(tot_s),
+                               rtol=2e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(f_p), np.asarray(f_s),
+                               rtol=2e-4, atol=1e-6)
+
+
+def test_pipeline_ef_config_trains():
+    """Training.pipeline_stages + compute_grad_energy from a JSON config:
+    the equivariant SchNet EF flagship trains on the pipelined path."""
+    cfg = _ef_cfg(2, epochs=5)
+    state, history, _, _ = run_training(cfg, datasets=_lj_splits())
+    assert all(np.isfinite(v) for v in history["train_loss"])
+    assert history["train_loss"][-1] < history["train_loss"][0]
