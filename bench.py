@@ -258,6 +258,7 @@ def run_bench():
         "nbr_layout": use_nbr,
         "steps_per_call": spc if spc > 1 else 1,
         "pallas": os.environ.get("HYDRAGNN_USE_PALLAS", "default"),
+        "nbr_pallas": os.environ.get("HYDRAGNN_PALLAS_NBR", "default"),
         "dtype": compute_dtype,
     }
     if flops_per_step is not None:

@@ -212,11 +212,23 @@ class PNAConv(nn.Module):
             return h
 
         if batch.nbr is not None:
-            # dense neighbor-list layout: [N, K, F] messages, axis-1
-            # reductions, zero scatters (with_neighbor_format)
-            h = proj_i[:, None, :] + proj_j[batch.nbr]
-            h = edge_terms(h, lambda ev: ev[batch.nbr_edge])
-            mean, mn, mx, sd, deg = seg.neighbor_aggregate(h, batch.nbr_mask)
+            from ..kernels.nbr_pallas import (fused_neighbor_aggregate,
+                                              nbr_pallas_enabled)
+            if (not self.edge_dim and not self.rbf
+                    and nbr_pallas_enabled(proj_j.shape, proj_j.dtype)):
+                # fused gather->stats Pallas kernel: no [N, K, F] in HBM
+                # (HYDRAGNN_PALLAS_NBR=1; kernels/nbr_pallas.py decision
+                # record — on-chip A/B via bench BENCH_NBR_PALLAS)
+                mean, mn, mx, sd, deg = fused_neighbor_aggregate(
+                    proj_i, proj_j, batch.nbr, batch.nbr_mask, 128,
+                    jax.default_backend() == "cpu")
+            else:
+                # dense neighbor-list layout: [N, K, F] messages, axis-1
+                # reductions, zero scatters (with_neighbor_format)
+                h = proj_i[:, None, :] + proj_j[batch.nbr]
+                h = edge_terms(h, lambda ev: ev[batch.nbr_edge])
+                mean, mn, mx, sd, deg = seg.neighbor_aggregate(
+                    h, batch.nbr_mask)
         else:
             h = proj_i[batch.receivers] + proj_j[batch.senders]
             h = edge_terms(h, lambda ev: ev)
