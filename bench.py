@@ -34,6 +34,8 @@ Env knobs:
   BENCH_DTYPE          compute dtype for the train step (default
                        float32; bfloat16 = mixed precision on the MXU)
   HYDRAGNN_USE_PALLAS  Pallas segment-sum kernel on/off (ops/segment.py)
+  HYDRAGNN_PALLAS_NBR  fused neighbor-gather->MXU kernel on/off
+                       (kernels/nbr_pallas.py; watcher A/Bs it on-chip)
   BENCH_PEAK_FLOPS     override chip peak FLOP/s for MFU
 """
 import itertools

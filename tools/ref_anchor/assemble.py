@@ -21,6 +21,15 @@ FORMULATION_DIVERGENCE = {
              "AND formulations, not formulation-identical models"),
 }
 
+# Per-row budget disclosures (the protocol requires identical budgets
+# ACROSS SIDES, not across rows)
+BUDGET_NOTES = {
+    "MACE": ("60-epoch budget on BOTH sides (the other rows use 150): "
+             "the reference side under the shims measures ~250 s/epoch "
+             "on this one-core box (~10.5 h at 150 epochs, infeasible "
+             "in-round); the comparison stays budget-matched"),
+}
+
 
 def load_jsonl(path):
     out = {}
@@ -36,9 +45,16 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--round", type=int,
                    default=int(os.environ.get("GRAFT_ROUND", "4")))
+    p.add_argument("--base", default=None,
+                   help="prior ANCHOR_r{N}.json whose rows seed this one "
+                        "(new jsonl rows overlay per model)")
+    p.add_argument("--ref-log", default=os.path.join(REPO, "logs",
+                                                     "anchor_ref.jsonl"))
+    p.add_argument("--tpu-log", default=os.path.join(REPO, "logs",
+                                                     "anchor_tpu.jsonl"))
     args = p.parse_args()
-    ref = load_jsonl(os.path.join(REPO, "logs", "anchor_ref.jsonl"))
-    tpu = load_jsonl(os.path.join(REPO, "logs", "anchor_tpu.jsonl"))
+    ref = load_jsonl(args.ref_log)
+    tpu = load_jsonl(args.tpu_log)
     models = sorted(set(ref) | set(tpu))
     rows, evaluated = {}, 0
     for m in models:
@@ -48,7 +64,8 @@ def main():
             row.update(energy_mae=t["energy_mae"], force_mae=t["force_mae"],
                        energy_mae_rel=t["energy_mae_rel"],
                        force_mae_rel=t["force_mae_rel"],
-                       train_secs=t["train_secs"])
+                       train_secs=t["train_secs"],
+                       num_epoch=t.get("budget", {}).get("num_epoch"))
         if r:
             row.update(reference_energy_mae=r["energy_mae"],
                        reference_force_mae=r["force_mae"],
@@ -66,16 +83,45 @@ def main():
             evaluated += 1
         if m in FORMULATION_DIVERGENCE:
             row["formulation_divergence"] = FORMULATION_DIVERGENCE[m]
+        if m in BUDGET_NOTES:
+            row["budget_note"] = BUDGET_NOTES[m]
         rows[m] = row
+    if args.base and os.path.exists(args.base):
+        with open(args.base) as f:
+            base = json.load(f)
+        merged = dict(base.get("models", {}))
+        for m, row in rows.items():
+            # field-level overlay: a one-sided rerun (e.g. ref landed,
+            # tpu still tunnel-gated) must not wipe the base row's other
+            # side; recompute the ratios from the combined fields
+            comb = {**merged.get(m, {}), **{k: v for k, v in row.items()
+                                            if v is not None}}
+            if "energy_mae" in comb and "reference_energy_mae" in comb:
+                comb["energy_ratio_ours_over_ref"] = round(
+                    comb["energy_mae"]
+                    / max(comb["reference_energy_mae"], 1e-12), 4)
+                comb["force_ratio_ours_over_ref"] = round(
+                    comb["force_mae"]
+                    / max(comb["reference_force_mae"], 1e-12), 4)
+                comb["parity_le_1.05"] = bool(
+                    comb["energy_ratio_ours_over_ref"] <= 1.05
+                    and comb["force_ratio_ours_over_ref"] <= 1.05)
+            merged[m] = comb
+        rows = merged
+        evaluated = sum(1 for r in rows.values()
+                        if "energy_ratio_ours_over_ref" in r)
     any_rec = next(iter((ref or tpu).values()), None)
-    budget = any_rec["budget"] if any_rec else {}
+    budget = dict(any_rec["budget"]) if any_rec else {}
+    budget["num_epoch"] = "per-row (see each model's num_epoch)"
     out = {
         "metric": "lj_anchor_cross_framework_mae",
         "round": args.round,
         "protocol": ("identical workload (our LJ generator, 64-atom 4^3 "
                      "PBC cells), identical budget and split on both "
-                     "sides; the reference runs UNMODIFIED on the "
-                     "tools/ref_anchor/shims dependency surface"),
+                     "sides per row; the reference runs UNMODIFIED on the "
+                     "tools/ref_anchor/shims dependency surface "
+                     "(validated by SHIM_FIDELITY_r05.json: the "
+                     "reference's own CI battery passes under the shims)"),
         "budget": budget,
         "models": rows,
         "models_evaluated": evaluated,

@@ -13,10 +13,10 @@ REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
 
 # shims that intentionally stub a dependency subset no anchor model needs;
 # a NotImplementedError from these is a documented scope boundary, not a
-# fidelity failure — but it does mean that model's row is unvalidated.
-# (MACE is NOT here: the e3nn shim is fully functional for it, so a MACE
-# error is a real fidelity failure.)
-KNOWN_STUBS = {"DimeNet": "InteractionPPBlock not in anchor shim"}
+# fidelity failure. EMPTY as of round 5: the e3nn subset (MACE) and the
+# DimeNet++ blocks are fully functional, so every error is a real
+# fidelity failure.
+KNOWN_STUBS = {}
 
 
 def main():
@@ -25,13 +25,21 @@ def main():
                    default=int(os.environ.get("GRAFT_ROUND", "5")))
     p.add_argument("--log", default=os.path.join(REPO, "logs",
                                                  "shim_fidelity.jsonl"))
+    p.add_argument("--extra-logs", nargs="*",
+                   default=[os.path.join(REPO, "logs",
+                                         "shim_fidelity_lengths.jsonl")])
     args = p.parse_args()
 
     rows = {}
-    with open(args.log) as f:
-        for line in f:
-            rec = json.loads(line)
-            rows[(rec["model"], rec["ci_input"])] = rec  # last run wins
+    for path in [args.log] + [p_ for p_ in args.extra_logs
+                              if os.path.exists(p_)]:
+        with open(path) as f:
+            for line in f:
+                rec = json.loads(line)
+                ci = rec["ci_input"] + ("+lengths"
+                                        if rec.get("use_lengths") else "")
+                rec = dict(rec, ci_input=ci)
+                rows[(rec["model"], ci)] = rec  # last run wins
 
     cells, n_pass, n_fail, n_stub = {}, 0, 0, 0
     for (model, ci), rec in sorted(rows.items()):

@@ -51,7 +51,7 @@ def setup_scratch():
         os.symlink(os.path.join(REFERENCE, "tests"), link)
 
 
-def run_one(model_type, ci_input):
+def run_one(model_type, ci_input, use_lengths=False):
     """In-process: runs the reference's unittest_train_model under the
     shims with cwd=SCRATCH; captures run_prediction's return to report
     the measured errors next to the reference's own thresholds."""
@@ -86,7 +86,8 @@ def run_one(model_type, ci_input):
     t0 = time.time()
     status, detail = "pass", ""
     try:
-        test_graphs.unittest_train_model(model_type, ci_input, False,
+        test_graphs.unittest_train_model(model_type, ci_input,
+                                         use_lengths,
                                          overwrite_config=overwrite)
     except AssertionError as e:
         status, detail = "fail_threshold", str(e)[:300]
@@ -96,6 +97,7 @@ def run_one(model_type, ci_input):
 
     rec = {
         "model": model_type, "ci_input": ci_input, "status": status,
+        "use_lengths": use_lengths,
         "thresholds_ref": THRESHOLDS[model_type],
         "train_secs": round(secs, 1),
     }
@@ -121,6 +123,8 @@ def main():
                    help="loop the default battery in subprocesses")
     p.add_argument("--models", default=",".join(DEFAULT_MODELS))
     p.add_argument("--ci", default="ci.json")
+    p.add_argument("--lengths", action="store_true",
+                   help="use_lengths=True (edge-length features)")
     p.add_argument("--out",
                    default=os.path.join(REPO, "logs",
                                         "shim_fidelity.jsonl"))
@@ -133,22 +137,24 @@ def main():
     if args.all:
         for m in args.models.split(","):
             try:
-                r = subprocess.run(
-                    [sys.executable, os.path.abspath(__file__),
-                     "--model", m, "--ci", args.ci, "--out", args.out],
-                    cwd=REPO, timeout=3 * 3600)
+                argv = [sys.executable, os.path.abspath(__file__),
+                        "--model", m, "--ci", args.ci, "--out", args.out]
+                if args.lengths:
+                    argv.append("--lengths")
+                r = subprocess.run(argv, cwd=REPO, timeout=3 * 3600)
                 print(f"[{m}] rc={r.returncode}", flush=True)
             except subprocess.TimeoutExpired:
                 with open(args.out, "a") as f:
                     f.write(json.dumps(
                         {"model": m, "ci_input": args.ci,
+                         "use_lengths": args.lengths,
                          "status": "error", "detail": "timeout 3h",
                          "thresholds_ref": THRESHOLDS[m],
                          "train_secs": 3 * 3600.0}) + "\n")
                 print(f"[{m}] timeout", flush=True)
         return
 
-    rec = run_one(args.model, args.ci)
+    rec = run_one(args.model, args.ci, use_lengths=args.lengths)
     line = json.dumps(rec)
     print(line)
     with open(args.out, "a") as f:

@@ -8,8 +8,67 @@ PairTensor = tuple
 OptPairTensor = tuple
 
 
-class SparseTensor:
-    """Placeholder: the reference only references this in type hints."""
+class _Storage:
+    def __init__(self, row, col, value):
+        self._row, self._col, self._value = row, col, value
 
-    def __init__(self, *a, **k):
-        raise NotImplementedError("SparseTensor not available in shim")
+    def row(self):
+        return self._row
+
+    def col(self):
+        return self._col
+
+    def value(self):
+        return self._value
+
+
+class SparseTensor:
+    """Minimal COO sparse tensor backing the reference's triplet builder
+    (DIMEStack.py:180-205): construction, row selection with duplicates,
+    set_value(None), per-row nnz sum, and .storage accessors. Written
+    from the documented torch_sparse semantics; NOT a copy."""
+
+    def __init__(self, row=None, col=None, value=None, sparse_sizes=None,
+                 _sorted=False):
+        if not _sorted:
+            order = torch.argsort(row, stable=True)
+            row, col = row[order], col[order]
+            value = value[order] if value is not None else None
+        self._row, self._col, self._value = row, col, value
+        self._sizes = sparse_sizes or (int(row.max()) + 1 if row.numel()
+                                       else 0,) * 2
+        n = self._sizes[0]
+        counts = torch.bincount(row, minlength=n)
+        self._rowptr = torch.zeros(n + 1, dtype=torch.long)
+        self._rowptr[1:] = torch.cumsum(counts, 0)
+
+    @property
+    def storage(self):
+        return _Storage(self._row, self._col, self._value)
+
+    def set_value(self, value):
+        return SparseTensor(row=self._row, col=self._col, value=value,
+                            sparse_sizes=self._sizes, _sorted=True)
+
+    def sum(self, dim):
+        assert dim == 1
+        return self._rowptr[1:] - self._rowptr[:-1]
+
+    def __getitem__(self, index):
+        """Row selection (duplicates allowed): result row i is the
+        original row index[i], renumbered to i."""
+        index = index.long()
+        starts = self._rowptr[index]
+        counts = self._rowptr[index + 1] - starts
+        total = int(counts.sum())
+        new_row = torch.repeat_interleave(
+            torch.arange(index.numel()), counts)
+        # flat positions: start of each selected row + offset within it
+        ends = torch.cumsum(counts, 0)
+        within = torch.arange(total) - torch.repeat_interleave(
+            ends - counts, counts)
+        take = torch.repeat_interleave(starts, counts) + within
+        value = self._value[take] if self._value is not None else None
+        return SparseTensor(row=new_row, col=self._col[take], value=value,
+                            sparse_sizes=(index.numel(), self._sizes[1]),
+                            _sorted=True)
