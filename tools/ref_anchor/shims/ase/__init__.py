@@ -8,7 +8,7 @@ readers raise on use.
 """
 import numpy as np
 
-from . import neighborlist  # noqa: F401
+from . import data, neighborlist  # noqa: F401
 
 
 class Atoms:

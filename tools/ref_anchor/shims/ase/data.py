@@ -1,0 +1,45 @@
+"""ase.data subset: covalent radii (Angstrom, indexed by atomic number,
+index 0 = placeholder like ase's X entry) and symbol tables. Values are
+the standard Cordero-2008 covalent radii (public physical constants),
+the same table hydragnn_tpu/utils/atomicdescriptors.py carries in pm.
+Used by the reference's MACE radial distance transforms
+(hydragnn/utils/model/mace_utils/modules/radial.py:170,214).
+"""
+import numpy as np
+
+_RCOV_PM = [
+    31, 28, 128, 96, 84, 76, 71, 66, 57, 58,
+    166, 141, 121, 111, 107, 105, 102, 106, 203, 176,
+    170, 160, 153, 139, 139, 132, 126, 124, 132, 122,
+    122, 120, 119, 120, 120, 116, 220, 195, 190, 175,
+    164, 154, 147, 146, 142, 139, 145, 144, 142, 139,
+    139, 138, 139, 140, 244, 215, 207, 204, 203, 201,
+    199, 198, 198, 196, 194, 192, 192, 189, 190, 187,
+    187, 175, 170, 162, 151, 144, 141, 136, 136, 132,
+    145, 146, 148, 140, 150, 150, 260, 221, 215, 206,
+    200, 196, 190, 187, 180, 169,
+]
+
+# index 0 is the ase 'X' placeholder; Z=97-118 use ase's own 0.2
+# missing-value placeholder (NOT an extrapolation — the shim must
+# reproduce what the reference sees under real ase)
+covalent_radii = np.array(
+    [0.2] + [r / 100.0 for r in _RCOV_PM]
+    + [0.2] * (118 - len(_RCOV_PM)), dtype=np.float64)
+
+chemical_symbols = [
+    "X", "H", "He", "Li", "Be", "B", "C", "N", "O", "F", "Ne",
+    "Na", "Mg", "Al", "Si", "P", "S", "Cl", "Ar", "K", "Ca",
+    "Sc", "Ti", "V", "Cr", "Mn", "Fe", "Co", "Ni", "Cu", "Zn",
+    "Ga", "Ge", "As", "Se", "Br", "Kr", "Rb", "Sr", "Y", "Zr",
+    "Nb", "Mo", "Tc", "Ru", "Rh", "Pd", "Ag", "Cd", "In", "Sn",
+    "Sb", "Te", "I", "Xe", "Cs", "Ba", "La", "Ce", "Pr", "Nd",
+    "Pm", "Sm", "Eu", "Gd", "Tb", "Dy", "Ho", "Er", "Tm", "Yb",
+    "Lu", "Hf", "Ta", "W", "Re", "Os", "Ir", "Pt", "Au", "Hg",
+    "Tl", "Pb", "Bi", "Po", "At", "Rn", "Fr", "Ra", "Ac", "Th",
+    "Pa", "U", "Np", "Pu", "Am", "Cm", "Bk", "Cf", "Es", "Fm",
+    "Md", "No", "Lr", "Rf", "Db", "Sg", "Bh", "Hs", "Mt", "Ds",
+    "Rg", "Cn", "Nh", "Fl", "Mc", "Lv", "Ts", "Og",
+]
+
+atomic_numbers = {s: z for z, s in enumerate(chemical_symbols) if z}
