@@ -356,11 +356,21 @@ def _ef_losses(cfg: ModelConfig, loss_name, forward, params,
         total_energy, has_aux=True)(stacked.pos)
     forces_pred = -neg_f
 
+    fw = force_weight
+    if fw == "auto":
+        # ONE whole-batch weight (reference semantics, Base.py:400-404)
+        # — a per-microbatch ratio would make the pipelined loss diverge
+        # from the sequential path's on identical data
+        from ..train.loss import auto_force_weight
+        flat = lambda a: a.reshape((-1,) + a.shape[2:])
+        fw = auto_force_weight(flat(stacked.energy), flat(stacked.forces),
+                               flat(stacked.graph_mask),
+                               flat(stacked.node_mask), energy_weight)
+
     def per_micro(ge, fp, b):
         e_loss = masked_loss(loss_name, ge, b.energy, b.graph_mask)
         f_loss = masked_loss(loss_name, fp, b.forces, b.node_mask)
-        return energy_weight * e_loss + force_weight * f_loss, \
-            e_loss, f_loss
+        return energy_weight * e_loss + fw * f_loss, e_loss, f_loss
     return jax.vmap(per_micro)(graph_e, forces_pred, stacked)
 
 

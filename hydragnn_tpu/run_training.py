@@ -321,6 +321,12 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
 
     loss_name = train_cfg.get("loss_function_type", "mse")
     cge = bool(train_cfg.get("compute_grad_energy", False))
+    # energy/force loss weights: force_loss_weight "auto" reproduces the
+    # reference's magnitude balancing (Base.energy_force_loss,
+    # Base.py:400-404); default 1.0 keeps the calibrated battery behavior
+    e_w = float(train_cfg.get("energy_loss_weight", 1.0))
+    f_w = train_cfg.get("force_loss_weight", 1.0)
+    f_w = f_w if f_w == "auto" else float(f_w)
     if pipeline_stages > 1:
         from .parallel.pipeline_trainer import (make_pipeline_ef_eval_step,
                                                 make_pipeline_ef_train_step,
@@ -331,9 +337,11 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
             # energy-force through the pipeline: the force grad and the
             # params grad both differentiate through the GPipe schedule
             train_step = make_pipeline_ef_train_step(
-                mcfg, mesh, pipeline_stages, tx, loss_name)
+                mcfg, mesh, pipeline_stages, tx, loss_name,
+                energy_weight=e_w, force_weight=f_w)
             eval_step = make_pipeline_ef_eval_step(
-                mcfg, mesh, pipeline_stages, loss_name)
+                mcfg, mesh, pipeline_stages, loss_name,
+                energy_weight=e_w, force_weight=f_w)
         else:
             train_step = make_pipeline_train_step(
                 mcfg, mesh, pipeline_stages, tx, loss_name)
@@ -346,10 +354,13 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         opt_cfg = train_cfg.get("Optimizer", {})
         train_step = make_composed_train_step(
             model, mcfg, tx, mesh, loss_name, compute_grad_energy=cge,
+            energy_weight=e_w, force_weight=f_w,
             zero_opt=bool(opt_cfg.get("use_zero_redundancy", False)),
             zero_min_size=int(opt_cfg.get("zero_min_shard_size", 2 ** 14)))
         eval_step = make_composed_eval_step(model, mcfg, loss_name,
-                                            compute_grad_energy=cge)
+                                            compute_grad_energy=cge,
+                                            energy_weight=e_w,
+                                            force_weight=f_w)
     elif num_shards > 1:
         if mp_spmd:
             from .parallel.multiprocess import spmd_mesh_devices
@@ -364,15 +375,21 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         zero_min = int(opt_cfg.get("zero_min_shard_size", 2 ** 14))
         train_step = make_spmd_train_step(model, mcfg, tx, mesh, loss_name,
                                           compute_grad_energy=cge,
+                                          energy_weight=e_w,
+                                          force_weight=f_w,
                                           zero_opt=zero_opt,
                                           zero_min_size=zero_min)
         eval_step = make_spmd_eval_step(model, mcfg, mesh, loss_name,
-                                        compute_grad_energy=cge)
+                                        compute_grad_energy=cge,
+                                        energy_weight=e_w,
+                                        force_weight=f_w)
     else:
         train_step = make_train_step(model, mcfg, tx, loss_name,
-                                     compute_grad_energy=cge)
+                                     compute_grad_energy=cge,
+                                     energy_weight=e_w, force_weight=f_w)
         eval_step = make_eval_step(model, mcfg, loss_name,
-                                   compute_grad_energy=cge)
+                                   compute_grad_energy=cge,
+                                   energy_weight=e_w, force_weight=f_w)
 
     # steps-per-call dispatch batching: scan S optimizer steps per device
     # call (Training.steps_per_call / HYDRAGNN_STEPS_PER_CALL). Identical
@@ -387,15 +404,19 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
                                        make_multi_train_step)
         multi_step = make_multi_train_step(model, mcfg, tx,
                                            loss_name=loss_name,
-                                           compute_grad_energy=cge)
+                                           compute_grad_energy=cge,
+                                           energy_weight=e_w,
+                                           force_weight=f_w)
         multi_eval = make_multi_eval_step(model, mcfg, loss_name=loss_name,
-                                          compute_grad_energy=cge)
+                                          compute_grad_energy=cge,
+                                          energy_weight=e_w,
+                                          force_weight=f_w)
     elif steps_per_call > 1:
         from .parallel.spmd import make_spmd_dispatch_group
         multi_step, place_group_fn = make_spmd_dispatch_group(
             model, mcfg, tx, mesh, steps_per_call, loss_name=loss_name,
-            compute_grad_energy=cge, zero_opt=zero_opt,
-            zero_min_size=zero_min)
+            compute_grad_energy=cge, energy_weight=e_w, force_weight=f_w,
+            zero_opt=zero_opt, zero_min_size=zero_min)
 
     ckpt_fn = None
     if train_cfg.get("Checkpoint", False) and jax.process_index() == 0:

@@ -53,6 +53,21 @@ def multihead_loss(cfg: ModelConfig, loss_name: str, outputs, outputs_var,
     return tot, tasks
 
 
+def auto_force_weight(energy, forces, graph_mask, node_mask,
+                      energy_weight: float = 1.0):
+    """The reference's force-loss balancing: scale the force term by the
+    TRUE-label magnitude ratio so energy and forces contribute equally
+    (reference: Base.energy_force_loss force_loss_weight,
+    Base.py:400-404), computed over the masked labels of one batch."""
+    gm = graph_mask[:, None]
+    nm = node_mask[:, None]
+    e_mean = (jnp.sum(jnp.abs(energy) * gm)
+              / jnp.maximum(jnp.sum(gm), 1.0))
+    f_mean = (jnp.sum(jnp.abs(forces) * nm)
+              / jnp.maximum(jnp.sum(nm) * forces.shape[-1], 1.0))
+    return energy_weight * e_mean / (f_mean + 1e-8)
+
+
 def energy_force_loss(apply_fn: Callable, variables, cfg: ModelConfig,
                       batch: GraphBatch, loss_name: str = "mae",
                       energy_weight: float = 1.0, force_weight: float = 1.0,
@@ -84,6 +99,10 @@ def energy_force_loss(apply_fn: Callable, variables, cfg: ModelConfig,
 
     e_loss = masked_loss(loss_name, graph_e, batch.energy, batch.graph_mask)
     f_loss = masked_loss(loss_name, forces_pred, batch.forces, batch.node_mask)
+    if force_weight == "auto":
+        force_weight = auto_force_weight(batch.energy, batch.forces,
+                                         batch.graph_mask, batch.node_mask,
+                                         energy_weight)
     total = energy_weight * e_loss + force_weight * f_loss
     return total, {"energy_loss": e_loss, "force_loss": f_loss,
                    "energy_pred": graph_e, "forces_pred": forces_pred,

@@ -545,3 +545,45 @@ def test_spmd_bfloat16_training():
     # master params stayed f32
     for leaf in jax.tree_util.tree_leaves(state.params):
         assert leaf.dtype == np.float32, leaf.dtype
+
+
+def test_force_loss_weight_auto_matches_reference_balancing():
+    """Training.force_loss_weight "auto" reproduces the reference's
+    magnitude balancing (Base.energy_force_loss force_loss_weight,
+    Base.py:400-404): force term scaled by mean|E|/mean|F| of the true
+    labels, so the weighted total differs from the 1.0/1.0 default by
+    exactly that factor on the force term."""
+    import jax
+    import numpy as np
+
+    from examples.LennardJones.lj_data import generate_lj_dataset
+    from hydragnn_tpu.graphs.batch import collate
+    from hydragnn_tpu.train.loss import energy_force_loss
+    from tests.utils import prepare
+
+    samples = generate_lj_dataset(num_configs=6)
+    cfg, mcfg, _ = prepare("SchNet", samples, heads=("node",),
+                           equivariance=True)
+    batch = collate(samples[:4])
+    from hydragnn_tpu.models.create import create_model, init_params
+    model = create_model(mcfg)
+    variables = init_params(model, batch)
+
+    def apply_fn(v, b, train=False):
+        outputs, _ = model.apply(v, b, train=train)
+        return (outputs, None), None
+
+    tot_auto, aux = energy_force_loss(apply_fn, variables, mcfg, batch,
+                                      "mse", 1.0, "auto")
+    tot_unit, aux_u = energy_force_loss(apply_fn, variables, mcfg, batch,
+                                        "mse", 1.0, 1.0)
+    gm = np.asarray(batch.graph_mask)[:, None]
+    nm = np.asarray(batch.node_mask)[:, None]
+    e_mean = (np.abs(np.asarray(batch.energy)) * gm).sum() / gm.sum()
+    f_mean = (np.abs(np.asarray(batch.forces)) * nm).sum() / (
+        nm.sum() * 3)
+    fw = e_mean / (f_mean + 1e-8)
+    e_l = float(aux["energy_loss"])
+    f_l = float(aux["force_loss"])
+    np.testing.assert_allclose(float(tot_auto), e_l + fw * f_l, rtol=1e-5)
+    np.testing.assert_allclose(float(tot_unit), e_l + f_l, rtol=1e-6)
