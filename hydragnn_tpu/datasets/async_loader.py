@@ -1,0 +1,364 @@
+"""Asynchronous host input pipeline: background collation + batch cache.
+
+The synchronous ``GraphDataLoader`` runs padding, batch transforms, and the
+O(E log E) neighbor-table build (`graphs/batch.py with_neighbor_format`) on
+the consumer thread, so the accelerator idles while Python packs arrays —
+``prefetch_to_device`` (loader.py) only overlaps the device copy that comes
+*after* collation. This module moves the collation itself off the consumer
+thread (the standard input-overlap lever in distributed GNN training:
+DistGNN §4, DGL's async samplers; the reference's thread-pool
+HydraDataLoader, hydragnn/preprocess/load_data.py:93-203):
+
+* ``iterate_async`` — a bounded ThreadPoolExecutor window collates batches
+  ahead of the consumer. Batches are yielded strictly in submission order,
+  so the stream is bitwise-identical to the synchronous loader for a given
+  (seed, epoch); a worker exception surfaces on the consumer at the failed
+  batch's position instead of hanging the queue.
+* ``BatchCache`` — size-bounded LRU over whole collated batches keyed by
+  the exact index tuple. Padded shapes are static, so a repeated selection
+  (re-iterating an epoch, a replayed permutation) reuses the previous
+  collation bitwise. ``HYDRAGNN_BATCH_CACHE_MB`` bounds the memory
+  (0 disables).
+* ``dataset_invariants`` — one-pass, memoized computation of the
+  dataset-level statistics that shape the compiled program (max node/edge
+  counts, max in-degree for the dense neighbor budget), which the sync path
+  recomputed with separate passes per call site.
+* ``background_iterate`` — single-producer pipelining for iterators whose
+  batch construction is not index-addressable (MultiDatasetLoader's cycling
+  shard streams).
+
+Kill switches: ``HYDRAGNN_ASYNC_LOADER=0`` restores the synchronous path;
+``HYDRAGNN_LOADER_WORKERS`` sizes the pool (default 2);
+``HYDRAGNN_BATCH_CACHE_MB`` sizes the cache (unset/0 = disabled — the
+cache is opt-in, for workloads whose batch selections actually repeat).
+"""
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import weakref
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Iterator, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+DEFAULT_WORKERS = 2
+# submission window beyond the pool: keeps every worker busy without
+# collating an unbounded distance ahead of the consumer
+WINDOW_SLACK = 2
+
+
+def resolve_async_workers(override: Optional[int] = None) -> int:
+    """Worker count for background collation: 0 = synchronous.
+
+    Precedence: explicit loader/config override, then the
+    HYDRAGNN_ASYNC_LOADER kill switch (default on) sized by
+    HYDRAGNN_LOADER_WORKERS."""
+    if override is not None:
+        return max(int(override), 0)
+    from ..utils.envflags import env_flag, env_int
+    if not env_flag("HYDRAGNN_ASYNC_LOADER", True):
+        return 0
+    # 0 is honored: HYDRAGNN_LOADER_WORKERS=0 is the same contract as the
+    # async_workers=0 override — fully synchronous collation
+    return max(env_int("HYDRAGNN_LOADER_WORKERS", DEFAULT_WORKERS), 0)
+
+
+def resolve_cache_bytes(override_mb: Optional[int] = None) -> int:
+    """Batch-cache budget in bytes; 0 disables.
+
+    Opt-in: with neither a loader/config override nor
+    HYDRAGNN_BATCH_CACHE_MB set, the cache is OFF — on the standard
+    training path every epoch draws a fresh permutation, so the
+    exact-selection keys essentially never repeat and a default-on cache
+    would be pure memory overhead. Enable it for workloads that replay
+    selections (fixed-permutation epochs, repeated eval over a shuffled
+    split, set_epoch replays)."""
+    from ..utils.envflags import env_int
+    mb = override_mb
+    if mb is None:
+        mb = env_int("HYDRAGNN_BATCH_CACHE_MB", None)
+    if mb is None:
+        return 0
+    return max(int(mb), 0) * (1 << 20)
+
+
+def _batch_nbytes(batch) -> int:
+    import dataclasses
+    total = 0
+    for f in dataclasses.fields(batch):
+        v = getattr(batch, f.name)
+        if v is not None:
+            total += np.asarray(v).nbytes
+    return total
+
+
+class BatchCache:
+    """Size-bounded LRU of collated batches keyed by the exact index tuple.
+
+    Exact-order keys (not sorted) because the padded layout is
+    order-sensitive — node/edge segments are packed in sample order — and
+    the async stream must stay bitwise-identical to the synchronous one.
+    Cached batches are numpy and treated as immutable by every consumer
+    (transforms run before insertion; placement copies to device)."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._data: "collections.OrderedDict[Tuple, Any]" = \
+            collections.OrderedDict()
+        self._sizes: Dict[Tuple, int] = {}
+        self.nbytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self._lock = threading.Lock()
+
+    def get(self, key: Tuple):
+        with self._lock:
+            hit = self._data.get(key)
+            if hit is None:
+                self.misses += 1
+                return None
+            self._data.move_to_end(key)
+            self.hits += 1
+            return hit
+
+    def put(self, key: Tuple, batch) -> None:
+        size = _batch_nbytes(batch)
+        if size > self.max_bytes:
+            return  # a single batch over budget is never cacheable
+        with self._lock:
+            if key in self._data:
+                return
+            while self.nbytes + size > self.max_bytes and self._data:
+                old, _ = self._data.popitem(last=False)
+                self.nbytes -= self._sizes.pop(old)
+                self.evictions += 1
+            self._data[key] = batch
+            self._sizes[key] = size
+            self.nbytes += size
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()
+            self._sizes.clear()
+            self.nbytes = 0
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def stats(self) -> Dict[str, int]:
+        return {"entries": len(self._data), "nbytes": self.nbytes,
+                "hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions}
+
+
+def _loader_pool(loader, num_workers: int) -> ThreadPoolExecutor:
+    """The loader's persistent collation pool, created lazily on the first
+    async iteration and reused across epochs — a pool per `__iter__` would
+    re-pay thread spawn every epoch, which on short epochs costs more than
+    the overlap wins. `weakref.finalize` shuts the pool down when the
+    loader is collected (shutdown is idempotent, so the stacked finalizers
+    from a resize are harmless)."""
+    ex = getattr(loader, "_async_pool", None)
+    if ex is not None and getattr(loader, "_async_pool_workers", 0) == \
+            num_workers:
+        return ex
+    if ex is not None:
+        ex.shutdown(wait=False, cancel_futures=True)
+    ex = ThreadPoolExecutor(max_workers=num_workers,
+                            thread_name_prefix="hydragnn-collate")
+    loader._async_pool = ex
+    loader._async_pool_workers = num_workers
+    weakref.finalize(loader, ex.shutdown, wait=False)
+    return ex
+
+
+def iterate_async(loader, selections: Sequence[Tuple[int, ...]],
+                  num_workers: int, cache: Optional[BatchCache] = None
+                  ) -> Iterator:
+    """Yield ``loader._build_batch(sel)`` for each selection, collated by a
+    background pool but delivered strictly in order.
+
+    A bounded submission window (workers + slack) keeps memory flat; cache
+    hits bypass the pool entirely. ``future.result()`` re-raises any worker
+    exception on the consumer at the failing batch's position — remaining
+    queued work is then cancelled instead of hanging the stream."""
+    # datasets that are plain in-memory sequences are safe to index from
+    # worker threads; file/socket-backed datasets (GraphStore, DDStore)
+    # keep their fetch on the consumer thread and offload only the
+    # numpy-pure collation
+    threadsafe = isinstance(loader.dataset, (list, tuple))
+    window = num_workers + WINDOW_SLACK
+    ex = _loader_pool(loader, num_workers)
+    pending: "collections.deque" = collections.deque()
+
+    def submit(sel):
+        hit = cache.get(sel) if cache is not None else None
+        if hit is not None:
+            pending.append((sel, None, hit))
+            return
+        if threadsafe:
+            fut = ex.submit(loader._build_batch, sel)
+        else:
+            samples = [loader.dataset[i] for i in sel]
+            fut = ex.submit(loader._build_batch_from_samples, sel, samples)
+        pending.append((sel, fut, None))
+
+    try:
+        it = iter(selections)
+        exhausted = False
+        while True:
+            while not exhausted and len(pending) < window:
+                try:
+                    submit(next(it))
+                except StopIteration:
+                    exhausted = True
+            if not pending:
+                return
+            sel, fut, hit = pending.popleft()
+            if fut is not None:
+                batch = fut.result()  # re-raises worker exceptions
+                if cache is not None:
+                    cache.put(sel, batch)
+            else:
+                batch = hit
+            yield batch
+    finally:
+        # abandoned or failed mid-epoch: drop queued work, keep the pool
+        # alive for the next epoch
+        for _sel, fut, _hit in pending:
+            if fut is not None:
+                fut.cancel()
+
+
+_SENTINEL = object()
+
+
+def background_iterate(iterable, depth: int = 2) -> Iterator:
+    """Pipeline an arbitrary iterator through one producer thread and a
+    bounded queue: the producer builds item k+1..k+depth while the consumer
+    holds item k. Order is trivially preserved (single producer); producer
+    exceptions are re-raised on the consumer; abandoning the generator
+    stops the producer promptly (the bounded queue is drained, then the
+    stop flag is seen)."""
+    q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+    stop = threading.Event()
+
+    def put_until_stopped(entry):
+        # block until the consumer takes it or abandons the stream — a
+        # timeout here could drop the terminal sentinel/exception while
+        # the consumer is stalled (e.g. inside a long JIT compile) and
+        # leave it blocked on q.get() forever
+        while not stop.is_set():
+            try:
+                q.put(entry, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def produce():
+        try:
+            for item in iterable:
+                put_until_stopped((item, None))
+                if stop.is_set():
+                    return
+            put_until_stopped((_SENTINEL, None))
+        except BaseException as exc:  # noqa: BLE001 — forwarded, not hidden
+            put_until_stopped((_SENTINEL, exc))
+
+    t = threading.Thread(target=produce, name="hydragnn-producer",
+                         daemon=True)
+    t.start()
+    try:
+        while True:
+            item, exc = q.get()
+            if item is _SENTINEL:
+                if exc is not None:
+                    raise exc
+                return
+            yield item
+    finally:
+        stop.set()
+        # unblock a producer stuck on a full queue
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+        # make close() synchronous with producer death: a still-running
+        # producer mutates the underlying iterable's state (e.g. the
+        # MultiDatasetLoader shard-epoch counters), which must not race a
+        # caller that abandons the stream and immediately re-seeds epochs.
+        # put_until_stopped polls the stop flag every 0.1s, so this join
+        # only waits out at most one in-flight item build.
+        t.join(timeout=30)
+
+
+class DatasetInvariants(NamedTuple):
+    """Dataset-level statistics that shape the compiled program."""
+    max_nodes: int
+    max_edges: int
+    max_in_degree: Optional[int]  # None when the scan skipped degrees
+
+
+_INVARIANT_CACHE: \
+    "collections.OrderedDict[int, Tuple[Any, DatasetInvariants, int]]" = \
+    collections.OrderedDict()
+# entries hold a STRONG reference to the whole dataset (lists are not
+# weakref-able, and the ref is what makes the id-key sound), so keep the
+# cache tiny: enough for the repeated scans within one loader-construction
+# burst, small enough that e.g. an HPO loop building fresh per-trial
+# datasets pins at most 2 stale ones
+_INVARIANT_CACHE_SIZE = 2
+
+
+def clear_dataset_invariants() -> None:
+    """Drop the memoized dataset scans (and their dataset references) —
+    for long-lived processes that build many short-lived datasets."""
+    _INVARIANT_CACHE.clear()
+
+
+def dataset_invariants(samples: Sequence, need_degree: bool = False
+                       ) -> DatasetInvariants:
+    """One pass over `samples` for (max_nodes, max_edges[, max in-degree]).
+
+    The synchronous call sites each re-scanned the dataset — two max()
+    passes in `loader_budgets` plus a per-sample bincount pass in
+    `neighbor_budget_for_dataset`, repeated per loader. Memoized on the
+    identity of the samples object (a strong reference is kept while the
+    entry lives, so the id cannot be reused underneath the cache); a
+    length change invalidates the entry, so growing a list in place
+    cannot leak stale (smaller) padding budgets into a new loader."""
+    key = id(samples)
+    hit = _INVARIANT_CACHE.get(key)
+    if hit is not None and hit[0] is samples and len(samples) == hit[2]:
+        inv = hit[1]
+        if not need_degree or inv.max_in_degree is not None:
+            _INVARIANT_CACHE.move_to_end(key)
+            return inv
+    max_n, max_e, kmax = 0, 0, 0
+    for s in samples:
+        max_n = max(max_n, s.num_nodes)
+        max_e = max(max_e, s.num_edges)
+        if need_degree and s.num_edges:
+            deg = np.bincount(np.asarray(s.receivers),
+                              minlength=s.num_nodes)
+            kmax = max(kmax, int(deg.max()))
+    inv = DatasetInvariants(max_n, max_e, max(kmax, 1) if need_degree
+                            else None)
+    _INVARIANT_CACHE[key] = (samples, inv, len(samples))
+    _INVARIANT_CACHE.move_to_end(key)
+    while len(_INVARIANT_CACHE) > _INVARIANT_CACHE_SIZE:
+        _INVARIANT_CACHE.popitem(last=False)
+    return inv
+
+
+def neighbor_budget(samples: Sequence, k_multiple: int = 8) -> int:
+    """Alias for `graphs.batch.neighbor_budget_for_dataset`, which holds
+    the ONE rounding formula and is itself backed by the memoized
+    one-pass scan above — kept so loader-side callers don't need to know
+    the graphs module layout."""
+    from ..graphs.batch import neighbor_budget_for_dataset
+    return neighbor_budget_for_dataset(samples, k_multiple)

@@ -12,12 +12,17 @@ and the custom thread-pool `HydraDataLoader` :93-203). TPU-first differences:
   in the train step is the gradient psum — the DDP pattern re-done the
   shard_map way,
 * shuffling is a seeded permutation per epoch (`set_epoch`,
-  reference: train_validate_test.py:156-158), identical on every host.
+  reference: train_validate_test.py:156-158), identical on every host,
+* collation runs on background workers by default (datasets/async_loader.py),
+  optionally backed by a size-bounded batch cache (HYDRAGNN_BATCH_CACHE_MB),
+  so the consumer thread — and therefore the accelerator — does not stall
+  on Python array packing; the async stream is bitwise-identical to the
+  synchronous one (HYDRAGNN_ASYNC_LOADER=0 restores the synchronous path).
 """
 from __future__ import annotations
 
 import math
-from typing import Iterator, List, Optional, Sequence
+from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -39,6 +44,8 @@ class GraphDataLoader:
         batch_transform=None,
         neighbor_format: bool = False,
         neighbor_k: Optional[int] = None,
+        async_workers: Optional[int] = None,
+        cache_mb: Optional[int] = None,
     ):
         assert batch_size % num_shards == 0 or num_shards == 1, (
             f"batch_size {batch_size} must divide evenly over {num_shards} shards")
@@ -53,21 +60,38 @@ class GraphDataLoader:
         self.drop_last = shuffle if drop_last is None else drop_last
         bucket = bucket or BucketSpec(multiple=64)
         if n_node_per_shard is None or n_edge_per_shard is None:
-            max_n = max(s.num_nodes for s in dataset)
-            max_e = max(s.num_edges for s in dataset)
-            n_node_per_shard = bucket.bucket(max_n * self.graphs_per_shard + 1)
-            n_edge_per_shard = bucket.bucket(max_e * self.graphs_per_shard + 1)
+            from .async_loader import dataset_invariants
+            inv = dataset_invariants(dataset)
+            n_node_per_shard = bucket.bucket(
+                inv.max_nodes * self.graphs_per_shard + 1)
+            n_edge_per_shard = bucket.bucket(
+                inv.max_edges * self.graphs_per_shard + 1)
         self.n_node = n_node_per_shard
         self.n_edge = n_edge_per_shard
         self.n_graph = self.graphs_per_shard + 1
+        # shape prototype for all-padding (empty-shard) batches, pinned on
+        # the constructing thread: _collate_shard_raw may run on a worker
+        # thread, and file/socket-backed datasets are not safe to index
+        # from there (the iterate_async threadsafe guard)
+        self._proto_sample = dataset[0] if len(dataset) else None
         self.batch_transform = batch_transform
         self._cache: Optional[List[GraphBatch]] = None
         # dense neighbor-list layout: K is pinned ONCE from dataset-level
         # max in-degree so every batch shares one [N, K] shape (one compile)
         self.neighbor_k = None
         if neighbor_format:
-            from ..graphs.batch import neighbor_budget_for_dataset
-            self.neighbor_k = neighbor_k or neighbor_budget_for_dataset(dataset)
+            from .async_loader import neighbor_budget
+            self.neighbor_k = neighbor_k or neighbor_budget(dataset)
+        # background collation (datasets/async_loader.py): 0 workers =
+        # synchronous; the batch cache reuses collation work whenever the
+        # exact index selection repeats (re-iterated epochs, replayed
+        # permutations) — padded shapes are static so the reuse is bitwise
+        from .async_loader import (BatchCache, resolve_async_workers,
+                                   resolve_cache_bytes)
+        self.async_workers = resolve_async_workers(async_workers)
+        cache_bytes = resolve_cache_bytes(cache_mb)
+        self.batch_cache = (BatchCache(cache_bytes) if cache_bytes
+                            else None)
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
@@ -116,7 +140,7 @@ class GraphDataLoader:
 
     def _collate_shard_raw(self, samples: List[GraphSample]) -> GraphBatch:
         if not samples:
-            b = collate([self.dataset[0]], n_node=self.n_node,
+            b = collate([self._proto_sample], n_node=self.n_node,
                         n_edge=self.n_edge, n_graph=self.n_graph, np_out=True)
             zero = lambda a: None if a is None else np.zeros_like(a)
             return GraphBatch(
@@ -133,6 +157,28 @@ class GraphDataLoader:
         return collate(samples, n_node=self.n_node, n_edge=self.n_edge,
                        n_graph=self.n_graph, np_out=True)
 
+    def _selections(self) -> List[Tuple[int, ...]]:
+        """The epoch's batch index tuples, in yield order — the unit of
+        work for both the synchronous loop and the background workers (and
+        the batch-cache key)."""
+        order = self._order()
+        return [tuple(int(i) for i in
+                      order[ib * self.batch_size:(ib + 1) * self.batch_size])
+                for ib in range(len(self))]
+
+    def _build_batch(self, sel: Tuple[int, ...]) -> GraphBatch:
+        return self._build_batch_from_samples(
+            sel, [self.dataset[i] for i in sel])
+
+    def _build_batch_from_samples(self, sel, samples) -> GraphBatch:
+        if self.num_shards == 1:
+            return self._collate_shard(samples)
+        shards = []
+        g = self.graphs_per_shard
+        for sh in range(self.num_shards):
+            shards.append(self._collate_shard(samples[sh * g:(sh + 1) * g]))
+        return _stack_batches(shards)
+
     def __iter__(self) -> Iterator[GraphBatch]:
         # non-shuffled loaders (val/test) produce identical batches every
         # epoch — collate once and replay (the reference's DataLoader
@@ -141,25 +187,36 @@ class GraphDataLoader:
         from ..utils.envflags import env_flag
         if not self.shuffle and env_flag("HYDRAGNN_CACHE_BATCHES", True):
             if self._cache is None:
-                self._cache = list(self._iter_uncached())
+                self._cache = list(self._iter_batches())
             yield from self._cache
             return
-        yield from self._iter_uncached()
+        yield from self._iter_batches()
 
-    def _iter_uncached(self) -> Iterator[GraphBatch]:
-        order = self._order()
-        nb = len(self)
-        for ib in range(nb):
-            sel = order[ib * self.batch_size:(ib + 1) * self.batch_size]
-            samples = [self.dataset[i] for i in sel]
-            if self.num_shards == 1:
-                yield self._collate_shard(samples)
-                continue
-            shards = []
-            g = self.graphs_per_shard
-            for sh in range(self.num_shards):
-                shards.append(self._collate_shard(samples[sh * g:(sh + 1) * g]))
-            yield _stack_batches(shards)
+    def _iter_batches(self) -> Iterator[GraphBatch]:
+        # HYDRAGNN_CACHE_BATCHES=0 is the blanket cache opt-out: it disables
+        # the whole-epoch replay above AND the selection-keyed BatchCache, so
+        # every epoch re-collates from scratch
+        from ..utils.envflags import env_flag
+        cache = (self.batch_cache
+                 if env_flag("HYDRAGNN_CACHE_BATCHES", True) else None)
+        if self.async_workers > 0:
+            from .async_loader import iterate_async
+            yield from iterate_async(self, self._selections(),
+                                     self.async_workers, cache)
+            return
+        yield from self._iter_uncached(cache)
+
+    def _iter_uncached(self, cache: Optional["BatchCache"] = None
+                       ) -> Iterator[GraphBatch]:
+        """Synchronous reference path (HYDRAGNN_ASYNC_LOADER=0): collate on
+        the consumer thread, consulting the same batch cache."""
+        for sel in self._selections():
+            hit = cache.get(sel) if cache is not None else None
+            if hit is None:
+                hit = self._build_batch(sel)
+                if cache is not None:
+                    cache.put(sel, hit)
+            yield hit
 
 
 def prefetch_to_device(iterator, size: int = 2, place_fn=None):

@@ -348,13 +348,16 @@ def neighbor_budget_for_dataset(samples, k_multiple: int = 8) -> int:
     `with_neighbor_format` so every batch shares one [N, K] shape — otherwise
     K floats with each batch's max degree and each crossing of a k_multiple
     boundary recompiles the jitted step (the same pinning that
-    `batch_shape_for_dataset` does for node/edge counts)."""
-    kmax = 1
-    for s in samples:
-        if s.num_edges:
-            deg = np.bincount(np.asarray(s.receivers), minlength=s.num_nodes)
-            kmax = max(kmax, int(deg.max()))
-    return max(k_multiple, _round_up(kmax, k_multiple))
+    `batch_shape_for_dataset` does for node/edge counts).
+
+    Thin wrapper over the memoized one-pass dataset scan
+    (datasets/async_loader.dataset_invariants) so there is exactly one
+    in-degree budget formula — loaders built through either call site
+    compile the same [N, K] shape."""
+    from ..datasets.async_loader import dataset_invariants
+    inv = dataset_invariants(samples, need_degree=True)
+    return max(k_multiple, _round_up(max(inv.max_in_degree or 1, 1),
+                                     k_multiple))
 
 
 def with_neighbor_format(batch: GraphBatch, k: Optional[int] = None,

@@ -160,10 +160,28 @@ def _bwd(block_n, interpret, eps, res, cots):
 fused_neighbor_aggregate.defvjp(_fwd, _bwd)
 
 
+# HYDRAGNN_PALLAS_NBR, resolved ONCE (at step construction via
+# resolve_nbr_pallas_flag(refresh=True), or lazily on first trace) and
+# frozen thereafter. The old trace-time os.environ read meant a toggle
+# after the step compiled silently did nothing, and any unrecognized
+# value (a typo) enabled the kernel (r5 advisor, convs.py:218).
+_RESOLVED_FLAG = None
+
+
+def resolve_nbr_pallas_flag(refresh: bool = False) -> bool:
+    """Resolve HYDRAGNN_PALLAS_NBR to a pinned boolean. Only explicit
+    truthy values ('1'/'true'/'on') enable the kernel. Step constructors
+    call this with refresh=True so the decision is made at
+    step-construction time, not at trace time."""
+    global _RESOLVED_FLAG
+    if _RESOLVED_FLAG is None or refresh:
+        from ..utils.envflags import env_strict_flag
+        _RESOLVED_FLAG = env_strict_flag("HYDRAGNN_PALLAS_NBR", False)
+    return _RESOLVED_FLAG
+
+
 def nbr_pallas_enabled(proj_j_shape, dtype) -> bool:
-    import os
-    env = os.environ.get("HYDRAGNN_PALLAS_NBR", "")
-    if env.lower() in ("", "0", "false", "no", "off"):
+    if not resolve_nbr_pallas_flag():
         return False
     nbytes = (proj_j_shape[0] * proj_j_shape[1]
               * jnp.dtype(dtype).itemsize)

@@ -217,8 +217,9 @@ class PNAConv(nn.Module):
             if (not self.edge_dim and not self.rbf
                     and nbr_pallas_enabled(proj_j.shape, proj_j.dtype)):
                 # fused gather->stats Pallas kernel: no [N, K, F] in HBM
-                # (HYDRAGNN_PALLAS_NBR=1; kernels/nbr_pallas.py decision
-                # record — on-chip A/B via bench BENCH_NBR_PALLAS)
+                # (HYDRAGNN_PALLAS_NBR=1, resolved once at step
+                # construction — kernels/nbr_pallas.py decision record;
+                # on-chip A/B via bench BENCH_NBR_PALLAS)
                 mean, mn, mx, sd, deg = fused_neighbor_aggregate(
                     proj_i, proj_j, batch.nbr, batch.nbr_mask, 128,
                     jax.default_backend() == "cpu")

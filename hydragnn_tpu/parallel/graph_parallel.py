@@ -160,7 +160,10 @@ def ring_aggregate(message_fn: Callable, x_block: jnp.ndarray,
     hop with one bucket's message computation. Returns the local [block, Fm]
     aggregation (receiver-partitioned — no final collective needed).
     """
-    d = lax.axis_size(axis_name)
+    # ring length == mesh axis size == leading dim of the per-sender-block
+    # bucket stack; read it from the static shape (jax.lax.axis_size is not
+    # available on jax 0.4.x, and ppermute needs a static permutation anyway)
+    d = buckets.send_local.shape[0]
     perm = [(i, (i + 1) % d) for i in range(d)]
     block = x_block.shape[0]
 

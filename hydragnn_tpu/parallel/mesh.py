@@ -37,7 +37,11 @@ def init_distributed(coordinator: Optional[str] = None,
     # must not touch the XLA backend before jax.distributed.initialize
     # (jax.process_count() would initialise it), so probe the distributed
     # client state instead
-    already = jax.distributed.is_initialized()
+    try:
+        already = jax.distributed.is_initialized()
+    except AttributeError:  # jax < 0.5 has no is_initialized()
+        from jax._src import distributed as _dist
+        already = _dist.global_state.client is not None
     if not already and (coordinator or os.getenv("HYDRAGNN_MASTER_ADDR")):
         coord = coordinator or (
             os.environ["HYDRAGNN_MASTER_ADDR"] + ":" +

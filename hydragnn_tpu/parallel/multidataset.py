@@ -73,22 +73,38 @@ class MultiDatasetLoader:
         self.assignment = assign_shards_to_datasets(
             [len(d) for d in datasets], num_shards)
         bucket = bucket or BucketSpec(multiple=64)
-        max_n = max(s.num_nodes for d in datasets for s in d)
-        max_e = max(s.num_edges for d in datasets for s in d)
+        from ..datasets.async_loader import dataset_invariants
+        invs = [dataset_invariants(d) for d in datasets]
+        max_n = max(i.max_nodes for i in invs)
+        max_e = max(i.max_edges for i in invs)
         n_node = bucket.bucket(max_n * self.gps + 1)
         n_edge = bucket.bucket(max_e * self.gps + 1)
         self.loaders = []
         for shard, ds_idx in enumerate(self.assignment):
+            # per-shard loaders stay synchronous and uncached
+            # (async_workers=0, cache_mb=0): the cycling shard streams are
+            # pipelined as ONE unit by background_iterate in __iter__ —
+            # per-shard pools would spawn num_shards * workers threads for
+            # no extra overlap, and per-shard caches (even env-enabled
+            # ones) would multiply a budget meant per training run by
+            # num_shards for fresh-permutation streams whose selection
+            # keys essentially never repeat
             self.loaders.append(GraphDataLoader(
                 datasets[ds_idx], self.gps, shuffle=True,
                 seed=seed * 1000 + shard, num_shards=1,
                 n_node_per_shard=n_node, n_edge_per_shard=n_edge,
-                drop_last=True))
+                drop_last=True, async_workers=0, cache_mb=0))
         self.n_node, self.n_edge = n_node, n_edge
         self.n_graph = self.gps + 1
         self.graphs_per_shard = self.gps
 
     def set_epoch(self, epoch: int):
+        # an abandoned async iteration (early stop, max-batch cap) leaves
+        # its producer thread alive until generator finalization — and that
+        # producer advances shard-loader epoch counters as streams cycle.
+        # Stop it NOW, before re-seeding, or the stale producer stomps the
+        # new epoch state and the per-host permutations diverge.
+        self._close_background()
         for ld in self.loaders:
             ld.set_epoch(epoch)
 
@@ -97,6 +113,33 @@ class MultiDatasetLoader:
         return max(len(ld) for ld in self.loaders)
 
     def __iter__(self):
+        # the cycling shard streams are not index-addressable (each shard
+        # advances its own epoch counter mid-stream), so pipeline the whole
+        # stacked-batch construction through one producer thread instead of
+        # the pool path (datasets/async_loader.py background_iterate)
+        from ..datasets.async_loader import (background_iterate,
+                                             resolve_async_workers)
+        workers = resolve_async_workers(None)
+        if workers > 0:
+            self._close_background()  # only one producer may cycle shards
+            gen = background_iterate(self._iter_sync(), depth=workers + 1)
+            self._background = gen
+            try:
+                yield from gen
+            finally:
+                if getattr(self, "_background", None) is gen:
+                    self._background = None
+                gen.close()  # joins the producer (async_loader.py)
+        else:
+            yield from self._iter_sync()
+
+    def _close_background(self):
+        gen = getattr(self, "_background", None)
+        if gen is not None:
+            self._background = None
+            gen.close()
+
+    def _iter_sync(self):
         iters = [iter(ld) for ld in self.loaders]
         for _ in range(len(self)):
             shards = []

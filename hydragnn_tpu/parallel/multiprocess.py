@@ -23,11 +23,14 @@ Used by run_training when jax.process_count() > 1 on the plain-SPMD path:
 """
 from __future__ import annotations
 
+import logging
 from typing import Optional
 
 import numpy as np
 
 import jax
+
+_LOG = logging.getLogger("hydragnn_tpu")
 
 
 def is_multiprocess() -> bool:
@@ -138,11 +141,37 @@ def make_multiprocess_place_fn(mesh, axis: str = "data"):
 
 
 def slice_by_process(ds, nproc: Optional[int] = None,
-                     rank: Optional[int] = None):
+                     rank: Optional[int] = None, what: str = "dataset",
+                     underflow: str = "raise"):
     """Contiguous per-process slice (equal sizes; the tail is dropped so
-    every process runs the same step count)."""
+    every process runs the same step count).
+
+    A split smaller than the process count used to silently return an
+    EMPTY slice, which made `_eval_epoch` report a bogus 0.0 loss that
+    drove keep_best/ReduceLROnPlateau decisions (r5 advisor). Now:
+    ``underflow='raise'`` (default) raises a clear error;
+    ``underflow='replicate'`` warns and keeps the FULL split on every
+    process instead (correct redundant eval — every process computes the
+    same loss over the same data). Dropped tail counts are logged."""
     ds = list(ds)
     nproc = nproc or jax.process_count()
     rank = jax.process_index() if rank is None else rank
     per = len(ds) // nproc
+    if per == 0 and len(ds) > 0:
+        if underflow == "replicate":
+            _LOG.warning(
+                "%s has %d samples for %d processes — too few to shard; "
+                "replicating the full split on every process (redundant "
+                "but correct eval)", what, len(ds), nproc)
+            return ds
+        raise ValueError(
+            f"{what} has {len(ds)} samples but {nproc} processes: "
+            "slicing would leave some processes an empty split whose 0.0 "
+            "loss corrupts keep_best/LR-plateau decisions — use a larger "
+            "split, fewer processes, or underflow='replicate'")
+    dropped = len(ds) - per * nproc
+    if dropped:
+        _LOG.info("%s: dropping %d tail sample(s) of %d so all %d "
+                  "processes hold equal %d-sample slices",
+                  what, dropped, len(ds), nproc, per)
     return ds[rank * per:(rank + 1) * per]

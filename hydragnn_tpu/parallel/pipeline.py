@@ -116,5 +116,9 @@ def make_pipeline_apply(mesh: Mesh, layer_fn: Callable, num_layers: int,
         return outputs
 
     in_specs = (P(axis), P(), P())
-    return shard_map(pipelined, mesh=mesh, in_specs=in_specs, out_specs=P(),
-                     check_vma=False)
+    try:
+        return shard_map(pipelined, mesh=mesh, in_specs=in_specs,
+                         out_specs=P(), check_vma=False)
+    except TypeError:  # jax < 0.6 names the replication check check_rep
+        return shard_map(pipelined, mesh=mesh, in_specs=in_specs,
+                         out_specs=P(), check_rep=False)

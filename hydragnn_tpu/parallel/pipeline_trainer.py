@@ -226,6 +226,8 @@ def make_pipeline_forward(cfg: ModelConfig, mesh: Mesh, num_stages: int,
     ``compute_dtype`` follows the main path's mixed-precision policy
     (train_step._resolve_compute_dtype): params/batch floats cast to the
     compute dtype, outputs accumulated back in f32."""
+    from ..kernels.nbr_pallas import resolve_nbr_pallas_flag
+    resolve_nbr_pallas_flag(refresh=True)  # pinned at construction time
     conv_fn = PIPELINE_CONV_TYPES[cfg.model_type]
     hidden = cfg.hidden_dim
     act = activation_function_selection(cfg.activation)

@@ -65,10 +65,11 @@ def loader_budgets(all_samples, graphs_per_shard: int,
     node/edge budgets per shard and the dense neighbor K. `reduce_fn`
     lets a multi-process caller globally max-reduce the RAW statistics
     before bucketing, so every process compiles the same shapes."""
-    from ..graphs.batch import BucketSpec, neighbor_budget_for_dataset
-    mx_n = max(s.num_nodes for s in all_samples)
-    mx_e = max(s.num_edges for s in all_samples)
-    k = neighbor_budget_for_dataset(all_samples) if neighbor_format else 0
+    from ..datasets.async_loader import dataset_invariants, neighbor_budget
+    from ..graphs.batch import BucketSpec
+    inv = dataset_invariants(all_samples, need_degree=neighbor_format)
+    mx_n, mx_e = inv.max_nodes, inv.max_edges
+    k = neighbor_budget(all_samples) if neighbor_format else 0
     if reduce_fn is not None:
         mx_n, mx_e, k = reduce_fn(mx_n, mx_e, k)
     b = BucketSpec(multiple=64)
@@ -82,7 +83,9 @@ def create_dataloaders(trainset, valset, testset, batch_size: int,
                        n_node_per_shard: Optional[int] = None,
                        n_edge_per_shard: Optional[int] = None,
                        batch_transform=None, neighbor_format: bool = False,
-                       neighbor_k: Optional[int] = None):
+                       neighbor_k: Optional[int] = None,
+                       async_workers: Optional[int] = None,
+                       cache_mb: Optional[int] = None):
     """reference: load_data.py:225-296 — DataLoader + DistributedSampler;
     here one static-shape loader per split, all sharing the max padded shape
     so train/val/test reuse one compiled program."""
@@ -96,13 +99,14 @@ def create_dataloaders(trainset, valset, testset, batch_size: int,
     if neighbor_format and neighbor_k is None:
         # one K for all three splits so they share one compiled program
         # (a multi-process caller passes the globally-reduced K instead)
-        from ..graphs.batch import neighbor_budget_for_dataset
-        neighbor_k = neighbor_budget_for_dataset(all_samples)
+        from ..datasets.async_loader import neighbor_budget
+        neighbor_k = neighbor_budget(all_samples)
     mk = lambda ds, shuffle: GraphDataLoader(
         ds, batch_size, shuffle=shuffle, seed=seed, num_shards=num_shards,
         n_node_per_shard=n_node_per_shard, n_edge_per_shard=n_edge_per_shard,
         drop_last=shuffle, batch_transform=batch_transform,
-        neighbor_format=neighbor_format, neighbor_k=neighbor_k)
+        neighbor_format=neighbor_format, neighbor_k=neighbor_k,
+        async_workers=async_workers, cache_mb=cache_mb)
     return mk(trainset, True), mk(valset, False), mk(testset, False)
 
 

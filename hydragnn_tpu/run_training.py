@@ -127,9 +127,14 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
                         or os.environ.get("HYDRAGNN_GS_SHARD_ROOT"))
             else "replicated")
         if mp_data == "replicated":
-            trainset = slice_by_process(trainset)
-            valset = slice_by_process(valset)
-            testset = slice_by_process(testset)
+            # train: too few samples to shard is fatal (empty shards would
+            # train on nothing); val/test: replicate the split instead so
+            # keep_best/LR-plateau never see a bogus 0.0 eval loss
+            trainset = slice_by_process(trainset, what="train split")
+            valset = slice_by_process(valset, what="validate split",
+                                      underflow="replicate")
+            testset = slice_by_process(testset, what="test split",
+                                       underflow="replicate")
             datasets = (trainset, valset, testset)
         else:
             config = sync_config_stats(config)
@@ -255,6 +260,11 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         train_source, valset, testset, local_batch,
         num_shards=local_shards,
         batch_transform=batch_transform, neighbor_format=nbr_fmt,
+        # async input pipeline (docs/input_pipeline.md): config overrides
+        # win over the HYDRAGNN_ASYNC_LOADER / HYDRAGNN_BATCH_CACHE_MB env
+        # knobs; None defers to them
+        async_workers=train_cfg.get("async_loader_workers"),
+        cache_mb=train_cfg.get("batch_cache_mb"),
         **mp_loader_kwargs)
 
     if mp_spmd:
@@ -418,21 +428,14 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
             compute_grad_energy=cge, energy_weight=e_w, force_weight=f_w,
             zero_opt=zero_opt, zero_min_size=zero_min)
 
+    # mid-training best-val saves run async so the epoch loop never blocks
+    # on filesystem writes; the final save below synchronizes. Installed on
+    # ALL ranks — orbax save() is a multihost collective; gating it to rank
+    # 0 deadlocked multi-process runs (checkpoint.make_async_best_checkpoint_fn)
     ckpt_fn = None
-    if train_cfg.get("Checkpoint", False) and jax.process_index() == 0:
-        # multi-process: params/opt state are replicated, so rank 0's copy
-        # is the complete checkpoint; concurrent writers would race the dir
-        # mid-training best-val saves run async so the epoch loop never
-        # blocks on filesystem writes; the final save below synchronizes.
-        # A failed optional save (the error surfaces on the NEXT save, when
-        # orbax drains the previous one) must not abort training.
-        def ckpt_fn(s, e, v):
-            try:
-                save_model(s, log_name, use_async=True)
-            except Exception as exc:  # noqa: BLE001
-                import logging
-                logging.getLogger("hydragnn_tpu").warning(
-                    "async checkpoint failed: %s", exc)
+    if train_cfg.get("Checkpoint", False):
+        from .utils.checkpoint import make_async_best_checkpoint_fn
+        ckpt_fn = make_async_best_checkpoint_fn(log_name)
 
     # visualization wiring (reference: run_training.py:76-78 reads the
     # Visualization section; train_validate_test.py:100-125,264-311 builds

@@ -93,6 +93,10 @@ def make_loss_fn(model, cfg: ModelConfig, loss_name: str = "mse",
     metrics)) with the mixed-precision casting policy — the ONE training
     loss body, shared by the single-device step factories here and the
     SPMD factories in parallel/spmd.py so the two paths cannot drift."""
+    # pin env-dependent kernel choices NOW: the traced body must not read
+    # os.environ (a post-compile toggle would silently no-op — r5 advisor)
+    from ..kernels.nbr_pallas import resolve_nbr_pallas_flag
+    resolve_nbr_pallas_flag(refresh=True)
     cdtype = _resolve_compute_dtype(cfg, compute_dtype)
     mixed = cdtype != jnp.float32
 
@@ -212,6 +216,8 @@ def make_forward_fn(model, cfg: Optional[ModelConfig] = None,
     outputs out, model compute in Architecture.dtype (or `compute_dtype`).
     The ONE eval-side casting policy, shared by the single-device eval
     body here and the SPMD eval/predict factories in parallel/spmd.py."""
+    from ..kernels.nbr_pallas import resolve_nbr_pallas_flag
+    resolve_nbr_pallas_flag(refresh=True)  # pinned at construction time
     cdtype = _resolve_compute_dtype(cfg, compute_dtype)
     mixed = cdtype != jnp.float32
 

@@ -137,29 +137,21 @@ def train_validate_test(
     from ..utils.envflags import env_flag, env_int
     max_num_batch = env_int("HYDRAGNN_MAX_NUM_BATCH")
     run_valtest = env_flag("HYDRAGNN_VALTEST", default=True)
-    # HYDRAGNN_TRACE_LEVEL>0 adds a dataload span around every batch fetch
-    # (reference: tr spans at train_validate_test.py:474-545, h2d/sync spans
-    # gated by the same flag); HYDRAGNN_NUM_WORKERS maps the reference's
-    # DataLoader worker count (load_data.py:249-254) onto prefetch depth
-    trace_level = env_int("HYDRAGNN_TRACE_LEVEL", 0)
+    # HYDRAGNN_NUM_WORKERS maps the reference's DataLoader worker count
+    # (load_data.py:249-254) onto prefetch depth
     prefetch_depth = max(env_int("HYDRAGNN_NUM_WORKERS", 2), 1)
 
-    def _timed_stream(stream):
-        it = iter(stream)
-        while True:
-            with tr.timer("dataload"):
-                try:
-                    b = next(it)
-                except StopIteration:
-                    return
-            yield b
-
-    from ..utils.profiling import Profiler
+    from ..utils.profiling import HostStallMonitor, Profiler
     profiler = profiler or Profiler(run_dir, enable=False)
+    # host-stall accounting: every epoch reports the fraction of host time
+    # blocked on the input pipeline (collation + staging) vs dispatching
+    # steps — the input-bound fraction the async loader is meant to erase
+    stall = HostStallMonitor(tracer=tr)
 
     for epoch in range(num_epochs):
         train_loader.set_epoch(epoch)
         profiler.set_current_epoch(epoch)
+        stall.reset()
         # ---- train pass (reference: train, :449-565) ----
         acc_train: Dict[str, float] = {}
         nb = 0
@@ -184,8 +176,9 @@ def train_validate_test(
                   else place_fn)
             stream = (prefetch_to_device(source, size=depth, place_fn=pf)
                       if pf is not None else source)
-            if trace_level > 0:
-                stream = _timed_stream(stream)
+            # every next() on the stream is host time the device waits on
+            # (collation, cache lookup, staging) — accounted per epoch
+            stream = stall.wrap(stream)
             n_items = len(train_loader)
             if group:
                 n_items = -(-n_items // steps_per_call)  # stacked groups
@@ -196,7 +189,7 @@ def train_validate_test(
                               and batch.x.shape[0] == steps_per_call
                               and (max_num_batch is None
                                    or nb + steps_per_call <= max_num_batch))
-                with tr.timer("train_step"):
+                with tr.timer("train_step"), stall.step_timer():
                     if full_group:
                         state, metrics = multi_train_step(state, batch)
                         _accumulate_metrics(acc_train, metrics, summed=True)
@@ -222,6 +215,11 @@ def train_validate_test(
                     break
         train_loss = acc_train.pop("loss", 0.0) / max(nb, 1)
         task_tot = acc_train
+        # host-stall report: fraction of the train pass the host (and so
+        # the device) was blocked on the input pipeline rather than
+        # dispatching/executing steps
+        input_bound = stall.input_bound_frac()
+        history.setdefault("input_bound_frac", []).append(input_bound)
 
         # ---- val/test passes ----
         if run_valtest:
@@ -268,6 +266,7 @@ def train_validate_test(
                 history.setdefault(f"{prefix}_{k}", []).append(v)
         if tb is not None:
             tb.add_scalar("train/loss", train_loss, epoch)
+            tb.add_scalar("train/input_bound_frac", input_bound, epoch)
             tb.add_scalar("val/loss", val_loss, epoch)
             tb.add_scalar("test/loss", test_loss, epoch)
             for k, v in task_tot.items():
@@ -276,7 +275,8 @@ def train_validate_test(
                 for k, v in tasks.items():
                     tb.add_scalar(f"{prefix}/{k}", v, epoch)
         log(f"epoch {epoch}: train {train_loss:.5f} val {val_loss:.5f} "
-            f"test {test_loss:.5f} lr {lr:.2e}")
+            f"test {test_loss:.5f} lr {lr:.2e} "
+            f"input_bound {input_bound:.3f}")
 
         if (checkpoint_fn is not None and val_loss == val_loss
                 and gate.should_save(epoch, val_loss)):

@@ -61,6 +61,29 @@ def save_model(state: TrainState, log_name: str, path: str = "./logs",
     return target
 
 
+def make_async_best_checkpoint_fn(log_name: str, path: str = "./logs"):
+    """Best-val mid-training checkpoint callback for the trainer.
+
+    Must be installed (and invoked) on ALL ranks: orbax ``save()`` is a
+    multihost collective (sync_global_processes barrier), so the old
+    ``jax.process_index() == 0`` gate deadlocked rank 0 at the barrier on
+    the first best-val save while other ranks never joined (r5 advisor,
+    run_training.py:422). `save_model` already restricts the LATEST marker
+    to rank 0 and orbax coordinates the writers internally — the same
+    contract the final-save path always used.
+
+    A failed optional save (the error surfaces on the NEXT save, when
+    orbax drains the previous one) must not abort training."""
+    def ckpt_fn(state, epoch, val_loss):
+        try:
+            save_model(state, log_name, path=path, use_async=True)
+        except Exception as exc:  # noqa: BLE001
+            import logging
+            logging.getLogger("hydragnn_tpu").warning(
+                "async checkpoint failed: %s", exc)
+    return ckpt_fn
+
+
 def _write_latest(target: str) -> None:
     d = os.path.dirname(target)
     tmp = os.path.join(d, "LATEST.tmp")

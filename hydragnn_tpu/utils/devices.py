@@ -57,10 +57,11 @@ def probe_backend(timeout_s: int = 60, attempts: int = 1,
 
 def force_cpu_platform(min_devices: int = 1) -> None:
     """Reconfigure this process onto the CPU platform with at least
-    `min_devices` devices, regardless of whether backends were already
-    initialized. XLA_FLAGS' --xla_force_host_platform_device_count is
-    honored (its parse is stale after any backend init, so the count is
-    re-applied via jax_num_cpu_devices)."""
+    `min_devices` devices. XLA_FLAGS' --xla_force_host_platform_device_count
+    is honored; on jax >= 0.5 the count is re-applied via
+    jax_num_cpu_devices even after a backend was initialized, on older
+    jax only a pre-first-device-op call can grow the count (a stale
+    post-init call logs a warning)."""
     import jax
     import jax.extend.backend
     m = re.search(r"host_platform_device_count=(\d+)",
@@ -69,9 +70,28 @@ def force_cpu_platform(min_devices: int = 1) -> None:
     # a caller who pinned 2 devices gets 2 and a clear downstream error,
     # not a silently different mesh); otherwise provision min_devices
     target = int(m.group(1)) if m else max(min_devices, 1)
+    from jax._src import xla_bridge as _xb
+    was_initialized = bool(getattr(_xb, "_backends", None))
     jax.extend.backend.clear_backends()  # no-op when nothing initialized
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", target)
+    try:
+        jax.config.update("jax_num_cpu_devices", target)
+    except AttributeError:
+        # jax < 0.5 has no jax_num_cpu_devices: the count only comes from
+        # XLA_FLAGS, which XLA parses once at FIRST backend creation — so
+        # this path only provisions `target` devices when called before
+        # any device op (the entry-point call pattern)
+        if not m:
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") +
+                f" --xla_force_host_platform_device_count={target}").strip()
+        if was_initialized and target > 1:
+            import logging
+            logging.getLogger("hydragnn_tpu").warning(
+                "force_cpu_platform: this jax (<0.5) cannot re-size the "
+                "CPU device count after a backend was initialized — "
+                "requested %d devices, the stale XLA_FLAGS parse may "
+                "yield fewer", target)
 
 
 def enable_compile_cache(cache_dir: Optional[str],

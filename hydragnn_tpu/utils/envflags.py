@@ -17,6 +17,31 @@ def env_flag(name: str, default: bool = False) -> bool:
     return val.strip().lower() not in _FALSY
 
 
+_TRUTHY_STRICT = ("1", "true", "on")
+
+
+def env_strict_flag(name: str, default: bool = False) -> bool:
+    """Boolean env flag that only accepts explicit truthy values
+    ('1'/'true'/'on', any case) as True. Unlike `env_flag`, an
+    unrecognized value (a typo like 'ture') does NOT silently enable the
+    feature — it logs a warning and returns the default. Use for flags
+    that switch in experimental code paths (r5 advisor: any non-empty
+    HYDRAGNN_PALLAS_NBR value used to enable the Pallas kernel)."""
+    val = os.getenv(name)
+    if val is None:
+        return default
+    v = val.strip().lower()
+    if v in _TRUTHY_STRICT:
+        return True
+    if v in _FALSY:
+        return False
+    import logging
+    logging.getLogger("hydragnn_tpu").warning(
+        "%s=%r is not a recognized boolean (use 1/true/on or 0/false/off); "
+        "treating as %s", name, val, default)
+    return default
+
+
 def env_int(name: str, default=None):
     val = os.getenv(name)
     if val is None or not val.strip():

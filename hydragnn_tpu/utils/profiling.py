@@ -98,6 +98,66 @@ class Tracer:
         return text
 
 
+class HostStallMonitor:
+    """Per-epoch accounting of host time blocked on the input pipeline vs
+    time spent dispatching/executing steps.
+
+    ``wrap(stream)`` times every ``next()`` on the batch stream (collation,
+    cache lookups, host->device staging — everything the accelerator waits
+    on); ``step_timer()`` wraps the step call. ``input_bound_frac`` is
+    wait / (wait + step): the fraction of the epoch the device sat idle
+    for the host. This turns "the input pipeline is probably the problem"
+    into a measured number (bench.py emits it as `input_bound_frac`;
+    the trainer logs it per epoch and accumulates tracer regions
+    `dataload_wait` / `step_dispatch`)."""
+
+    def __init__(self, tracer: Optional[Tracer] = None):
+        self.tracer = tracer
+        self.reset()
+
+    def reset(self):
+        self.wait_s = 0.0
+        self.step_s = 0.0
+        self.batches = 0
+
+    def wrap(self, stream):
+        it = iter(stream)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            finally:
+                dt = time.perf_counter() - t0
+                self.wait_s += dt
+                if self.tracer is not None:
+                    self.tracer.times["dataload_wait"] = \
+                        self.tracer.times.get("dataload_wait", 0.0) + dt
+                    self.tracer.counts["dataload_wait"] = \
+                        self.tracer.counts.get("dataload_wait", 0) + 1
+            self.batches += 1
+            yield batch
+
+    @contextlib.contextmanager
+    def step_timer(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.step_s += dt
+            if self.tracer is not None:
+                self.tracer.times["step_dispatch"] = \
+                    self.tracer.times.get("step_dispatch", 0.0) + dt
+                self.tracer.counts["step_dispatch"] = \
+                    self.tracer.counts.get("step_dispatch", 0) + 1
+
+    def input_bound_frac(self) -> float:
+        total = self.wait_s + self.step_s
+        return self.wait_s / total if total > 0 else 0.0
+
+
 _GLOBAL = Tracer()
 
 

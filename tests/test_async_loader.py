@@ -1,0 +1,371 @@
+"""Asynchronous input pipeline (datasets/async_loader.py) + the three r5
+advisor regressions riding the same PR: the multihost checkpoint gate, the
+empty `slice_by_process` slice, and the trace-time HYDRAGNN_PALLAS_NBR read.
+"""
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.datasets.async_loader import (
+    BatchCache, background_iterate, dataset_invariants, neighbor_budget,
+    resolve_async_workers, resolve_cache_bytes)
+from hydragnn_tpu.datasets.loader import GraphDataLoader
+from tests.deterministic_data import deterministic_graph_dataset
+
+
+@pytest.fixture(scope="module")
+def samples():
+    return deterministic_graph_dataset(num_configs=24, heads=("graph",))
+
+
+def _assert_batches_identical(a, b, ctx=""):
+    for f in dataclasses.fields(a):
+        va, vb = getattr(a, f.name), getattr(b, f.name)
+        if va is None:
+            assert vb is None, f"{ctx}: {f.name} None mismatch"
+            continue
+        va, vb = np.asarray(va), np.asarray(vb)
+        assert va.dtype == vb.dtype, f"{ctx}: {f.name} dtype"
+        assert np.array_equal(va, vb), f"{ctx}: {f.name} values"
+
+
+def _epoch_stream(loader, epochs):
+    out = []
+    for e in range(epochs):
+        loader.set_epoch(e)
+        out.extend(loader)
+    return out
+
+
+# ---------------------------------------------------------------- tentpole
+
+def test_async_stream_bitwise_identical_to_sync(samples):
+    """Acceptance: async workers yield the exact synchronous batch stream
+    (same order, same values, same dtypes) across shuffled epochs."""
+    mk = lambda workers, cache: GraphDataLoader(
+        samples, batch_size=6, shuffle=True, seed=11,
+        neighbor_format=True, async_workers=workers, cache_mb=cache)
+    sync = _epoch_stream(mk(0, 0), 3)
+    asyn = _epoch_stream(mk(3, 64), 3)
+    assert len(sync) == len(asyn) > 0
+    for i, (a, b) in enumerate(zip(sync, asyn)):
+        _assert_batches_identical(a, b, ctx=f"batch {i}")
+
+
+def test_async_worker_exception_propagates(samples):
+    """A worker exception surfaces on the consumer (at the failing batch's
+    position) instead of hanging the queue."""
+    class Exploding(list):
+        def __getitem__(self, i):
+            if i == 7:
+                raise RuntimeError("bad sample 7")
+            return list.__getitem__(self, i)
+
+    ld = GraphDataLoader(Exploding(samples), batch_size=4, shuffle=False,
+                         async_workers=2, cache_mb=0)
+    with pytest.raises(RuntimeError, match="bad sample 7"):
+        list(ld)
+
+
+def test_cache_hit_after_set_epoch_replay(samples):
+    """Re-visiting an epoch (same seed+epoch => same permutation) replays
+    collation from the cache, bitwise-identically."""
+    ld = GraphDataLoader(samples, batch_size=6, shuffle=True, seed=3,
+                         async_workers=2, cache_mb=64)
+    ld.set_epoch(1)
+    first = list(ld)
+    assert ld.batch_cache.hits == 0
+    ld.set_epoch(1)
+    again = list(ld)
+    assert ld.batch_cache.hits >= len(again)
+    for i, (a, b) in enumerate(zip(first, again)):
+        _assert_batches_identical(a, b, ctx=f"replayed batch {i}")
+
+
+def test_sync_path_also_uses_cache(samples):
+    """HYDRAGNN_ASYNC_LOADER=0 (async_workers=0) still consults the batch
+    cache, so the kill switch does not forfeit epoch reuse."""
+    ld = GraphDataLoader(samples, batch_size=6, shuffle=True, seed=3,
+                         async_workers=0, cache_mb=64)
+    ld.set_epoch(0)
+    list(ld)
+    ld.set_epoch(0)
+    again = list(ld)
+    assert ld.batch_cache.hits >= len(again)
+
+
+def test_batch_cache_eviction_bounds_memory(samples):
+    ld = GraphDataLoader(samples, batch_size=6, shuffle=True, seed=0,
+                         async_workers=0, cache_mb=64)
+    one = next(iter(ld))
+    nbytes = sum(np.asarray(getattr(one, f.name)).nbytes
+                 for f in dataclasses.fields(one)
+                 if getattr(one, f.name) is not None)
+    cache = BatchCache(max_bytes=int(nbytes * 2.5))  # room for 2 batches
+    for i in range(5):
+        cache.put((i,), one)
+    assert len(cache) == 2
+    assert cache.evictions == 3
+    assert cache.nbytes <= cache.max_bytes
+    # an over-budget single batch is never inserted
+    tiny = BatchCache(max_bytes=16)
+    tiny.put((0,), one)
+    assert len(tiny) == 0
+
+
+def test_background_iterate_order_and_errors():
+    assert list(background_iterate(iter(range(50)), depth=3)) == \
+        list(range(50))
+
+    def boom():
+        yield 1
+        raise ValueError("producer died")
+    it = background_iterate(boom(), depth=2)
+    assert next(it) == 1
+    with pytest.raises(ValueError, match="producer died"):
+        list(it)
+
+
+def test_background_iterate_abandonment_stops_producer():
+    started = threading.active_count()
+    it = background_iterate(iter(range(10_000)), depth=2)
+    next(it)
+    it.close()
+    deadline = time.time() + 5
+    while threading.active_count() > started and time.time() < deadline:
+        time.sleep(0.01)
+    assert threading.active_count() <= started
+
+
+def test_dataset_invariants_match_legacy_scans(samples):
+    from hydragnn_tpu.graphs.batch import neighbor_budget_for_dataset
+    inv = dataset_invariants(list(samples), need_degree=True)
+    assert inv.max_nodes == max(s.num_nodes for s in samples)
+    assert inv.max_edges == max(s.num_edges for s in samples)
+    assert neighbor_budget(samples) == neighbor_budget_for_dataset(samples)
+
+
+def test_resolver_env_knobs(monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_ASYNC_LOADER", "0")
+    assert resolve_async_workers(None) == 0
+    assert resolve_async_workers(5) == 5  # explicit override wins
+    monkeypatch.setenv("HYDRAGNN_ASYNC_LOADER", "1")
+    monkeypatch.setenv("HYDRAGNN_LOADER_WORKERS", "7")
+    assert resolve_async_workers(None) == 7
+    # 0 workers via env == the async_workers=0 override: synchronous
+    monkeypatch.setenv("HYDRAGNN_LOADER_WORKERS", "0")
+    assert resolve_async_workers(None) == 0
+    # the batch cache is opt-in: unset env and no override -> disabled
+    monkeypatch.delenv("HYDRAGNN_BATCH_CACHE_MB", raising=False)
+    assert resolve_cache_bytes(None) == 0
+    monkeypatch.setenv("HYDRAGNN_BATCH_CACHE_MB", "64")
+    assert resolve_cache_bytes(None) == 64 << 20
+    monkeypatch.setenv("HYDRAGNN_BATCH_CACHE_MB", "0")
+    assert resolve_cache_bytes(None) == 0
+    assert resolve_cache_bytes(3) == 3 << 20
+
+
+def test_multidataset_abandoned_stream_does_not_stomp_epochs(samples,
+                                                             monkeypatch):
+    """Abandoning an async MultiDatasetLoader iteration mid-epoch and
+    re-seeding (set_epoch) must stop the background producer FIRST — a
+    stale producer advancing shard-epoch counters concurrently would make
+    the next epoch's permutations host-dependent."""
+    from hydragnn_tpu.parallel.multidataset import MultiDatasetLoader
+    datasets = [list(samples[:12]), list(samples[12:])]
+
+    monkeypatch.setenv("HYDRAGNN_ASYNC_LOADER", "0")
+    ref = MultiDatasetLoader(datasets, batch_size=4, num_shards=2, seed=5)
+    ref.set_epoch(1)
+    expected = list(ref)
+
+    monkeypatch.setenv("HYDRAGNN_ASYNC_LOADER", "1")
+    ld = MultiDatasetLoader(datasets, batch_size=4, num_shards=2, seed=5)
+    ld.set_epoch(0)
+    next(iter(ld))  # abandon mid-stream, producer still pipelining
+    ld.set_epoch(1)  # must close the stale producer before re-seeding
+    got = list(ld)
+    assert len(got) == len(expected) > 0
+    for i, (a, b) in enumerate(zip(expected, got)):
+        _assert_batches_identical(a, b, ctx=f"post-abandon batch {i}")
+
+
+def test_nonthreadsafe_dataset_fetched_on_consumer_thread(samples):
+    """File/socket-backed (non-list) datasets must only be indexed from
+    the consumer thread — including the all-padding empty-shard branch,
+    which uses the prototype sample pinned at construction."""
+    class RecordingDataset:
+        def __init__(self, s):
+            self._s = list(s)
+            self.threads = set()
+
+        def __len__(self):
+            return len(self._s)
+
+        def __getitem__(self, i):
+            self.threads.add(threading.current_thread().name)
+            return self._s[i]
+
+    # 5 samples / batch_size 4 / 2 shards, drop_last=False: the final
+    # batch leaves shard 1 empty -> exercises the proto-sample branch
+    ds = RecordingDataset(samples[:5])
+    ld = GraphDataLoader(ds, batch_size=4, num_shards=2, drop_last=False,
+                         async_workers=2, cache_mb=0)
+    batches = list(ld)
+    assert len(batches) == 2
+    assert ds.threads == {"MainThread"}, (
+        f"dataset indexed off the consumer thread: {ds.threads}")
+
+
+def test_multidataset_loader_async_matches_sync(samples, monkeypatch):
+    from hydragnn_tpu.parallel.multidataset import MultiDatasetLoader
+    datasets = [list(samples[:12]), list(samples[12:])]
+
+    def batches(enabled):
+        monkeypatch.setenv("HYDRAGNN_ASYNC_LOADER", "1" if enabled else "0")
+        ld = MultiDatasetLoader(datasets, batch_size=4, num_shards=2, seed=5)
+        ld.set_epoch(0)
+        return list(ld)
+
+    sync, asyn = batches(False), batches(True)
+    assert len(sync) == len(asyn) > 0
+    for i, (a, b) in enumerate(zip(sync, asyn)):
+        _assert_batches_identical(a, b, ctx=f"stacked batch {i}")
+
+
+# ------------------------------------------------- r5 advisor regressions
+
+def test_checkpoint_fn_runs_on_every_rank(monkeypatch, samples):
+    """Regression (run_training.py:422): mid-training best-val saves are a
+    multihost collective — the callback must be installed and invoked on
+    every rank, not only process_index()==0."""
+    from hydragnn_tpu.utils import checkpoint as ckpt
+    calls = []
+    monkeypatch.setattr(
+        ckpt, "save_model",
+        lambda state, log_name, path="./logs", use_async=False:
+        calls.append((log_name, use_async)))
+    fn = ckpt.make_async_best_checkpoint_fn("run")
+    monkeypatch.setattr("jax.process_index", lambda: 1)  # a non-zero rank
+    fn(state=None, epoch=0, val_loss=0.5)
+    assert calls == [("run", True)]
+
+    # a failed optional save must not abort training
+    def explode(*a, **k):
+        raise IOError("disk full")
+    monkeypatch.setattr(ckpt, "save_model", explode)
+    fn(state=None, epoch=1, val_loss=0.4)  # no raise
+
+
+def test_slice_by_process_underflow_raises():
+    """Regression (multiprocess.py:141): a split smaller than the process
+    count must not silently become an empty slice (whose 0.0 eval loss
+    corrupted keep_best/LR-plateau)."""
+    from hydragnn_tpu.parallel.multiprocess import slice_by_process
+    with pytest.raises(ValueError, match="empty split"):
+        slice_by_process([1, 2], nproc=4, rank=0, what="validate split")
+
+
+def test_slice_by_process_underflow_replicate(caplog):
+    from hydragnn_tpu.parallel.multiprocess import slice_by_process
+    with caplog.at_level("WARNING", logger="hydragnn_tpu"):
+        out = slice_by_process([1, 2], nproc=4, rank=3,
+                               what="validate split",
+                               underflow="replicate")
+    assert out == [1, 2]  # every rank keeps the full split
+    assert any("replicating" in r.message for r in caplog.records)
+
+
+def test_slice_by_process_logs_dropped_tail(caplog):
+    from hydragnn_tpu.parallel.multiprocess import slice_by_process
+    ds = list(range(10))
+    with caplog.at_level("INFO", logger="hydragnn_tpu"):
+        out = [slice_by_process(ds, nproc=4, rank=r) for r in range(4)]
+    assert [len(s) for s in out] == [2, 2, 2, 2]
+    assert sorted(sum(out, [])) == list(range(8))
+    assert any("dropping 2 tail" in r.message for r in caplog.records)
+
+
+def test_pallas_nbr_flag_strict_and_pinned(monkeypatch):
+    """Regression (convs.py:218): HYDRAGNN_PALLAS_NBR is resolved once at
+    step-construction time and only explicit truthy values enable it."""
+    from hydragnn_tpu.kernels import nbr_pallas as knp
+    from hydragnn_tpu.utils.envflags import env_strict_flag
+
+    monkeypatch.setenv("HYDRAGNN_PALLAS_NBR", "ture")  # typo: NOT truthy
+    assert env_strict_flag("HYDRAGNN_PALLAS_NBR", False) is False
+    for v in ("1", "true", "on", "TRUE", "On"):
+        monkeypatch.setenv("HYDRAGNN_PALLAS_NBR", v)
+        assert env_strict_flag("HYDRAGNN_PALLAS_NBR", False) is True
+    for v in ("0", "false", "off", ""):
+        monkeypatch.setenv("HYDRAGNN_PALLAS_NBR", v)
+        assert env_strict_flag("HYDRAGNN_PALLAS_NBR", False) is False
+
+    # pinning: the resolved value is frozen until the next refresh (i.e. a
+    # post-step-construction env toggle is a no-op, not a trace-time read)
+    monkeypatch.setenv("HYDRAGNN_PALLAS_NBR", "1")
+    assert knp.resolve_nbr_pallas_flag(refresh=True) is True
+    monkeypatch.setenv("HYDRAGNN_PALLAS_NBR", "0")
+    assert knp.resolve_nbr_pallas_flag() is True  # still the pinned value
+    assert knp.resolve_nbr_pallas_flag(refresh=True) is False
+
+
+# --------------------------------------------------- CI smoke benchmark
+
+def _dense_samples(num=32, nodes=64, deg=30, seed=0):
+    """bench.py-style fixed-degree random graphs: enough edges that the
+    O(E log E) neighbor-table build makes collation a few ms per batch."""
+    from hydragnn_tpu.graphs import GraphSample
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(num):
+        send = np.repeat(np.arange(nodes), deg).astype(np.int32)
+        recv = rng.randint(0, nodes, nodes * deg).astype(np.int32)
+        out.append(GraphSample(
+            x=rng.rand(nodes, 1).astype(np.float32),
+            pos=rng.rand(nodes, 3).astype(np.float32) * 10,
+            senders=send, receivers=recv,
+            y_graph=np.asarray([rng.randn()], np.float32)))
+    return out
+
+
+def test_input_pipeline_smoke_benchmark():
+    """Fast perf guard: with a consumer that idles like a host waiting on
+    an accelerator step, the async loader must not be slower than the
+    synchronous one (collation overlaps the 'step'), and the host-stall
+    instrumentation reports a lower input-bound fraction. Prints the
+    input_bound_frac line so CI logs carry the number."""
+    from hydragnn_tpu.utils.profiling import HostStallMonitor
+    heavy = _dense_samples()
+    step_s = 0.006
+    epochs = 4
+
+    def run(workers):
+        ld = GraphDataLoader(heavy, batch_size=4, shuffle=True, seed=2,
+                             neighbor_format=True, async_workers=workers,
+                             cache_mb=0)
+        stall = HostStallMonitor()
+        t0 = time.perf_counter()
+        for e in range(epochs):
+            ld.set_epoch(e)
+            for _ in stall.wrap(ld):
+                with stall.step_timer():
+                    time.sleep(step_s)  # stands in for the device step
+        return time.perf_counter() - t0, stall.input_bound_frac()
+
+    run(0)  # warm both paths (imports, allocator) before timing
+    sync_t, sync_frac = run(0)
+    async_t, async_frac = run(2)
+    print(f"input_bound_frac sync={sync_frac:.3f} async={async_frac:.3f} "
+          f"wall sync={sync_t:.3f}s async={async_t:.3f}s")
+    assert 0.0 <= async_frac <= 1.0 and 0.0 <= sync_frac <= 1.0
+    # generous slack absorbs scheduler jitter on the contended 2-core CI
+    # tier; the real expectation is a clear win. The frac comparison is
+    # advisory only (printed above) — few-ms per-batch timings flip under
+    # a noisy neighbor, and the wall-clock guard already catches a loader
+    # that stopped overlapping.
+    assert async_t <= sync_t * 1.25, (
+        f"async loader slower than sync: {async_t:.3f}s vs {sync_t:.3f}s")

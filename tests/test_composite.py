@@ -30,6 +30,7 @@ def _train(cfg, **kw):
     return run_training(cfg, datasets=_splits(), **kw)
 
 
+@pytest.mark.slow
 def test_graph_shards_config_trains():
     """graph_shards=4 via config: data axis gets 8/4=2 devices."""
     cfg = make_config("PNA")

@@ -28,7 +28,16 @@ EXTRA_ARCH = {
 }
 
 
-@pytest.mark.parametrize("model_type", sorted(THRESHOLDS))
+# the triplet/equivariant stacks dominate the module's wall clock on the
+# 2-core CPU tier (DimeNet ~67s, the others 15-23s each) — nightly lane
+# only; the cheap message-passing models stay in tier-1
+_HEAVY = {"DimeNet", "PNAEq", "PNAPlus", "MACE", "GAT", "PAINN"}
+
+
+@pytest.mark.parametrize(
+    "model_type",
+    [pytest.param(m, marks=pytest.mark.slow) if m in _HEAVY else m
+     for m in sorted(THRESHOLDS)])
 def test_model_threshold(model_type):
     samples = deterministic_graph_dataset(num_configs=160, heads=("graph",))
     splits = split_dataset(samples, 0.7)

@@ -129,10 +129,17 @@ def test_fused_neighbor_aggregate_in_pna(monkeypatch):
     batch = with_neighbor_format(batch, k=12)
     model = create_model(mcfg)
     variables = init_params(model, batch)
+    # the flag is pinned at resolve time, not read per-trace — refresh it
+    # around each env change exactly like a step factory would, and let
+    # monkeypatch restore the pre-test pin at teardown
+    from hydragnn_tpu.kernels import nbr_pallas as knp
+    monkeypatch.setattr(knp, "_RESOLVED_FLAG", None)
     monkeypatch.delenv("HYDRAGNN_PALLAS_NBR", raising=False)
+    assert knp.resolve_nbr_pallas_flag(refresh=True) is False
     out_default, _ = model.apply(variables, batch, train=False)
 
     monkeypatch.setenv("HYDRAGNN_PALLAS_NBR", "1")
+    assert knp.resolve_nbr_pallas_flag(refresh=True) is True
     out_fused, _ = model.apply(variables, batch, train=False)
     for a, b in zip(out_default, out_fused):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),

@@ -237,6 +237,7 @@ def _lj_splits(n=24):
     return samples[:k], samples[k:k + n // 6], samples[k + n // 6:]
 
 
+@pytest.mark.slow
 def test_pipeline_ef_matches_sequential():
     """Energy-force losses computed through the GPipe schedule equal the
     sequential-scan losses on the same params — the force grad (d/dpos)
@@ -271,6 +272,7 @@ def test_pipeline_ef_matches_sequential():
                                rtol=2e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_pipeline_ef_config_trains():
     """Training.pipeline_stages + compute_grad_energy from a JSON config:
     the equivariant SchNet EF flagship trains on the pipelined path."""
