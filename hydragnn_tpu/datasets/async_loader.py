@@ -202,7 +202,11 @@ def iterate_async(loader, selections: Sequence[Tuple[int, ...]],
         if threadsafe:
             fut = ex.submit(loader._build_batch, sel)
         else:
-            samples = [loader.dataset[i] for i in sel]
+            # packed selections are nested per-shard tuples: flatten via
+            # the loader so the fetch order matches _build_batch_from_samples
+            flat = getattr(loader, "_flat_indices", None)
+            idx = flat(sel) if flat is not None else sel
+            samples = [loader.dataset[i] for i in idx]
             fut = ex.submit(loader._build_batch_from_samples, sel, samples)
         pending.append((sel, fut, None))
 

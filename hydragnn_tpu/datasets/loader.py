@@ -46,6 +46,11 @@ class GraphDataLoader:
         neighbor_k: Optional[int] = None,
         async_workers: Optional[int] = None,
         cache_mb: Optional[int] = None,
+        packing: bool = False,
+        pack_budget=None,
+        pack_lookahead: Optional[int] = None,
+        pack_rank: int = 0,
+        pack_nproc: int = 1,
     ):
         assert batch_size % num_shards == 0 or num_shards == 1, (
             f"batch_size {batch_size} must divide evenly over {num_shards} shards")
@@ -58,6 +63,28 @@ class GraphDataLoader:
         self.epoch = 0
         self._transform_arity = None
         self.drop_last = shuffle if drop_last is None else drop_last
+        self.packing = bool(packing)
+        self.pack_rank, self.pack_nproc = int(pack_rank), int(pack_nproc)
+        self.pack_budget = None
+        self._sizes = None        # lazily-scanned (nodes[], edges[]) arrays
+        self._plan_cache = {}     # epoch -> (bins, selections)
+        if self.packing:
+            # budget-packed batching (graphs/packing.py): shapes come from
+            # the pack budget — sized for graphs_per_shard AVERAGE graphs,
+            # not worst-case — and a variable graph count fills each bin
+            import dataclasses as _dc
+            from ..graphs.packing import choose_budget
+            nodes, edges = self._sample_sizes()
+            if pack_budget is None:
+                pack_budget = choose_budget(nodes, edges,
+                                            self.graphs_per_shard,
+                                            lookahead=pack_lookahead)
+            elif pack_lookahead:
+                pack_budget = _dc.replace(pack_budget,
+                                          lookahead=int(pack_lookahead))
+            self.pack_budget = pack_budget
+            n_node_per_shard = pack_budget.n_node
+            n_edge_per_shard = pack_budget.n_edge
         bucket = bucket or BucketSpec(multiple=64)
         if n_node_per_shard is None or n_edge_per_shard is None:
             from .async_loader import dataset_invariants
@@ -68,7 +95,8 @@ class GraphDataLoader:
                 inv.max_edges * self.graphs_per_shard + 1)
         self.n_node = n_node_per_shard
         self.n_edge = n_edge_per_shard
-        self.n_graph = self.graphs_per_shard + 1
+        self.n_graph = (self.pack_budget.n_graph if self.packing
+                        else self.graphs_per_shard + 1)
         # shape prototype for all-padding (empty-shard) batches, pinned on
         # the constructing thread: _collate_shard_raw may run on a worker
         # thread, and file/socket-backed datasets are not safe to index
@@ -97,6 +125,8 @@ class GraphDataLoader:
         self.epoch = epoch
 
     def __len__(self):
+        if self.packing:
+            return len(self._plan()[1])
         n = len(self.dataset)
         if self.drop_last:
             # never drop down to zero batches: a dataset smaller than one
@@ -111,6 +141,67 @@ class GraphDataLoader:
             rng = np.random.RandomState(self.seed + self.epoch)
             rng.shuffle(idx)
         return idx
+
+    def _sample_sizes(self):
+        """(nodes[], edges[]) per dataset index, scanned once and cached —
+        the pack planner's input and the padding-stats denominator."""
+        if self._sizes is None:
+            from ..graphs.packing import sample_sizes
+            self._sizes = sample_sizes(self.dataset)
+        return self._sizes
+
+    def _plan(self):
+        """The epoch's pack plan: (global bins, this rank's selections).
+
+        The plan is computed from the GLOBAL shuffled order over the full
+        dataset — identical on every process for a given (seed, epoch) —
+        and only then sliced per (pack_rank, pack_nproc), so all ranks
+        execute the same step count (docs/packing.md)."""
+        key = self.epoch if self.shuffle else -1
+        hit = self._plan_cache.get(key)
+        if hit is None:
+            from ..graphs.packing import pack_order, plan_steps
+            nodes, edges = self._sample_sizes()
+            bins = pack_order(self._order(), nodes, edges, self.pack_budget)
+            sels = plan_steps(bins, self.num_shards, self.pack_nproc,
+                              self.pack_rank, drop_last=self.drop_last)
+            hit = (bins, sels)
+            self._plan_cache = {key: hit}  # keep only the current epoch
+        return hit
+
+    def _flat_indices(self, sel) -> List[int]:
+        """Flatten a selection to dataset indices (packed selections are
+        tuples of per-shard tuples; fixed selections are flat)."""
+        if self.packing:
+            return [i for shard in sel for i in shard]
+        return list(sel)
+
+    def padding_stats(self):
+        """Measured padding waste of the current epoch's plan —
+        `padding_frac_nodes` / `padding_frac_edges` over all node/edge
+        slots the compiled program will execute (the FLOP-waste proxy
+        reported by trainer/bench), plus bookkeeping fields.
+
+        Returns None for fixed-mode loaders over non-in-memory datasets:
+        the size scan would deserialize every sample from disk/socket
+        purely for instrumentation (packing mode already paid that scan
+        at plan time, so it always reports)."""
+        if (not self.packing and self._sizes is None
+                and not isinstance(self.dataset, (list, tuple))):
+            return None
+        from ..graphs.packing import plan_padding_stats
+        nodes, edges = self._sample_sizes()
+        sels = self._selections()
+        if not self.packing:
+            # normalize flat fixed-mode selections to per-shard tuples so
+            # the slot denominator counts every shard's padded shape
+            g = self.graphs_per_shard
+            sels = [tuple(tuple(sel[sh * g:(sh + 1) * g])
+                          for sh in range(self.num_shards)) for sel in sels]
+        stats = plan_padding_stats(sels, nodes, edges,
+                                   self.n_node, self.n_edge)
+        stats["packing"] = "packed" if self.packing else "fixed"
+        return stats
 
     def _collate_shard(self, samples: List[GraphSample]) -> GraphBatch:
         b = self._collate_shard_raw(samples)
@@ -160,7 +251,10 @@ class GraphDataLoader:
     def _selections(self) -> List[Tuple[int, ...]]:
         """The epoch's batch index tuples, in yield order — the unit of
         work for both the synchronous loop and the background workers (and
-        the batch-cache key)."""
+        the batch-cache key). In packing mode each selection is a tuple of
+        per-shard index tuples (still an exact, hashable index key)."""
+        if self.packing:
+            return self._plan()[1]
         order = self._order()
         return [tuple(int(i) for i in
                       order[ib * self.batch_size:(ib + 1) * self.batch_size])
@@ -168,9 +262,19 @@ class GraphDataLoader:
 
     def _build_batch(self, sel: Tuple[int, ...]) -> GraphBatch:
         return self._build_batch_from_samples(
-            sel, [self.dataset[i] for i in sel])
+            sel, [self.dataset[i] for i in self._flat_indices(sel)])
 
     def _build_batch_from_samples(self, sel, samples) -> GraphBatch:
+        if self.packing:
+            # sel is a tuple of per-shard index tuples; `samples` holds the
+            # flattened fetch in the same order
+            shards, at = [], 0
+            for shard_sel in sel:
+                shards.append(self._collate_shard(
+                    samples[at:at + len(shard_sel)]))
+                at += len(shard_sel)
+            return shards[0] if self.num_shards == 1 else \
+                _stack_batches(shards)
         if self.num_shards == 1:
             return self._collate_shard(samples)
         shards = []

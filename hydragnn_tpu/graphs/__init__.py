@@ -1,2 +1,3 @@
 from .batch import BucketSpec, GraphBatch, GraphSample, batch_shape_for_dataset, collate
+from .packing import PackBudget, choose_budget, pack_order, plan_steps
 from .radius import radius_graph, radius_graph_pbc

@@ -179,6 +179,47 @@ class BucketSpec:
         return (self.bucket(n_node + 1), self.bucket(n_edge + 1), n_graph + 1)
 
 
+# optional GraphSample fields whose presence/width the padded buffers take
+# from samples[0] — a mixed batch must fail up front, not mid-fill
+_COLLATE_OPTIONAL_FIELDS = ("edge_attr", "edge_shifts", "y_graph", "y_node",
+                            "cell", "energy", "forces")
+
+
+def _validate_field_homogeneity(samples: Sequence[GraphSample]) -> None:
+    """Every sample must carry the same field schema as samples[0]: the
+    fill loop sizes the padded buffers from samples[0] only, so a mixed
+    list (e.g. some samples missing edge_attr/forces) would either crash
+    mid-fill with an opaque broadcast error or silently drop the field
+    for the whole batch. Raise a clear per-field error instead."""
+    ref = samples[0]
+    for name in _COLLATE_OPTIONAL_FIELDS:
+        want = getattr(ref, name) is not None
+        for i, s in enumerate(samples):
+            if (getattr(s, name) is not None) != want:
+                a, b = ("present", "missing") if want else ("missing",
+                                                           "present")
+                raise ValueError(
+                    f"collate: field '{name}' is {a} on sample 0 but {b} "
+                    f"on sample {i} — all samples in a batch must share "
+                    "one field schema (fill or drop the field "
+                    "consistently across the dataset)")
+    dims = [("x", lambda s: s.x.shape[1])]
+    if ref.edge_attr is not None:
+        dims.append(("edge_attr", lambda s: s.edge_attr.shape[1]))
+    if ref.y_graph is not None:
+        dims.append(("y_graph", lambda s: s.y_graph.shape[0]))
+    if ref.y_node is not None:
+        dims.append(("y_node", lambda s: s.y_node.shape[1]))
+    for name, dim in dims:
+        want_d = dim(ref)
+        for i, s in enumerate(samples):
+            if dim(s) != want_d:
+                raise ValueError(
+                    f"collate: field '{name}' has width {want_d} on "
+                    f"sample 0 but {dim(s)} on sample {i} — all samples "
+                    "in a batch must share one feature/label width")
+
+
 def collate(
     samples: Sequence[GraphSample],
     n_node: Optional[int] = None,
@@ -192,6 +233,10 @@ def collate(
     At least one padding graph and one padding node are always present
     (jraph ``pad_with_graphs`` convention).
     """
+    if not samples:
+        raise ValueError("collate: at least one sample is required (the "
+                         "loader's empty-shard path pads a proto sample)")
+    _validate_field_homogeneity(samples)
     tot_n = sum(s.num_nodes for s in samples)
     tot_e = sum(s.num_edges for s in samples)
     ng = len(samples)

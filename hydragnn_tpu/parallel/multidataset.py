@@ -67,18 +67,40 @@ class MultiDatasetLoader:
 
     def __init__(self, datasets: Sequence[Sequence[GraphSample]],
                  batch_size: int, num_shards: int, seed: int = 0,
-                 bucket: Optional[BucketSpec] = None):
+                 bucket: Optional[BucketSpec] = None,
+                 packing: bool = False,
+                 pack_lookahead: Optional[int] = None):
         assert batch_size % num_shards == 0
         self.gps = batch_size // num_shards
         self.assignment = assign_shards_to_datasets(
             [len(d) for d in datasets], num_shards)
-        bucket = bucket or BucketSpec(multiple=64)
-        from ..datasets.async_loader import dataset_invariants
-        invs = [dataset_invariants(d) for d in datasets]
-        max_n = max(i.max_nodes for i in invs)
-        max_e = max(i.max_edges for i in invs)
-        n_node = bucket.bucket(max_n * self.gps + 1)
-        n_edge = bucket.bucket(max_e * self.gps + 1)
+        self.packing = bool(packing)
+        pack_budget = None
+        if self.packing:
+            # one pack budget over the UNION of member datasets: every
+            # shard stream packs against the same (n_node, n_edge,
+            # n_graph), so the heterogeneous mix still compiles one
+            # program (the pack-plan analogue of the max-over-datasets
+            # fixed shape below). Each shard packs its own dataset's
+            # global order — shard streams are independent by design, so
+            # there is no cross-shard step-count contract to keep here
+            # (len() already cycles the shorter streams).
+            import numpy as _np
+            from ..graphs.packing import choose_budget, sample_sizes
+            sizes = [sample_sizes(d) for d in datasets]
+            nodes = _np.concatenate([s[0] for s in sizes])
+            edges = _np.concatenate([s[1] for s in sizes])
+            pack_budget = choose_budget(nodes, edges, self.gps,
+                                        lookahead=pack_lookahead)
+            n_node, n_edge = pack_budget.n_node, pack_budget.n_edge
+        else:
+            bucket = bucket or BucketSpec(multiple=64)
+            from ..datasets.async_loader import dataset_invariants
+            invs = [dataset_invariants(d) for d in datasets]
+            max_n = max(i.max_nodes for i in invs)
+            max_e = max(i.max_edges for i in invs)
+            n_node = bucket.bucket(max_n * self.gps + 1)
+            n_edge = bucket.bucket(max_e * self.gps + 1)
         self.loaders = []
         for shard, ds_idx in enumerate(self.assignment):
             # per-shard loaders stay synchronous and uncached
@@ -92,10 +114,13 @@ class MultiDatasetLoader:
             self.loaders.append(GraphDataLoader(
                 datasets[ds_idx], self.gps, shuffle=True,
                 seed=seed * 1000 + shard, num_shards=1,
-                n_node_per_shard=n_node, n_edge_per_shard=n_edge,
-                drop_last=True, async_workers=0, cache_mb=0))
+                n_node_per_shard=None if self.packing else n_node,
+                n_edge_per_shard=None if self.packing else n_edge,
+                drop_last=True, async_workers=0, cache_mb=0,
+                packing=self.packing, pack_budget=pack_budget))
         self.n_node, self.n_edge = n_node, n_edge
-        self.n_graph = self.gps + 1
+        self.n_graph = (pack_budget.n_graph if self.packing
+                        else self.gps + 1)
         self.graphs_per_shard = self.gps
 
     def set_epoch(self, epoch: int):
@@ -111,6 +136,24 @@ class MultiDatasetLoader:
     def __len__(self):
         # one "epoch" = enough steps to cycle the largest shard stream once
         return max(len(ld) for ld in self.loaders)
+
+    def padding_stats(self):
+        """Slot-weighted padding waste over the member shard streams'
+        current plans (same fields as GraphDataLoader.padding_stats; the
+        trainer reports it per epoch)."""
+        stats = [s for s in (ld.padding_stats() for ld in self.loaders)
+                 if s is not None]
+        if not stats:
+            return None
+        tot = max(sum(s["shards"] for s in stats), 1)
+        return {
+            "padding_frac_nodes": sum(
+                s["padding_frac_nodes"] * s["shards"] for s in stats) / tot,
+            "padding_frac_edges": sum(
+                s["padding_frac_edges"] * s["shards"] for s in stats) / tot,
+            "shards": tot,
+            "packing": "packed" if self.packing else "fixed",
+        }
 
     def __iter__(self):
         # the cycling shard streams are not index-addressable (each shard

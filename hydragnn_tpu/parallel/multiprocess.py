@@ -57,6 +57,28 @@ def validate_multiprocess_spmd(num_shards: int, batch_size: int):
     return local_shards, batch_size // nproc
 
 
+def packing_process_coords(mp_data: str):
+    """(pack_rank, pack_nproc) for global-pack-plan slicing
+    (datasets/loader.py `_plan`): every process packs the SAME global
+    order over the full replicated dataset and takes its contiguous bin
+    slice per step, so all ranks execute identical step counts.
+
+    Per-host data shards (HYDRAGNN_MP_DATA=local) have no global sample
+    order to compute one plan from — rank-local plans would produce
+    divergent step counts and deadlock the collectives — so that mode
+    refuses packing outright."""
+    if mp_data != "replicated":
+        raise ValueError(
+            "batch packing requires replicated input data in multi-process "
+            "runs: per-host shards (HYDRAGNN_MP_DATA=local / GraphStore "
+            "shard dirs) have no global sample order to compute one pack "
+            "plan from, and rank-local plans would diverge in step count "
+            "and deadlock the collectives — disable "
+            "Training.batch_packing / HYDRAGNN_PACKING or use "
+            "HYDRAGNN_MP_DATA=replicated")
+    return jax.process_index(), jax.process_count()
+
+
 def allreduce_max_int(*vals: int):
     """Element-wise max of small int tuples across processes (bucket
     sizes, neighbor K — anything that shapes the compiled program)."""

@@ -85,12 +85,26 @@ def create_dataloaders(trainset, valset, testset, batch_size: int,
                        batch_transform=None, neighbor_format: bool = False,
                        neighbor_k: Optional[int] = None,
                        async_workers: Optional[int] = None,
-                       cache_mb: Optional[int] = None):
+                       cache_mb: Optional[int] = None,
+                       packing: bool = False,
+                       pack_lookahead: Optional[int] = None,
+                       pack_rank: int = 0, pack_nproc: int = 1):
     """reference: load_data.py:225-296 — DataLoader + DistributedSampler;
     here one static-shape loader per split, all sharing the max padded shape
-    so train/val/test reuse one compiled program."""
+    so train/val/test reuse one compiled program. With ``packing`` the
+    shared shape is the budget-packed one (graphs/packing.py) sized for
+    the mean batch content instead of the worst case; the pack budget is
+    computed ONCE over all three splits so they still share one program."""
     all_samples = list(trainset) + list(valset) + list(testset)
-    if n_node_per_shard is None or n_edge_per_shard is None:
+    pack_budget = None
+    if packing:
+        from ..graphs.packing import choose_budget, sample_sizes
+        g = max(batch_size // num_shards, 1)
+        nodes, edges = sample_sizes(all_samples)
+        pack_budget = choose_budget(nodes, edges, g,
+                                    lookahead=pack_lookahead)
+        n_node_per_shard = n_edge_per_shard = None
+    elif n_node_per_shard is None or n_edge_per_shard is None:
         g = max(batch_size // num_shards, 1)
         n_node_per_shard, n_edge_per_shard, k = loader_budgets(
             all_samples, g, neighbor_format)
@@ -106,7 +120,9 @@ def create_dataloaders(trainset, valset, testset, batch_size: int,
         n_node_per_shard=n_node_per_shard, n_edge_per_shard=n_edge_per_shard,
         drop_last=shuffle, batch_transform=batch_transform,
         neighbor_format=neighbor_format, neighbor_k=neighbor_k,
-        async_workers=async_workers, cache_mb=cache_mb)
+        async_workers=async_workers, cache_mb=cache_mb,
+        packing=packing, pack_budget=pack_budget,
+        pack_rank=pack_rank, pack_nproc=pack_nproc)
     return mk(trainset, True), mk(valset, False), mk(testset, False)
 
 

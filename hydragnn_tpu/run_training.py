@@ -88,12 +88,15 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     config = load_config(config_or_path)
     verbosity = config.get("Verbosity", {}).get("level", 0)
 
-    from .utils.envflags import (env_flag, env_int,
-                                 resolve_steps_per_call)
-    # HYDRAGNN_COMPILE_CACHE=<dir>: persistent XLA compilation cache so
-    # repeated runs skip recompiles (opt-in; bench.py defaults it on)
-    from .utils.devices import enable_compile_cache
-    enable_compile_cache(os.environ.get("HYDRAGNN_COMPILE_CACHE"))
+    from .utils.envflags import (env_flag, env_int, resolve_pack_lookahead,
+                                 resolve_packing, resolve_steps_per_call)
+    # HYDRAGNN_COMPILE_CACHE_DIR (or legacy HYDRAGNN_COMPILE_CACHE):
+    # persistent XLA compilation cache wired at startup so the handful of
+    # bucket/pack shapes compile once per machine, not per run (opt-in;
+    # bench.py defaults it on for TPU)
+    from .utils.devices import (enable_compile_cache,
+                                resolve_compile_cache_dir)
+    enable_compile_cache(resolve_compile_cache_dir())
     init_distributed()
     # TRACE_LEVEL>0 also turns on synchronous region timing (the cudasync
     # analogue: block_until_ready before closing a span — reference:
@@ -111,6 +114,28 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
 
     config = update_config(config, trainset, valset, testset)
 
+    # budget-packed batching (docs/packing.md): pack a VARIABLE number of
+    # graphs into a fixed (n_node, n_edge, n_graph) budget sized for the
+    # mean batch content — one compiled program, a fraction of the padding
+    # FLOPs. Resolved here, before the multi-process data wiring, because
+    # packing changes how data is distributed (global plan, not sliced
+    # samples).
+    packing = resolve_packing(config["NeuralNetwork"]["Training"])
+    pack_lookahead = resolve_pack_lookahead(
+        config["NeuralNetwork"]["Training"])
+    _arch0 = config["NeuralNetwork"]["Architecture"]
+    _tcfg0 = config["NeuralNetwork"]["Training"]
+    if packing and _arch0["model_type"] == "DimeNet":
+        log("batch_packing: DimeNet's static triplet budget is not "
+            "pack-aware yet; falling back to fixed-shape batching")
+        packing = False
+    if packing and (int(_arch0.get("graph_shards", 1) or 1) > 1
+                    or int(_tcfg0.get("pipeline_stages", 1) or 1) > 1):
+        log("batch_packing: not composed with graph_shards/pipeline_stages "
+            "meshes yet; falling back to fixed-shape batching")
+        packing = False
+    pack_rank, pack_nproc = 0, 1
+
     # multi-process (multi-host) data wiring: with replicated inputs every
     # process keeps its contiguous slice (stats above saw the full data);
     # with per-host shards (GraphStore shard dirs) the data is already
@@ -126,7 +151,15 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
             "local" if (os.environ.get("HYDRAGNN_GS_SHARD_DIR")
                         or os.environ.get("HYDRAGNN_GS_SHARD_ROOT"))
             else "replicated")
-        if mp_data == "replicated":
+        if packing:
+            # the pack plan must be computed from the GLOBAL order before
+            # any per-process slicing: every process keeps the full
+            # replicated splits, packs the same global plan, and takes its
+            # rank's bin slice per step — identical step counts on every
+            # rank by construction (raises for per-host local shards)
+            from .parallel.multiprocess import packing_process_coords
+            pack_rank, pack_nproc = packing_process_coords(mp_data)
+        elif mp_data == "replicated":
             # train: too few samples to shard is fatal (empty shards would
             # train on nothing); val/test: replicate the split instead so
             # keep_best/LR-plateau never see a bogus 0.0 eval loss
@@ -245,16 +278,20 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
                 "multi-process SPMD does not support triplet-transform "
                 "models yet (the static triplet budget is not globally "
                 "reduced; train DimeNet single-process)")
-        from .parallel.multiprocess import allreduce_max_int
-        from .preprocess.load_data import loader_budgets
-        n_node, n_edge, k_glob = loader_budgets(
-            trainset + valset + testset,
-            max(local_batch // local_shards, 1), nbr_fmt,
-            reduce_fn=lambda *v: allreduce_max_int(*v))
-        mp_loader_kwargs = dict(n_node_per_shard=n_node,
-                                n_edge_per_shard=n_edge)
-        if nbr_fmt:
-            mp_loader_kwargs["neighbor_k"] = k_glob
+        if not packing:
+            from .parallel.multiprocess import allreduce_max_int
+            from .preprocess.load_data import loader_budgets
+            n_node, n_edge, k_glob = loader_budgets(
+                trainset + valset + testset,
+                max(local_batch // local_shards, 1), nbr_fmt,
+                reduce_fn=lambda *v: allreduce_max_int(*v))
+            mp_loader_kwargs = dict(n_node_per_shard=n_node,
+                                    n_edge_per_shard=n_edge)
+            if nbr_fmt:
+                mp_loader_kwargs["neighbor_k"] = k_glob
+        # packed multi-process runs keep the FULL replicated splits on
+        # every rank, so the pack budget (and neighbor K) computed inside
+        # create_dataloaders is already identical on every process
 
     train_loader, val_loader, test_loader = create_dataloaders(
         train_source, valset, testset, local_batch,
@@ -265,7 +302,15 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         # knobs; None defers to them
         async_workers=train_cfg.get("async_loader_workers"),
         cache_mb=train_cfg.get("batch_cache_mb"),
+        packing=packing, pack_lookahead=pack_lookahead,
+        pack_rank=pack_rank, pack_nproc=pack_nproc,
         **mp_loader_kwargs)
+    if packing:
+        b = train_loader.pack_budget
+        log(f"batch_packing: budget n_node={b.n_node} n_edge={b.n_edge} "
+            f"n_graph={b.n_graph} lookahead={b.lookahead} "
+            f"(fixed-shape batching would pad every batch to the "
+            f"worst case)")
 
     if mp_spmd:
         # unequal per-host step counts deadlock the collectives
@@ -274,9 +319,12 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
                          ("test", test_loader)):
             assert_equal_across_processes(len(ld), f"{name} batches/epoch")
 
-    # init on one shard-shaped batch
+    # init on one shard-shaped batch; flax init only needs the static
+    # shapes, so in packing mode a single sample padded to the pack budget
+    # suffices (graphs_per_shard samples could overflow a mean-sized budget)
     from .graphs.batch import collate
-    init_batch = collate(trainset[:min(len(trainset), train_loader.graphs_per_shard)],
+    init_count = 1 if packing else train_loader.graphs_per_shard
+    init_batch = collate(trainset[:min(len(trainset), init_count)],
                          n_node=train_loader.n_node, n_edge=train_loader.n_edge,
                          n_graph=train_loader.n_graph, np_out=True)
     if batch_transform is not None:

@@ -147,6 +147,7 @@ def train_validate_test(
     # blocked on the input pipeline (collation + staging) vs dispatching
     # steps — the input-bound fraction the async loader is meant to erase
     stall = HostStallMonitor(tracer=tr)
+    prev_compiled = 0  # jit-recompile counter baseline (utils/profiling)
 
     for epoch in range(num_epochs):
         train_loader.set_epoch(epoch)
@@ -220,7 +221,18 @@ def train_validate_test(
         # dispatching/executing steps
         input_bound = stall.input_bound_frac()
         history.setdefault("input_bound_frac", []).append(input_bound)
-
+        # padding-waste report: fraction of the epoch's node/edge slots
+        # that were padding (the FLOP waste budget-packed batching cuts —
+        # docs/packing.md); loaders without size stats simply skip it
+        pad_stats = None
+        if callable(getattr(train_loader, "padding_stats", None)):
+            try:
+                pad_stats = train_loader.padding_stats()
+            except Exception:  # noqa: BLE001 — instrumentation only
+                pad_stats = None
+        if pad_stats is not None:
+            for k in ("padding_frac_nodes", "padding_frac_edges"):
+                history.setdefault(k, []).append(float(pad_stats[k]))
         # ---- val/test passes ----
         if run_valtest:
             val_loss, val_tasks = _eval_epoch(
@@ -232,6 +244,20 @@ def train_validate_test(
         else:
             val_loss = test_loss = float("nan")
             val_tasks = test_tasks = {}
+
+        # jit-recompile counter (after ALL of this epoch's step kinds ran):
+        # compiled-program count across the step functions minus last
+        # epoch's — nonzero after epoch 0 means a batch shape leaked out
+        # of the pinned budgets (the packed-vs-fixed adjudication signal,
+        # docs/packing.md)
+        from ..utils.profiling import jit_cache_total
+        compiled = jit_cache_total(train_step, multi_train_step,
+                                   eval_step, multi_eval_step)
+        recompiles = None
+        if compiled is not None:
+            recompiles = compiled - prev_compiled
+            prev_compiled = compiled
+            history.setdefault("jit_recompiles", []).append(recompiles)
 
         if keep_best and val_loss == val_loss and val_loss < best_val:
             best_val = val_loss
@@ -267,6 +293,13 @@ def train_validate_test(
         if tb is not None:
             tb.add_scalar("train/loss", train_loss, epoch)
             tb.add_scalar("train/input_bound_frac", input_bound, epoch)
+            if pad_stats is not None:
+                tb.add_scalar("train/padding_frac_nodes",
+                              float(pad_stats["padding_frac_nodes"]), epoch)
+                tb.add_scalar("train/padding_frac_edges",
+                              float(pad_stats["padding_frac_edges"]), epoch)
+            if recompiles is not None:
+                tb.add_scalar("train/jit_recompiles", recompiles, epoch)
             tb.add_scalar("val/loss", val_loss, epoch)
             tb.add_scalar("test/loss", test_loss, epoch)
             for k, v in task_tot.items():
@@ -274,9 +307,15 @@ def train_validate_test(
             for prefix, tasks in (("val", val_tasks), ("test", test_tasks)):
                 for k, v in tasks.items():
                     tb.add_scalar(f"{prefix}/{k}", v, epoch)
+        extra = ""
+        if pad_stats is not None:
+            extra += (f" pad_n {pad_stats['padding_frac_nodes']:.3f}"
+                      f" pad_e {pad_stats['padding_frac_edges']:.3f}")
+        if recompiles is not None:
+            extra += f" recompiles {recompiles}"
         log(f"epoch {epoch}: train {train_loss:.5f} val {val_loss:.5f} "
             f"test {test_loss:.5f} lr {lr:.2e} "
-            f"input_bound {input_bound:.3f}")
+            f"input_bound {input_bound:.3f}" + extra)
 
         if (checkpoint_fn is not None and val_loss == val_loss
                 and gate.should_save(epoch, val_loss)):

@@ -94,6 +94,21 @@ def force_cpu_platform(min_devices: int = 1) -> None:
                 "yield fewer", target)
 
 
+def resolve_compile_cache_dir(default: Optional[str] = None
+                              ) -> Optional[str]:
+    """Persistent-compile-cache dir from the environment:
+    HYDRAGNN_COMPILE_CACHE_DIR (the documented knob) or the legacy
+    HYDRAGNN_COMPILE_CACHE, first set wins; `default` applies when
+    neither is set. Feed the result to `enable_compile_cache` at startup
+    so the handful of bucket/pack shapes compile once per machine, not
+    per run."""
+    for name in ("HYDRAGNN_COMPILE_CACHE_DIR", "HYDRAGNN_COMPILE_CACHE"):
+        val = os.environ.get(name)
+        if val is not None:
+            return val
+    return default
+
+
 def enable_compile_cache(cache_dir: Optional[str],
                          min_compile_secs: float = 1.0) -> bool:
     """Persistent XLA compilation cache at `cache_dir` (no-op for None and

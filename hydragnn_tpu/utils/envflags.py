@@ -49,6 +49,30 @@ def env_int(name: str, default=None):
     return int(val)
 
 
+def resolve_packing(train_cfg) -> bool:
+    """Budget-packed batching knob (docs/packing.md): the HYDRAGNN_PACKING
+    env overrides Training.batch_packing (default off). Strict parsing —
+    packing switches batch composition and (multi-process) the data
+    distribution contract, so a typo value must warn and fall back, not
+    silently enable it (the HYDRAGNN_PALLAS_NBR lesson). Shared by
+    run_training and bench.py so the precedence can't drift."""
+    default = bool(train_cfg.get("batch_packing", False))
+    if os.getenv("HYDRAGNN_PACKING") is not None:
+        return env_strict_flag("HYDRAGNN_PACKING", default)
+    return default
+
+
+def resolve_pack_lookahead(train_cfg) -> "int | None":
+    """Bounded first-fit-decreasing window for the pack planner:
+    HYDRAGNN_PACK_LOOKAHEAD env over Training.pack_lookahead; None defers
+    to the planner default."""
+    la = env_int("HYDRAGNN_PACK_LOOKAHEAD")
+    if la is not None:
+        return la
+    la = train_cfg.get("pack_lookahead")
+    return None if la is None else int(la)
+
+
 def resolve_steps_per_call(train_cfg) -> int:
     """Steps-per-call dispatch batching knob: HYDRAGNN_STEPS_PER_CALL env
     overrides Training.steps_per_call (default 1). Shared by run_training

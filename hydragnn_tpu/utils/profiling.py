@@ -158,6 +158,36 @@ class HostStallMonitor:
         return self.wait_s / total if total > 0 else 0.0
 
 
+def jit_cache_size(fn) -> Optional[int]:
+    """Number of compiled programs a jitted callable currently holds
+    (jax 0.4.x PjitFunction `_cache_size`); None when `fn` is not a
+    jitted function (or the introspection API moved). The trainer/bench
+    report this as the recompile counter — budget-packed batching must
+    keep it at ONE program per step function (docs/packing.md)."""
+    if fn is None:
+        return None
+    probe = getattr(fn, "_cache_size", None)
+    if not callable(probe):
+        return None
+    try:
+        return int(probe())
+    except Exception:
+        return None
+
+
+def jit_cache_total(*fns) -> Optional[int]:
+    """Sum of `jit_cache_size` over the given callables; None when none
+    of them expose a cache (so callers can distinguish 'zero compiles'
+    from 'not measurable')."""
+    total, seen = 0, False
+    for fn in fns:
+        n = jit_cache_size(fn)
+        if n is not None:
+            total += n
+            seen = True
+    return total if seen else None
+
+
 _GLOBAL = Tracer()
 
 
