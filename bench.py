@@ -55,6 +55,23 @@ Env knobs:
                        waste the fixed shape pays is real FLOPs here)
   BENCH_POOL           sample-pool size in size-skewed mode
                        (default 8 * BENCH_BATCH)
+  BENCH_SERVE          =1: serving mode (docs/serving.md) — adjudicate the
+                       batched InferenceEngine against the per-request
+                       forward on identical samples: closed-loop
+                       throughput + speedup with a bitwise output check,
+                       then seeded-Poisson open-loop load for
+                       p50/p95/p99 latency, batch occupancy, padding
+                       fraction, queue depth, and compile count
+  BENCH_SERVE_REQUESTS request count per serving phase (default 256)
+  BENCH_SERVE_DIST     request size mix over BENCH_SIZE_RANGE:
+                       "loguniform" (default — the long-tail shape real
+                       request streams have) or "uniform"
+  BENCH_SERVE_WAIT_MS  engine batching window (default 2.0)
+  BENCH_SERVE_RATE     open-loop arrival rate in req/s (default: 2x the
+                       measured per-request throughput — load a
+                       non-batching server cannot sustain)
+  BENCH_SERVE_OUT      also write the serving JSON to this path (the
+                       slow-lane smoke emits BENCH_SERVE.json)
 """
 import itertools
 import json
@@ -104,12 +121,19 @@ def parse_size_range():
     return int(lo), int(hi)
 
 
-def synth_samples(num, rng, size_range=None):
+def synth_samples(num, rng, size_range=None, dist="uniform"):
     from hydragnn_tpu.graphs.batch import GraphSample
     samples = []
     for _ in range(num):
-        n = (NODES_PER_GRAPH if size_range is None
-             else int(rng.randint(size_range[0], size_range[1] + 1)))
+        if size_range is None:
+            n = NODES_PER_GRAPH
+        elif dist == "loguniform":
+            # long-tail size mix: most requests small, a thin large tail —
+            # the shape real serving streams have (BENCH_SERVE default)
+            n = int(round(np.exp(rng.uniform(np.log(size_range[0]),
+                                             np.log(size_range[1])))))
+        else:
+            n = int(rng.randint(size_range[0], size_range[1] + 1))
         pos = rng.rand(n, 3).astype(np.float32) * 10
         # fixed-degree random graph (radius-graph-like connectivity)
         send = np.repeat(np.arange(n), DEG)
@@ -166,21 +190,29 @@ def _step_flops(jitted, *args):
         return None
 
 
-def run_bench():
+def _resolve_backend_and_cache():
+    """Shared preamble for every bench mode: probe/wait for the tunnel
+    (CPU fallback keeps the JSON line flowing), then enable the
+    persistent XLA compilation cache so repeat runs skip the 20-40s
+    first compile. Default-on for TPU only — XLA's CPU AOT loader warns
+    about machine-feature mismatches (potential SIGILL) when reloading
+    CPU entries, so CPU runs need the explicit HYDRAGNN_COMPILE_CACHE
+    opt-in."""
     import jax
     backend = _wait_for_backend()
     if backend is None:
         jax.config.update("jax_platforms", "cpu")
         backend = "cpu_fallback_tunnel_down"
-    # persistent XLA compilation cache: repeat bench runs (and future
-    # rounds) skip the 20-40s first compile. Default-on for TPU only —
-    # XLA's CPU AOT loader warns about machine-feature mismatches
-    # (potential SIGILL) when reloading CPU entries, so CPU runs need the
-    # explicit HYDRAGNN_COMPILE_CACHE opt-in.
     from hydragnn_tpu.utils.devices import (enable_compile_cache,
                                             resolve_compile_cache_dir)
     default_cache = "" if backend.startswith("cpu") else ".jax_cache"
     enable_compile_cache(resolve_compile_cache_dir(default_cache))
+    return backend
+
+
+def run_bench():
+    import jax
+    backend = _resolve_backend_and_cache()
     size_range = parse_size_range()
     if size_range is not None:
         return run_bench_sized(backend, size_range)
@@ -507,6 +539,151 @@ def run_bench_sized(backend, size_range):
     return out
 
 
+def run_bench_serve(backend=None):
+    """BENCH_SERVE: the serving engine vs the per-request forward on
+    IDENTICAL samples, same compile cache, same bucket ladder — the
+    speedup is pure micro-batching (dispatch amortization + better MXU
+    fill), adjudicated at bitwise-equal outputs. Closed loop measures
+    peak throughput; the seeded-Poisson open loop measures the tail
+    latency a real request stream would see."""
+    from hydragnn_tpu.graphs.batch import collate
+    from hydragnn_tpu.models.create import init_params
+    from hydragnn_tpu.serving.config import resolve_serving
+    from hydragnn_tpu.serving.engine import InferenceEngine
+
+    if backend is None:
+        backend = _resolve_backend_and_cache()
+    size_range = parse_size_range() or (8, 80)
+    n_req = int(os.environ.get("BENCH_SERVE_REQUESTS", "256"))
+    dist = os.environ.get("BENCH_SERVE_DIST", "loguniform")
+    rng = np.random.RandomState(0)
+    samples = synth_samples(n_req, rng, size_range, dist=dist)
+    cfg, mcfg, model, _, _, compute_dtype = _bench_model(samples)
+    serving = resolve_serving(cfg)
+    wait_ms = float(os.environ.get("BENCH_SERVE_WAIT_MS", "2.0"))
+    use_nbr = os.environ.get("BENCH_NBR", "1") != "0"
+
+    variables = init_params(model, collate(samples[:4]))
+    engine = InferenceEngine(
+        model, variables, mcfg, reference_samples=samples,
+        max_batch_size=BATCH_GRAPHS, max_wait_ms=wait_ms,
+        num_buckets=serving.num_buckets, neighbor_format=use_nbr,
+        compute_dtype=compute_dtype)
+    engine.warmup()
+    compiles_after_warmup = engine.compile_count
+
+    # per-request reference: every sample padded alone into its smallest
+    # bucket, through the SAME compiled programs — what a non-batching
+    # server executes
+    def per_request_pass():
+        return [engine.forward_single(s) for s in samples]
+
+    singles = per_request_pass()
+    base_dt = _best_of(3, per_request_pass)
+    base_gps = n_req / base_dt
+
+    # closed loop: submit everything, drain; futures carry the bucket
+    # their batch ran on (the adjudication breadcrumb)
+    engine.reset_stats()
+    batched = [None]
+    bucket_used = [None]
+
+    def closed_loop():
+        futs = [engine.submit(s) for s in samples]
+        batched[0] = [f.result(timeout=300) for f in futs]
+        bucket_used[0] = [f.bucket for f in futs]
+    closed_dt = _best_of(3, closed_loop)
+    closed_gps = n_req / closed_dt
+    closed_stats = engine.stats()
+
+    # bitwise adjudication — the engine contract: batched outputs ==
+    # single-request forward ON THE SAME BUCKET, bit for bit. Verified on
+    # a deterministic subsample (a full pass would re-run every request
+    # on its batch's big bucket). Against the TIMED baseline (smallest
+    # bucket, a different compiled program) outputs agree to float32
+    # round-off, reported as a max-abs-diff.
+    n_verify = min(int(os.environ.get("BENCH_SERVE_VERIFY", "32")), n_req)
+    stride = max(n_req // n_verify, 1)
+    mismatch = 0
+    for i in range(0, n_req, stride):
+        ref = engine.forward_single(samples[i], bucket=bucket_used[0][i])
+        if not all(np.array_equal(a, b)
+                   for a, b in zip(batched[0][i], ref)):
+            mismatch += 1
+    base_diff = max(
+        float(np.abs(a - b).max())
+        for res, ref in zip(batched[0], singles)
+        for a, b in zip(res, ref))
+
+    # open loop: seeded Poisson arrivals — latency includes queueing
+    rate = float(os.environ.get("BENCH_SERVE_RATE", "0") or 0)
+    if rate <= 0:
+        rate = 2.0 * base_gps
+    engine.reset_stats()
+    arrival_rng = np.random.RandomState(7)
+    gaps = arrival_rng.exponential(1.0 / rate, size=n_req)
+    t0 = time.perf_counter()
+    futs = []
+    for s, gap in zip(samples, gaps):
+        time.sleep(max(0.0, gap))
+        futs.append(engine.submit(s))
+    for f in futs:
+        f.result(timeout=300)
+    open_dt = time.perf_counter() - t0
+    open_stats = engine.stats()
+    engine.shutdown()
+
+    out = {
+        "metric": "serve_graphs_per_sec_engine_closed_loop",
+        "value": round(closed_gps, 2),
+        "unit": "graphs/s",
+        "vs_baseline": None,
+        "backend": backend,
+        "shape": {"requests": n_req, "size_range": list(size_range),
+                  "dist": dist, "hidden": HIDDEN,
+                  "max_batch_size": BATCH_GRAPHS},
+        "dtype": compute_dtype,
+        "nbr_layout": use_nbr,
+        "max_wait_ms": wait_ms,
+        "per_request_gps": round(base_gps, 2),
+        "speedup_vs_per_request": round(closed_gps / base_gps, 2),
+        "outputs_bitwise_equal_same_bucket": mismatch == 0,
+        "bitwise_mismatches": mismatch,
+        "bitwise_verified": len(range(0, n_req, stride)),
+        "max_abs_diff_vs_per_request_bucket": base_diff,
+        "buckets": [[b.n_node, b.n_edge, b.n_graph] for b in engine.buckets],
+        "compile_count": engine.compile_count,
+        "compile_count_after_warmup": compiles_after_warmup,
+        "closed_loop": {
+            "throughput_gps": round(closed_gps, 2),
+            "p50_ms": round(closed_stats.get("p50_ms", 0.0), 3),
+            "p95_ms": round(closed_stats.get("p95_ms", 0.0), 3),
+            "p99_ms": round(closed_stats.get("p99_ms", 0.0), 3),
+            "batch_occupancy": round(closed_stats["batch_occupancy"], 4),
+            "padding_frac_nodes": round(
+                closed_stats["padding_frac_nodes"], 4),
+            "padding_frac_edges": round(
+                closed_stats["padding_frac_edges"], 4),
+            "max_queue_depth": closed_stats["max_queue_depth"],
+        },
+        "open_loop": {
+            "rate_rps": round(rate, 2),
+            "throughput_gps": round(n_req / open_dt, 2),
+            "p50_ms": round(open_stats.get("p50_ms", 0.0), 3),
+            "p95_ms": round(open_stats.get("p95_ms", 0.0), 3),
+            "p99_ms": round(open_stats.get("p99_ms", 0.0), 3),
+            "mean_ms": round(open_stats.get("mean_ms", 0.0), 3),
+            "batch_occupancy": round(open_stats["batch_occupancy"], 4),
+            "max_queue_depth": open_stats["max_queue_depth"],
+        },
+    }
+    out_path = os.environ.get("BENCH_SERVE_OUT", "").strip()
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1)
+    return out
+
+
 def sweep():
     """Run the (nbr-layout x pallas x steps-per-call) grid, each point in a
     fresh subprocess (the flags are read once per process), and report the
@@ -547,6 +724,8 @@ def sweep():
 def main():
     if os.environ.get("BENCH_SWEEP") == "1":
         out = sweep()
+    elif os.environ.get("BENCH_SERVE") == "1":
+        out = run_bench_serve()
     else:
         out = run_bench()
     print(json.dumps(out))

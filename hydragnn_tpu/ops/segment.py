@@ -9,7 +9,6 @@ All functions take `num_segments` statically so XLA sees fixed shapes.
 """
 from __future__ import annotations
 
-import os
 from typing import Optional
 
 import jax
@@ -32,22 +31,27 @@ def _use_pallas() -> bool:
     interpret-mode only and pathologically slow (r3 CPU sweep: every
     HYDRAGNN_USE_PALLAS=1 grid point timed out at 20 min, BENCH_SWEEP.json).
     The kernel stays available behind HYDRAGNN_USE_PALLAS=1 for shapes
-    where a future sweep shows an end-to-end win.
+    where a future sweep shows an end-to-end win. Parsed STRICTLY
+    (utils/envflags.env_strict_flag, the HYDRAGNN_PALLAS_NBR lesson): a
+    typo value warns and leaves the kernel off instead of silently
+    enabling it.
     """
     if not _PALLAS_STATE["checked"]:
-        env = os.environ.get("HYDRAGNN_USE_PALLAS")
-        backend = jax.default_backend()
-        if env is not None:
-            _PALLAS_STATE["on"] = env.lower() not in (
-                "0", "false", "no", "off", "")
-        else:
-            _PALLAS_STATE["on"] = False
-        _PALLAS_STATE["interpret"] = backend == "cpu"
+        from ..utils.envflags import env_strict_flag
+        _PALLAS_STATE["on"] = env_strict_flag("HYDRAGNN_USE_PALLAS", False)
+        _PALLAS_STATE["interpret"] = jax.default_backend() == "cpu"
         _PALLAS_STATE["checked"] = True
     return _PALLAS_STATE["on"]
 
 
-def segment_sum(data, segment_ids, num_segments, mask=None):
+def segment_sum(data, segment_ids, num_segments, mask=None,
+                indices_are_sorted=False):
+    """`indices_are_sorted` is the static XLA hint for nondecreasing
+    `segment_ids` (the pooling case: collate concatenates graphs in
+    order, so `node_graph` is sorted by construction) — it lets the
+    scatter lower to a segmented reduction instead of a general
+    scatter-add. Only pass True when the ids really are nondecreasing;
+    XLA is allowed to return garbage otherwise."""
     if mask is not None:
         data = jnp.where(_bcast(mask, data), data, 0.0)
     if (data.ndim == 2 and jnp.issubdtype(data.dtype, jnp.floating)
@@ -55,19 +59,25 @@ def segment_sum(data, segment_ids, num_segments, mask=None):
         from ..kernels.segment_pallas import segment_sum_pallas
         return segment_sum_pallas(data, segment_ids, num_segments,
                                   _PALLAS_STATE["interpret"])
-    return jax.ops.segment_sum(data, segment_ids, num_segments)
+    return jax.ops.segment_sum(data, segment_ids, num_segments,
+                               indices_are_sorted=indices_are_sorted)
 
 
-def segment_count(segment_ids, num_segments, mask=None):
+def segment_count(segment_ids, num_segments, mask=None,
+                  indices_are_sorted=False):
     ones = jnp.ones((segment_ids.shape[0],), jnp.float32)
     if mask is not None:
         ones = jnp.where(mask, ones, 0.0)
-    return jax.ops.segment_sum(ones, segment_ids, num_segments)
+    return jax.ops.segment_sum(ones, segment_ids, num_segments,
+                               indices_are_sorted=indices_are_sorted)
 
 
-def segment_mean(data, segment_ids, num_segments, mask=None):
-    total = segment_sum(data, segment_ids, num_segments, mask)
-    count = segment_count(segment_ids, num_segments, mask)
+def segment_mean(data, segment_ids, num_segments, mask=None,
+                 indices_are_sorted=False):
+    total = segment_sum(data, segment_ids, num_segments, mask,
+                        indices_are_sorted=indices_are_sorted)
+    count = segment_count(segment_ids, num_segments, mask,
+                          indices_are_sorted=indices_are_sorted)
     count = jnp.maximum(count, 1.0)
     return total / count.reshape(count.shape + (1,) * (total.ndim - 1))
 
@@ -212,12 +222,19 @@ def segment_softmax(logits, segment_ids, num_segments, mask=None):
 
 def global_mean_pool(node_feats, node_graph, num_graphs, node_mask):
     """Masked graph-level mean pooling
-    (reference: torch_geometric global_mean_pool at hydragnn/models/Base.py:320-323)."""
-    return segment_mean(node_feats, node_graph, num_graphs, node_mask)
+    (reference: torch_geometric global_mean_pool at hydragnn/models/Base.py:320-323).
+
+    `node_graph` ids are nondecreasing by construction — collate
+    concatenates graphs in order with padding nodes (id G-1) at the tail
+    — so the pools pass the static `indices_are_sorted` hint through to
+    `jax.ops.segment_*` (tests/test_graph_core.py pins hinted == unhinted)."""
+    return segment_mean(node_feats, node_graph, num_graphs, node_mask,
+                        indices_are_sorted=True)
 
 
 def global_sum_pool(node_feats, node_graph, num_graphs, node_mask):
-    return segment_sum(node_feats, node_graph, num_graphs, node_mask)
+    return segment_sum(node_feats, node_graph, num_graphs, node_mask,
+                       indices_are_sorted=True)
 
 
 def degree(receivers, num_nodes, edge_mask=None):

@@ -202,16 +202,15 @@ def make_spmd_dispatch_group(model, cfg: ModelConfig,
     return multi, (lambda b: shard_stacked_batch(b, mesh))
 
 
-def make_spmd_predict_step(model, mesh: Mesh, cfg: Optional[ModelConfig] = None,
-                           compute_dtype=None):
-    """Per-head predictions over a device-stacked batch: each device runs
-    the forward on its shard, outputs concatenate over the data axis
-    (device-major — matching a [D, ...] -> [D*..., ...] flatten of the
-    batch). The SPMD half of run_prediction (reference: run_prediction
-    evaluates under the same DDP layout as training, run_prediction.py:62-97,
-    with per-rank gathers at train_validate_test.py:709-737). With a `cfg`,
-    Architecture.dtype selects the same bf16 compute as the single-device
-    eval, so predictions don't depend on the shard count."""
+def make_spmd_forward(model, mesh: Mesh, cfg: Optional[ModelConfig] = None,
+                      compute_dtype=None):
+    """Per-head predictions over a device-stacked batch, taking a plain
+    ``variables`` dict — each device runs the forward on its shard,
+    outputs concatenate over the data axis (device-major — matching a
+    [D, ...] -> [D*..., ...] flatten of the batch). The SPMD forward the
+    serving engine dispatches for multi-device serving
+    (serving/engine.py); ``make_spmd_predict_step`` wraps it for the
+    TrainState-based run_prediction path."""
     forward = make_forward_fn(model, cfg, compute_dtype)
 
     def per_device(params, batch_stats, batch: GraphBatch):
@@ -222,12 +221,30 @@ def make_spmd_predict_step(model, mesh: Mesh, cfg: Optional[ModelConfig] = None,
         return outputs
 
     @jax.jit
-    def predict_step(state: TrainState, batch: GraphBatch):
+    def spmd_forward(variables, batch: GraphBatch):
         mapped = shard_map(
             per_device, mesh=mesh,
             in_specs=(P(), P(), _batch_spec(batch)),
             out_specs=P("data"),
             )
-        return mapped(state.params, state.batch_stats, batch)
+        return mapped(variables["params"], variables.get("batch_stats", {}),
+                      batch)
+
+    return spmd_forward
+
+
+def make_spmd_predict_step(model, mesh: Mesh, cfg: Optional[ModelConfig] = None,
+                           compute_dtype=None):
+    """TrainState wrapper over ``make_spmd_forward`` — the SPMD half of
+    run_prediction (reference: run_prediction evaluates under the same DDP
+    layout as training, run_prediction.py:62-97, with per-rank gathers at
+    train_validate_test.py:709-737). With a `cfg`, Architecture.dtype
+    selects the same bf16 compute as the single-device eval, so
+    predictions don't depend on the shard count."""
+    spmd_forward = make_spmd_forward(model, mesh, cfg, compute_dtype)
+
+    def predict_step(state: TrainState, batch: GraphBatch):
+        return spmd_forward({"params": state.params,
+                             "batch_stats": state.batch_stats}, batch)
 
     return predict_step

@@ -24,17 +24,25 @@ from .utils.checkpoint import load_existing_model
 
 def run_prediction(config_or_path, datasets: Optional[Tuple] = None,
                    state: Optional[TrainState] = None, model=None,
-                   num_shards: Optional[int] = None):
+                   num_shards: Optional[int] = None,
+                   serve: Optional[bool] = None):
     """Returns (true_values, predicted_values) per head
     (reference: run_prediction.py:48-107, test() gathering at
     train_validate_test.py:709-737).
 
     `num_shards > 1` evaluates the test set SPMD over a data mesh (the
     reference predicts under the same DDP layout as training); default is
-    single-program."""
+    single-program.
+
+    `serve` (default: the `Serving` config block / HYDRAGNN_SERVE env,
+    serving/config.py) routes the prediction loop through the batched
+    inference engine (serving/engine.py) — request micro-batching over a
+    bucketed compile cache — instead of the legacy per-loader-batch eval
+    loop. Outputs are bitwise-identical between the two paths on the same
+    bucket shapes (tests/test_serving.py)."""
     config = load_config(config_or_path)
-    from .utils.devices import enable_compile_cache
-    enable_compile_cache(os.environ.get("HYDRAGNN_COMPILE_CACHE"))
+    from .utils.devices import enable_compile_cache, resolve_compile_cache_dir
+    enable_compile_cache(resolve_compile_cache_dir())
     if datasets is None:
         from .run_training import _load_datasets_from_config
         datasets = _load_datasets_from_config(config)
@@ -75,6 +83,51 @@ def run_prediction(config_or_path, datasets: Optional[Tuple] = None,
         state = load_existing_model(template, log_name)
         assert state is not None, f"no checkpoint found for run '{log_name}'"
 
+    from .serving.config import resolve_serving
+    serving = resolve_serving(config)
+    use_engine = serving.enabled if serve is None else bool(serve)
+    if use_engine and batch_transform is not None:
+        # triplet-transformed batches (DimeNet) need per-batch host index
+        # tables the engine does not rebuild per bucket yet — same
+        # auto-disable contract as budget packing (docs/serving.md)
+        import logging
+        logging.getLogger("hydragnn_tpu").warning(
+            "serving engine does not support triplet batch transforms "
+            "(DimeNet); falling back to the legacy prediction loop")
+        use_engine = False
+
+    if use_engine:
+        trues, preds = _predict_with_engine(
+            model, state, mcfg, testset, serving, num_shards,
+            nbr_fmt, test_loader.neighbor_k)
+    else:
+        trues, preds = _predict_with_loader(
+            model, state, mcfg, test_loader, train_cfg, num_shards)
+
+    voi = config["NeuralNetwork"]["Variables_of_interest"]
+    if voi.get("denormalize_output") and "y_minmax" in voi:
+        trues, preds = output_denormalize(voi["y_minmax"], trues, preds)
+
+    # per-head true/pred pickle dump (reference: HYDRAGNN_DUMP_TESTDATA,
+    # train_validate_test.py:640-703 writes rank-local test-data pickles)
+    from .utils.envflags import env_flag
+    if env_flag("HYDRAGNN_DUMP_TESTDATA"):
+        import pickle
+        log_name = get_log_name_config(config)
+        dump_dir = os.path.join("./logs", log_name)
+        os.makedirs(dump_dir, exist_ok=True)
+        names = voi.get("output_names",
+                        [f"head_{i}" for i in range(len(trues))])
+        with open(os.path.join(dump_dir, "test_data.pk"), "wb") as f:
+            pickle.dump({name: {"true": t, "pred": p}
+                         for name, t, p in zip(names, trues, preds)}, f)
+    return trues, preds
+
+
+def _predict_with_loader(model, state, mcfg, test_loader, train_cfg,
+                         num_shards):
+    """Legacy per-loader-batch eval loop (one padded forward per batch of
+    `batch_size` test samples)."""
     if num_shards > 1:
         from .parallel.mesh import make_mesh, shard_batch
         from .parallel.spmd import make_spmd_predict_step
@@ -108,24 +161,67 @@ def run_prediction(config_or_path, datasets: Optional[Tuple] = None,
             mask = gm if head.head_type == "graph" else nm
             trues[ih].append(np.asarray(targets[ih])[mask])
             preds[ih].append(np.asarray(outputs[ih])[mask])
-    trues = [np.concatenate(t) for t in trues]
-    preds = [np.concatenate(p) for p in preds]
+    return ([np.concatenate(t) for t in trues],
+            [np.concatenate(p) for p in preds])
 
-    voi = config["NeuralNetwork"]["Variables_of_interest"]
-    if voi.get("denormalize_output") and "y_minmax" in voi:
-        trues, preds = output_denormalize(voi["y_minmax"], trues, preds)
 
-    # per-head true/pred pickle dump (reference: HYDRAGNN_DUMP_TESTDATA,
-    # train_validate_test.py:640-703 writes rank-local test-data pickles)
-    from .utils.envflags import env_flag
-    if env_flag("HYDRAGNN_DUMP_TESTDATA"):
-        import pickle
-        log_name = get_log_name_config(config)
-        dump_dir = os.path.join("./logs", log_name)
-        os.makedirs(dump_dir, exist_ok=True)
-        names = voi.get("output_names",
-                        [f"head_{i}" for i in range(len(trues))])
-        with open(os.path.join(dump_dir, "test_data.pk"), "wb") as f:
-            pickle.dump({name: {"true": t, "pred": p}
-                         for name, t, p in zip(names, trues, preds)}, f)
-    return trues, preds
+def _sample_targets(mcfg, sample):
+    """Per-head targets straight off one GraphSample — the sample-level
+    mirror of train.loss.head_targets (same offsets, same error
+    contract), rows shaped exactly as the masked batch gathering yields
+    them (graph head: [1, D]; node head: [num_nodes, D])."""
+    targets = []
+    for head in mcfg.heads:
+        if head.head_type == "graph":
+            y = sample.y_graph
+            end = head.offset + head.output_dim
+            if y is None or y.shape[0] < end:
+                have = 0 if y is None else y.shape[0]
+                raise ValueError(
+                    f"graph head needs packed label columns "
+                    f"[{head.offset}:{end}) but the sample carries {have}")
+            targets.append(np.asarray(y[head.offset:end],
+                                      np.float32)[None, :])
+        else:
+            y = sample.y_node
+            end = head.offset + head.output_dim
+            if y is None or y.shape[1] < end:
+                have = 0 if y is None else y.shape[1]
+                raise ValueError(
+                    f"node head needs packed label columns "
+                    f"[{head.offset}:{end}) but the sample carries {have}")
+            targets.append(np.asarray(y[:, head.offset:end], np.float32))
+    return targets
+
+
+def _predict_with_engine(model, state, mcfg, testset, serving, num_shards,
+                         neighbor_format, neighbor_k):
+    """Engine path: every test sample becomes one serving request; the
+    background dispatcher coalesces them into bucketed padded batches
+    (serving/engine.py) — the same numerics as the legacy loop, measured
+    3x+ faster per request on CPU (BENCH_SERVE)."""
+    from .serving.engine import InferenceEngine
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+    engine = InferenceEngine(
+        model, variables, mcfg, reference_samples=testset,
+        max_batch_size=serving.max_batch_size,
+        max_wait_ms=serving.max_wait_ms,
+        num_buckets=serving.num_buckets,
+        bucket_multiple=serving.bucket_multiple,
+        num_shards=num_shards if num_shards and num_shards > 1 else 1,
+        neighbor_format=neighbor_format, neighbor_k=neighbor_k)
+    try:
+        engine.warmup()
+        results = engine.predict(testset)
+    finally:
+        engine.shutdown()
+    trues = [[] for _ in mcfg.heads]
+    preds = [[] for _ in mcfg.heads]
+    for sample, res in zip(testset, results):
+        targets = _sample_targets(mcfg, sample)
+        for ih, head in enumerate(mcfg.heads):
+            trues[ih].append(targets[ih])
+            preds[ih].append(res[ih][None, :]
+                             if head.head_type == "graph" else res[ih])
+    return ([np.concatenate(t) for t in trues],
+            [np.concatenate(p) for p in preds])

@@ -1,0 +1,12 @@
+"""Batched inference serving: request micro-batching over a bucketed
+compile cache (docs/serving.md)."""
+from .config import ServingConfig, resolve_serving
+from .engine import InferenceEngine, bucket_ladder, select_bucket
+
+__all__ = [
+    "InferenceEngine",
+    "ServingConfig",
+    "bucket_ladder",
+    "resolve_serving",
+    "select_bucket",
+]

@@ -1,0 +1,59 @@
+"""Serving knobs: the `Serving` config block + HYDRAGNN_SERVE_* env layer.
+
+Precedence per knob: env var over config block over default — the same
+contract as Training.batch_packing / HYDRAGNN_PACKING. All env values are
+parsed STRICTLY (utils/envflags.env_strict_*): serving switches the whole
+prediction path, so a typo value must warn and fall back to the config
+default, never silently flip the engine on (the HYDRAGNN_PALLAS_NBR
+lesson).
+
+Config schema (top-level block, alongside "Dataset"/"NeuralNetwork"):
+
+    "Serving": {
+        "enabled": false,        # engine path in run_prediction
+        "max_batch_size": 32,    # requests coalesced per dispatch
+        "max_wait_ms": 5.0,      # batching window for a lone request
+        "num_buckets": 0,        # 0 = full capacity ladder
+        "bucket_multiple": 64    # shape rounding (MXU-friendly)
+    }
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    enabled: bool = False
+    max_batch_size: int = 32
+    max_wait_ms: float = 5.0
+    num_buckets: int = 0          # 0 = full ladder (1, 2, 4, ..., max)
+    bucket_multiple: int = 64
+
+
+def resolve_serving(config: Optional[Dict[str, Any]]) -> ServingConfig:
+    """Merge the `Serving` config block and the HYDRAGNN_SERVE_* env knobs
+    into one ServingConfig. Shared by run_prediction and bench.py so the
+    precedence cannot drift."""
+    from ..utils.envflags import (env_strict_flag, env_strict_float,
+                                  env_strict_int)
+    block = (config or {}).get("Serving", {}) or {}
+    base = ServingConfig(
+        enabled=bool(block.get("enabled", False)),
+        max_batch_size=int(block.get("max_batch_size", 32)),
+        max_wait_ms=float(block.get("max_wait_ms", 5.0)),
+        num_buckets=int(block.get("num_buckets", 0)),
+        bucket_multiple=int(block.get("bucket_multiple", 64)),
+    )
+    return ServingConfig(
+        enabled=env_strict_flag("HYDRAGNN_SERVE", base.enabled),
+        max_batch_size=env_strict_int("HYDRAGNN_SERVE_MAX_BATCH",
+                                      base.max_batch_size),
+        max_wait_ms=env_strict_float("HYDRAGNN_SERVE_MAX_WAIT_MS",
+                                     base.max_wait_ms),
+        num_buckets=env_strict_int("HYDRAGNN_SERVE_BUCKETS",
+                                   base.num_buckets),
+        bucket_multiple=env_strict_int("HYDRAGNN_SERVE_BUCKET_MULTIPLE",
+                                       base.bucket_multiple),
+    )

@@ -1,0 +1,568 @@
+"""Batched inference serving engine: request micro-batching over a
+bucketed compile cache.
+
+The naive serving loop (run_prediction's legacy path, and any per-request
+deployment of it) pays one padded forward — and one XLA dispatch — per
+request, recompiling whenever a novel shape shows up. Batched execution
+over fixed-shape padded graphs is exactly where this framework already
+wins at training time (budget-packed batching, graphs/packing.py), so the
+serving path reuses the same machinery:
+
+* ``bucket_ladder`` — a small DETERMINISTIC set of padded shapes, one per
+  graph-count capacity in {1, 2, 4, ..., max_batch_size}, each sized by
+  ``graphs.packing.choose_budget`` over a reference size histogram (node/
+  edge capacities target `cap` average-size graphs, never below one
+  max-size graph) and rounded to MXU-friendly multiples. Compile count is
+  bounded by the ladder length — O(log max_batch_size) programs.
+* ``InferenceEngine.submit(sample) -> Future`` — requests enter a queue; a
+  background dispatcher coalesces them into one padded batch (greedy, in
+  arrival order, while the next request fits the largest bucket's node/
+  edge budget) up to ``max_batch_size`` requests or ``max_wait_ms`` after
+  the first dequeued request, whichever first. The coalesced batch runs
+  one compiled forward on the smallest fitting bucket and each caller's
+  future resolves to ITS unpadded slice.
+* ``warmup()`` — precompile every bucket up front so no request ever pays
+  a compile; after warmup the compile count stays frozen at the ladder
+  length (`compile_count`, asserted by tests/bench).
+
+Batched outputs are bitwise-identical to the single-request forward on
+the same bucket (tests/test_serving.py): per-node/per-edge ops are
+row-independent, and the pooling segment-sums accumulate each graph's
+nodes in the same relative order regardless of which slot the graph
+occupies.
+
+Multi-device serving (``num_shards > 1``) splits each coalesced batch
+into per-shard sub-batches on one bucket shape and runs the SPMD forward
+(parallel/spmd.make_spmd_forward) — the same shard_map layout training
+uses, with outputs concatenated device-major.
+"""
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..graphs.batch import GraphBatch, GraphSample, collate
+from ..graphs.packing import MAX_GRAPH_SLOTS, PackBudget, choose_budget
+
+_SHUTDOWN = object()
+
+
+def bucket_ladder(nodes, edges, max_batch_size: int, num_buckets: int = 0,
+                  multiple: int = 64) -> Tuple[PackBudget, ...]:
+    """The engine's deterministic bucket set, smallest first.
+
+    One bucket per graph-count capacity in the geometric ladder
+    {1, 2, 4, ..., max_batch_size}; each bucket's node/edge budget comes
+    from ``choose_budget`` over the reference (nodes, edges) histogram —
+    shapes are a pure function of (histogram, max_batch_size, num_buckets,
+    multiple). `num_buckets` > 0 keeps only the largest that many
+    capacities (fewer compiled programs, more graph-slot padding on small
+    batches). Duplicate shapes (tiny datasets) are deduped."""
+    caps: List[int] = []
+    g = max(int(max_batch_size), 1)
+    while g >= 1:
+        caps.append(g)
+        g //= 2
+    caps = sorted(set(caps))
+    if num_buckets and num_buckets > 0:
+        caps = caps[-int(num_buckets):]
+    ladder: List[PackBudget] = []
+    for cap in caps:
+        b = choose_budget(nodes, edges, cap, multiple=multiple)
+        b = dataclasses.replace(b, n_graph=min(cap, MAX_GRAPH_SLOTS) + 1)
+        if not ladder or (b.n_node, b.n_edge) != (ladder[-1].n_node,
+                                                  ladder[-1].n_edge):
+            ladder.append(b)
+        else:  # same shape at a higher capacity: keep the roomier one
+            ladder[-1] = b
+    return tuple(ladder)
+
+
+def select_bucket(buckets: Sequence[PackBudget], count: int, tot_n: int,
+                  tot_e: int) -> Optional[PackBudget]:
+    """Smallest bucket (ladder order) that fits `count` graphs with
+    `tot_n` nodes / `tot_e` edges; None when nothing fits. Pure function
+    of its arguments — the determinism contract tests pin."""
+    for b in buckets:
+        if (count <= b.cap_graphs and tot_n <= b.cap_nodes
+                and tot_e <= b.cap_edges):
+            return b
+    return None
+
+
+class _Request:
+    __slots__ = ("sample", "future", "n", "e", "t_submit")
+
+    def __init__(self, sample: GraphSample, future: Future):
+        self.sample = sample
+        self.future = future
+        self.n = sample.num_nodes
+        self.e = sample.num_edges
+        self.t_submit = time.perf_counter()
+
+
+class InferenceEngine:
+    """submit(sample) -> Future resolving to per-head unpadded outputs
+    (graph heads: [output_dim]; node heads: [num_nodes, output_dim]).
+
+    Construction needs the model + variables + ModelConfig (head types
+    drive the unpadding) and either `reference_samples` (bucket shapes
+    and the field schema come from them — typically the training/test
+    set) or an explicit `buckets` ladder plus a `proto_sample` for the
+    schema. Label fields (y_graph/y_node/energy/forces) are stripped
+    before the forward — the compiled signature is label-free, so
+    labeled and unlabeled requests share one program.
+    """
+
+    def __init__(self, model, variables, mcfg, *,
+                 reference_samples: Optional[Sequence[GraphSample]] = None,
+                 buckets: Optional[Sequence[PackBudget]] = None,
+                 proto_sample: Optional[GraphSample] = None,
+                 max_batch_size: int = 32, max_wait_ms: float = 5.0,
+                 num_buckets: int = 0, bucket_multiple: int = 64,
+                 num_shards: int = 1, neighbor_format: bool = False,
+                 neighbor_k: Optional[int] = None,
+                 batch_transform: Optional[Callable] = None,
+                 compute_dtype: Optional[str] = None):
+        import jax
+        from ..train.train_step import make_forward_fn
+
+        self.mcfg = mcfg
+        self.max_batch_size = max(int(max_batch_size), 1)
+        self.max_wait_s = max(float(max_wait_ms), 0.0) / 1e3
+        self.num_shards = max(int(num_shards), 1)
+        # bucket shapes are PER SHARD; the ladder is sized for this many
+        # requests per shard so num_shards * cap covers max_batch_size
+        self.per_shard_cap = -(-self.max_batch_size // self.num_shards)
+        self.batch_transform = batch_transform
+        if buckets is None:
+            if not reference_samples:
+                raise ValueError(
+                    "InferenceEngine needs reference_samples (bucket "
+                    "shapes + request schema) or an explicit buckets "
+                    "ladder with a proto_sample")
+            from ..graphs.packing import sample_sizes
+            nodes, edges = sample_sizes(reference_samples)
+            buckets = bucket_ladder(nodes, edges, self.per_shard_cap,
+                                    num_buckets, bucket_multiple)
+        self.buckets: Tuple[PackBudget, ...] = tuple(buckets)
+        if not self.buckets:
+            raise ValueError("InferenceEngine: empty bucket ladder")
+        if any(b.n_graph < 2 for b in self.buckets):
+            raise ValueError(
+                "InferenceEngine: every bucket needs n_graph >= 2 (one "
+                "real graph slot + the padding slot, the collate "
+                "convention)")
+        # per-shard fill limit: an explicit ladder may cap graph slots
+        # below the request-count split, and the coalescer must never
+        # build a shard that select_bucket cannot place
+        self._shard_fill_cap = min(self.per_shard_cap,
+                                   self.buckets[-1].cap_graphs)
+        self._proto = (proto_sample if proto_sample is not None
+                       else reference_samples[0])
+        self.neighbor_k = None
+        if neighbor_format:
+            if neighbor_k is None:
+                if not reference_samples:
+                    raise ValueError(
+                        "neighbor_format=True needs an explicit "
+                        "neighbor_k when no reference_samples are given")
+                from ..datasets.async_loader import neighbor_budget
+                neighbor_k = neighbor_budget(reference_samples)
+            self.neighbor_k = int(neighbor_k)
+
+        self._variables = {"params": variables["params"],
+                           "batch_stats": variables.get("batch_stats", {})}
+        if self.num_shards > 1:
+            from ..parallel.mesh import make_mesh
+            from ..parallel.spmd import make_spmd_forward
+            mesh = make_mesh((("data", self.num_shards),))
+            self._jit_forward = make_spmd_forward(model, mesh, mcfg,
+                                                  compute_dtype)
+        else:
+            forward = make_forward_fn(model, mcfg, compute_dtype)
+
+            def head_forward(variables, batch):
+                outputs, _ = forward(variables, batch, train=False)
+                return list(outputs)
+
+            self._jit_forward = jax.jit(head_forward)
+
+        # per-bucket compile cache: bucket -> AOT-compiled executable
+        self._compiled = {}
+        self.compile_count = 0
+        self._lock = threading.Lock()
+
+        # dispatcher state + service statistics
+        self._queue: "queue.Queue" = queue.Queue()
+        self._closed = False
+        self._fatal: Optional[BaseException] = None
+        self.requests_done = 0
+        self.batches_run = 0
+        self._occupancy_sum = 0.0
+        self._real_node_slots = 0
+        self._total_node_slots = 0
+        self._real_edge_slots = 0
+        self._total_edge_slots = 0
+        self.max_queue_depth = 0
+        self._latencies: List[float] = []
+        self._dispatcher = threading.Thread(target=self._loop,
+                                            name="serve-dispatch",
+                                            daemon=True)
+        self._dispatcher.start()
+
+    # ------------------------------------------------------------- client API
+
+    def submit(self, sample: GraphSample) -> Future:
+        """Enqueue one request; returns a Future resolving to the per-head
+        outputs (or raising the per-request failure). Thread-safe."""
+        fut: Future = Future()
+        err = self._validate(sample)
+        if err is not None:
+            fut.set_exception(err)
+            return fut
+        # closed-check + put under the lock: shutdown() flips _closed
+        # under the same lock BEFORE enqueuing the sentinel, so a request
+        # can never land behind the sentinel on a queue nobody drains
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("InferenceEngine is shut down")
+            if self._fatal is not None:
+                raise RuntimeError(
+                    "InferenceEngine dispatcher died") from self._fatal
+            self._queue.put(_Request(sample, fut))
+            depth = self._queue.qsize()
+            if depth > self.max_queue_depth:
+                self.max_queue_depth = depth
+        return fut
+
+    def predict(self, samples: Sequence[GraphSample], timeout=None):
+        """Submit all samples, wait, return the list of results in order."""
+        futs = [self.submit(s) for s in samples]
+        return [f.result(timeout=timeout) for f in futs]
+
+    def forward_single(self, sample: GraphSample,
+                       bucket: Optional[PackBudget] = None):
+        """The per-request reference path: one sample, padded alone into
+        the smallest bucket that fits it (or an explicit `bucket`), run
+        through the SAME compile cache — what a non-batching server would
+        execute per request. Bench/tests adjudicate the engine against
+        this on identical samples: on the bucket a batch actually ran
+        (each resolved future carries it as `.bucket`), outputs must
+        match the batched ones bitwise."""
+        err = self._validate(sample)
+        if err is not None:
+            raise err
+        req = _Request(sample, Future())
+        if bucket is None:
+            bucket = select_bucket(self.buckets, 1, req.n, req.e)
+        shards = [[req]] + [[] for _ in range(self.num_shards - 1)]
+        outs = self._forward_requests(shards, bucket)
+        return self._unpad(shards, bucket, outs)[0]
+
+    def warmup(self) -> int:
+        """Precompile every bucket (and for `num_shards > 1` the stacked
+        SPMD shape) with a zeroed proto batch; returns the number of
+        compiled programs. After warmup no request pays a compile — the
+        bench's compile-count bound."""
+        for bucket in self.buckets:
+            proto = self._collate_bucket([self._proto], bucket)
+            if self.num_shards > 1:
+                proto = self._stack_shards([proto] + [None] *
+                                           (self.num_shards - 1), bucket)
+            self._get_compiled(bucket, proto)
+        return self.compile_count
+
+    def shutdown(self, wait: bool = True):
+        """Stop accepting submissions; the dispatcher drains every queued
+        request (no hung callers) and exits. Idempotent."""
+        with self._lock:
+            if self._closed and not self._dispatcher.is_alive():
+                return
+            self._closed = True
+            self._queue.put(_SHUTDOWN)
+        if wait:
+            self._dispatcher.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.shutdown(wait=True)
+        return False
+
+    def reset_stats(self):
+        """Zero the service counters (compile cache untouched) — bench
+        phases report closed-loop and open-loop stats separately."""
+        with self._lock:
+            self.requests_done = 0
+            self.batches_run = 0
+            self._occupancy_sum = 0.0
+            self._real_node_slots = 0
+            self._total_node_slots = 0
+            self._real_edge_slots = 0
+            self._total_edge_slots = 0
+            self.max_queue_depth = 0
+            self._latencies = []
+
+    def stats(self) -> dict:
+        """Service counters for bench/monitoring: batch occupancy is real
+        graphs over graph-slot capacity of the chosen buckets; padding
+        fractions are over the node/edge slots the compiled programs
+        actually executed."""
+        from ..utils.profiling import latency_percentiles
+        with self._lock:
+            out = {
+                "requests": self.requests_done,
+                "batches": self.batches_run,
+                "batch_occupancy": (self._occupancy_sum / self.batches_run
+                                    if self.batches_run else 0.0),
+                "padding_frac_nodes": (
+                    1.0 - self._real_node_slots / self._total_node_slots
+                    if self._total_node_slots else 0.0),
+                "padding_frac_edges": (
+                    1.0 - self._real_edge_slots / self._total_edge_slots
+                    if self._total_edge_slots else 0.0),
+                "max_queue_depth": self.max_queue_depth,
+                "compile_count": self.compile_count,
+                "num_buckets": len(self.buckets),
+            }
+            out.update(latency_percentiles(self._latencies))
+        return out
+
+    # --------------------------------------------------------------- plumbing
+
+    def _validate(self, sample: GraphSample) -> Optional[Exception]:
+        big = self.buckets[-1]
+        if sample.num_nodes > big.cap_nodes or sample.num_edges > big.cap_edges:
+            return ValueError(
+                f"request ({sample.num_nodes} nodes, {sample.num_edges} "
+                f"edges) exceeds the largest serving bucket (capacity "
+                f"{big.cap_nodes} nodes / {big.cap_edges} edges) — rebuild "
+                "the engine with a larger reference set or explicit buckets")
+        p = self._proto
+        for name in ("edge_attr", "edge_shifts", "cell"):
+            if (getattr(sample, name) is None) != (getattr(p, name) is None):
+                return ValueError(
+                    f"request field '{name}' is "
+                    f"{'missing' if getattr(sample, name) is None else 'present'}"
+                    " but the engine was built for the opposite schema — "
+                    "all requests must match the reference sample schema")
+        if sample.x.shape[1] != p.x.shape[1]:
+            return ValueError(
+                f"request feature width {sample.x.shape[1]} != engine "
+                f"schema width {p.x.shape[1]}")
+        if (p.edge_attr is not None
+                and sample.edge_attr.shape[1] != p.edge_attr.shape[1]):
+            return ValueError(
+                f"request edge_attr width {sample.edge_attr.shape[1]} != "
+                f"engine schema width {p.edge_attr.shape[1]}")
+        return None
+
+    def _collate_bucket(self, samples: List[GraphSample],
+                        bucket: PackBudget) -> GraphBatch:
+        """One shard's padded batch on `bucket`, label-free, with the
+        engine's transform/neighbor tables applied — mirrors
+        GraphDataLoader._collate_shard so served numerics match the
+        loader-fed eval path."""
+        b = collate(samples, n_node=bucket.n_node, n_edge=bucket.n_edge,
+                    n_graph=bucket.n_graph, np_out=True)
+        b = b.replace(y_graph=None, y_node=None, energy=None, forces=None)
+        if self.batch_transform is not None:
+            b = self.batch_transform(b)
+        if self.neighbor_k is not None:
+            from ..graphs.batch import with_neighbor_format
+            b = with_neighbor_format(b, k=self.neighbor_k)
+        return b
+
+    def _empty_shard(self, bucket: PackBudget) -> GraphBatch:
+        """All-padding shard batch (the loader's proto-sample trick): a
+        zeroed proto collate whose masks are all False."""
+        b = self._collate_bucket([self._proto], bucket)
+        zero = lambda a: None if a is None else np.zeros_like(a)
+
+        def pad_full(a, fill):
+            return None if a is None else np.full_like(a, fill)
+
+        return b.replace(
+            x=zero(b.x), pos=zero(b.pos),
+            senders=pad_full(b.senders, bucket.n_node - 1),
+            receivers=pad_full(b.receivers, bucket.n_node - 1),
+            node_graph=pad_full(b.node_graph, bucket.n_graph - 1),
+            node_mask=zero(b.node_mask), edge_mask=zero(b.edge_mask),
+            graph_mask=zero(b.graph_mask), edge_attr=zero(b.edge_attr),
+            edge_shifts=zero(b.edge_shifts), cell=zero(b.cell),
+            triplet_mask=zero(b.triplet_mask),
+            nbr=pad_full(b.nbr, bucket.n_node - 1),
+            nbr_edge=pad_full(b.nbr_edge, b.num_edges - 1),
+            nbr_mask=zero(b.nbr_mask))
+
+    def _stack_shards(self, shards: List[Optional[GraphBatch]],
+                      bucket: PackBudget) -> GraphBatch:
+        from ..datasets.loader import _stack_batches
+        filled = [s if s is not None else self._empty_shard(bucket)
+                  for s in shards]
+        return _stack_batches(filled)
+
+    def _get_compiled(self, bucket: PackBudget, proto_batch: GraphBatch):
+        with self._lock:
+            hit = self._compiled.get(bucket)
+        if hit is not None:
+            return hit
+        compiled = self._jit_forward.lower(self._variables,
+                                           proto_batch).compile()
+        with self._lock:
+            hit = self._compiled.setdefault(bucket, compiled)
+            if hit is compiled:
+                self.compile_count += 1
+        return hit
+
+    def _forward_requests(self, shards: List[List[_Request]],
+                          bucket: PackBudget) -> List[np.ndarray]:
+        if self.num_shards > 1:
+            parts = [self._collate_bucket([r.sample for r in sh], bucket)
+                     if sh else None for sh in shards]
+            batch = self._stack_shards(parts, bucket)
+        else:
+            batch = self._collate_bucket([r.sample for r in shards[0]],
+                                         bucket)
+        compiled = self._get_compiled(bucket, batch)
+        outs = compiled(self._variables, batch)
+        return [np.asarray(o) for o in outs]
+
+    def _unpad(self, shards: List[List[_Request]], bucket: PackBudget,
+               outs: List[np.ndarray]) -> List[List[np.ndarray]]:
+        """Slice each request's rows back out of the padded head outputs,
+        in arrival order (shard fill is contiguous, so shard-major IS
+        arrival order).
+
+        Single-shard: request i sits at graph slot i, its nodes at the
+        running node offset. SPMD: outputs are device-major concatenated,
+        so shard s's slots start at s * n_graph (graphs) / s * n_node
+        (nodes)."""
+        results: List[List[np.ndarray]] = []
+        for s, shard in enumerate(shards):
+            g0 = s * bucket.n_graph
+            no = s * bucket.n_node
+            for i, req in enumerate(shard):
+                per_head = []
+                for ih, head in enumerate(self.mcfg.heads):
+                    if head.head_type == "graph":
+                        per_head.append(outs[ih][g0 + i])
+                    else:
+                        per_head.append(outs[ih][no:no + req.n])
+                results.append(per_head)
+                no += req.n
+        return results
+
+    def _execute(self, shards: List[List[_Request]]):
+        reqs = [r for sh in shards for r in sh]
+        try:
+            count = max(len(sh) for sh in shards)
+            need_n = max(sum(r.n for r in sh) for sh in shards)
+            need_e = max(sum(r.e for r in sh) for sh in shards)
+            bucket = select_bucket(self.buckets, count, need_n, need_e)
+            assert bucket is not None, (count, need_n, need_e)
+            outs = self._forward_requests(shards, bucket)
+            results = self._unpad(shards, bucket, outs)
+            done = time.perf_counter()
+            tot_n = sum(r.n for r in reqs)
+            tot_e = sum(r.e for r in reqs)
+            with self._lock:
+                self.batches_run += 1
+                self.requests_done += len(reqs)
+                self._occupancy_sum += len(reqs) / (bucket.cap_graphs *
+                                                    self.num_shards)
+                self._real_node_slots += tot_n
+                self._real_edge_slots += tot_e
+                self._total_node_slots += bucket.n_node * self.num_shards
+                self._total_edge_slots += bucket.n_edge * self.num_shards
+                self._latencies.extend(done - r.t_submit for r in reqs)
+            for req, res in zip(reqs, results):
+                req.future.bucket = bucket  # adjudication breadcrumb
+                req.future.set_result(res)
+        except BaseException as e:  # noqa: BLE001 — must reach the callers
+            for req in reqs:
+                if not req.future.done():
+                    req.future.set_exception(e)
+
+    def _coalesce(self, first: _Request, wait: bool = True):
+        """Greedy arrival-order coalescing into per-shard bins: the
+        current shard grows while the next request fits the LARGEST
+        bucket's per-shard node/edge budget and per-shard graph capacity,
+        then the next shard opens; the batch flushes at max_batch_size
+        total requests, when every shard is full, or max_wait_ms after
+        `first` was dequeued — whichever first. Returns
+        (shards, leftover_or_sentinel)."""
+        big = self.buckets[-1]
+        shards: List[List[_Request]] = [[first]]
+        rem_n = big.cap_nodes - first.n
+        rem_e = big.cap_edges - first.e
+        total = 1
+        deadline = time.perf_counter() + (self.max_wait_s if wait else 0.0)
+        leftover = None
+        while total < self.max_batch_size:
+            timeout = deadline - time.perf_counter()
+            try:
+                nxt = (self._queue.get_nowait() if timeout <= 0
+                       else self._queue.get(timeout=timeout))
+            except queue.Empty:
+                break
+            if nxt is _SHUTDOWN:
+                leftover = nxt
+                break
+            if (nxt.n > rem_n or nxt.e > rem_e
+                    or len(shards[-1]) >= self._shard_fill_cap):
+                if len(shards) >= self.num_shards:
+                    leftover = nxt
+                    break
+                shards.append([])
+                rem_n, rem_e = big.cap_nodes, big.cap_edges
+            shards[-1].append(nxt)
+            rem_n -= nxt.n
+            rem_e -= nxt.e
+            total += 1
+        while len(shards) < self.num_shards:
+            shards.append([])
+        return shards, leftover
+
+    def _loop(self):
+        pending = None
+        try:
+            while True:
+                if pending is None:
+                    req = self._queue.get()
+                else:
+                    req, pending = pending, None
+                if req is _SHUTDOWN:
+                    break
+                shards, pending = self._coalesce(req)
+                self._execute(shards)
+                if pending is _SHUTDOWN:
+                    break
+        except BaseException as e:  # noqa: BLE001
+            self._fatal = e
+        finally:
+            # drain everything still queued — a shutdown (or dispatcher
+            # crash) must never leave a caller's future hanging
+            while True:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if req is _SHUTDOWN:
+                    continue
+                if self._fatal is not None:
+                    if not req.future.done():
+                        req.future.set_exception(self._fatal)
+                else:
+                    shards, leftover = self._coalesce(req, wait=False)
+                    self._execute(shards)
+                    if leftover is not None and leftover is not _SHUTDOWN:
+                        self._queue.put(leftover)

@@ -49,6 +49,32 @@ def env_int(name: str, default=None):
     return int(val)
 
 
+def _env_strict_number(name: str, default, conv, kind: str):
+    val = os.getenv(name)
+    if val is None or not val.strip():
+        return default
+    try:
+        return conv(val.strip())
+    except ValueError:
+        import logging
+        logging.getLogger("hydragnn_tpu").warning(
+            "%s=%r is not %s; treating as %r", name, val, kind, default)
+        return default
+
+
+def env_strict_int(name: str, default=None):
+    """Integer env knob that warns and falls back to `default` on an
+    unparseable value instead of raising mid-startup — the numeric
+    counterpart of `env_strict_flag` for serving/packing knobs that must
+    never take effect from a typo."""
+    return _env_strict_number(name, default, int, "an integer")
+
+
+def env_strict_float(name: str, default=None):
+    """Float counterpart of `env_strict_int`."""
+    return _env_strict_number(name, default, float, "a number")
+
+
 def resolve_packing(train_cfg) -> bool:
     """Budget-packed batching knob (docs/packing.md): the HYDRAGNN_PACKING
     env overrides Training.batch_packing (default off). Strict parsing —

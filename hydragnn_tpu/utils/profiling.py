@@ -158,6 +158,22 @@ class HostStallMonitor:
         return self.wait_s / total if total > 0 else 0.0
 
 
+def latency_percentiles(latencies_s, percentiles=(50, 95, 99)) -> Dict[str, float]:
+    """Tail-latency summary: {"p50_ms", "p95_ms", "p99_ms", "mean_ms"}
+    from per-request latencies in SECONDS (empty input -> {}). The one
+    percentile formatter shared by the serving engine
+    (serving/engine.stats) and BENCH_SERVE so the reported fields cannot
+    drift between the two."""
+    import numpy as np
+    lat = np.asarray(list(latencies_s), np.float64)
+    if lat.size == 0:
+        return {}
+    out = {f"p{int(q)}_ms": float(np.percentile(lat, q) * 1e3)
+           for q in percentiles}
+    out["mean_ms"] = float(lat.mean() * 1e3)
+    return out
+
+
 def jit_cache_size(fn) -> Optional[int]:
     """Number of compiled programs a jitted callable currently holds
     (jax 0.4.x PjitFunction `_cache_size`); None when `fn` is not a

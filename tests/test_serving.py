@@ -1,0 +1,402 @@
+"""Batched inference serving engine (hydragnn_tpu/serving/, docs/serving.md).
+
+Contract under test:
+* batched outputs are BITWISE-identical to the single-request forward on
+  the same bucket (the tentpole's numerics guarantee),
+* the bucket ladder and bucket selection are pure deterministic functions,
+* a lone request flushes after max_wait_ms (no starvation),
+* per-request failures reach the owning future — callers never hang,
+* shutdown drains queued requests cleanly,
+* the engine path through run_prediction matches the legacy loop,
+* serving knobs resolve config/env precedence with strict parsing.
+"""
+import copy
+import json
+import os
+import subprocess
+import sys
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.config import build_model_config, update_config
+from hydragnn_tpu.graphs.batch import GraphSample, collate
+from hydragnn_tpu.models.create import create_model, init_params
+from hydragnn_tpu.serving.config import ServingConfig, resolve_serving
+from hydragnn_tpu.serving.engine import (InferenceEngine, _Request,
+                                         bucket_ladder, select_bucket)
+
+from tests.deterministic_data import deterministic_graph_dataset
+from tests.utils import make_config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def served():
+    samples = deterministic_graph_dataset(num_configs=48,
+                                          heads=("graph", "node"))
+    cfg = make_config("PNA", heads=("graph", "node"))
+    cfg = update_config(cfg, samples)
+    mcfg = build_model_config(cfg)
+    model = create_model(mcfg)
+    variables = init_params(model, collate(samples[:4]))
+    return samples, cfg, mcfg, model, variables
+
+
+@pytest.fixture(scope="module")
+def engine(served):
+    samples, _, mcfg, model, variables = served
+    eng = InferenceEngine(model, variables, mcfg,
+                          reference_samples=samples, max_batch_size=8,
+                          max_wait_ms=50.0, neighbor_format=True)
+    eng.warmup()
+    yield eng
+    eng.shutdown()
+
+
+# ------------------------------------------------------------- bucket ladder
+
+def test_bucket_ladder_deterministic_and_monotone(served):
+    samples, _, _, _, _ = served
+    from hydragnn_tpu.graphs.packing import sample_sizes
+    nodes, edges = sample_sizes(samples)
+    a = bucket_ladder(nodes, edges, 16)
+    b = bucket_ladder(nodes, edges, 16)
+    assert a == b, "ladder must be a pure function of the histogram"
+    shapes = [(x.n_node, x.n_edge) for x in a]
+    assert shapes == sorted(shapes)
+    assert len(a) <= 5  # {1, 2, 4, 8, 16} minus dedup
+    # every single sample fits the smallest bucket
+    assert max(nodes) <= a[0].cap_nodes
+    assert max(edges) <= a[0].cap_edges
+    # num_buckets keeps the largest capacities
+    short = bucket_ladder(nodes, edges, 16, num_buckets=2)
+    assert len(short) <= 2
+    assert (short[-1].n_node, short[-1].n_edge) == shapes[-1]
+
+
+def test_select_bucket_first_fit(served):
+    samples, _, _, _, _ = served
+    from hydragnn_tpu.graphs.packing import sample_sizes
+    nodes, edges = sample_sizes(samples)
+    ladder = bucket_ladder(nodes, edges, 16)
+    for count, tn, te in ((1, 4, 10), (3, 40, 200), (16, 300, 1500)):
+        got = select_bucket(ladder, count, tn, te)
+        if got is not None:
+            # smallest fitting: every smaller ladder entry must NOT fit
+            for b in ladder:
+                if b is got:
+                    break
+                assert (count > b.cap_graphs or tn > b.cap_nodes
+                        or te > b.cap_edges)
+    assert select_bucket(ladder, 1, 10 ** 9, 1) is None
+
+
+def test_coalesce_deterministic_bucket_selection(served):
+    """Same request stream -> same per-shard bins -> same bucket, across
+    two independent engines (threads out of the picture: the dispatcher
+    is stopped and _coalesce is driven directly)."""
+    samples, _, mcfg, model, variables = served
+
+    def plan(eng):
+        eng.shutdown()
+        reqs = [_Request(s, Future()) for s in samples]
+        for r in reqs[1:]:
+            eng._queue.put(r)
+        plans = []
+        first = reqs[0]
+        while True:
+            shards, leftover = eng._coalesce(first, wait=False)
+            count = max(len(sh) for sh in shards)
+            need_n = max(sum(r.n for r in sh) for sh in shards)
+            need_e = max(sum(r.e for r in sh) for sh in shards)
+            bucket = select_bucket(eng.buckets, count, need_n, need_e)
+            plans.append(([[id(r.sample) for r in sh] for sh in shards],
+                          (bucket.n_node, bucket.n_edge, bucket.n_graph)))
+            if leftover is None:
+                break
+            first = leftover
+        return plans
+
+    mk = lambda: InferenceEngine(model, variables, mcfg,
+                                 reference_samples=samples,
+                                 max_batch_size=8, neighbor_format=True)
+    assert plan(mk()) == plan(mk())
+
+
+# ----------------------------------------------------------------- numerics
+
+def test_bitwise_parity_with_single_request_forward(served, engine):
+    """The tentpole contract: every request's batched output equals the
+    single-request forward on the bucket its batch ran on, bit for bit."""
+    samples, _, _, _, _ = served
+    futs = [engine.submit(s) for s in samples]
+    results = [f.result(timeout=120) for f in futs]
+    assert engine.compile_count <= len(engine.buckets)
+    for s, f, res in zip(samples, futs, results):
+        ref = engine.forward_single(s, bucket=f.bucket)
+        for a, b in zip(res, ref):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_resubmission_bitwise_deterministic(served, engine):
+    samples, _, _, _, _ = served
+    r1 = engine.predict(samples[:16], timeout=120)
+    r2 = engine.predict(samples[:16], timeout=120)
+    for a, b in zip(r1, r2):
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+
+def test_unpad_shapes(served, engine):
+    samples, _, mcfg, _, _ = served
+    res = engine.predict(samples[:3], timeout=120)
+    for s, r in zip(samples[:3], res):
+        assert len(r) == len(mcfg.heads)
+        for ih, head in enumerate(mcfg.heads):
+            if head.head_type == "graph":
+                assert r[ih].shape == (head.output_dim,)
+            else:
+                assert r[ih].shape == (s.num_nodes, head.output_dim)
+
+
+def test_spmd_serving_matches_single_shard(served, engine):
+    """num_shards=2: per-shard sub-batches on one bucket through the SPMD
+    forward, outputs unpadded device-major — numerics match the
+    single-shard engine. Also exercises the empty-shard path (1 request
+    over 2 shards)."""
+    samples, _, mcfg, model, variables = served
+    eng2 = InferenceEngine(model, variables, mcfg,
+                           reference_samples=samples, max_batch_size=8,
+                           max_wait_ms=50.0, num_shards=2,
+                           neighbor_format=True)
+    try:
+        for batch in ([samples[0]], samples[:7]):
+            res2 = eng2.predict(batch, timeout=120)
+            for s, r2 in zip(batch, res2):
+                ref = engine.forward_single(s)
+                for a, b in zip(r2, ref):
+                    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                               rtol=2e-6, atol=2e-6)
+    finally:
+        eng2.shutdown()
+
+
+# ------------------------------------------------------------------ batching
+
+def test_max_wait_flushes_partial_batch(served):
+    samples, _, mcfg, model, variables = served
+    eng = InferenceEngine(model, variables, mcfg,
+                          reference_samples=samples, max_batch_size=64,
+                          max_wait_ms=60.0, neighbor_format=True)
+    try:
+        t0 = time.perf_counter()
+        futs = [eng.submit(s) for s in samples[:3]]
+        for f in futs:
+            f.result(timeout=60)
+        elapsed = time.perf_counter() - t0
+        st = eng.stats()
+        assert st["requests"] == 3
+        assert st["batches"] == 1, "3 quick submits must coalesce into 1"
+        # flushed by the wait window, not by a full batch (64 never arrives)
+        assert elapsed < 60.0
+    finally:
+        eng.shutdown()
+
+
+def test_occupancy_and_padding_stats(served, engine):
+    engine.reset_stats()
+    samples, _, _, _, _ = served
+    engine.predict(samples, timeout=120)
+    st = engine.stats()
+    assert st["requests"] == len(samples)
+    assert 0.0 < st["batch_occupancy"] <= 1.0
+    assert 0.0 <= st["padding_frac_nodes"] < 1.0
+    assert st["p99_ms"] >= st["p50_ms"] >= 0.0
+    assert st["max_queue_depth"] >= 1
+    assert st["compile_count"] <= st["num_buckets"]
+
+
+def test_explicit_buckets_with_small_graph_cap(served):
+    """Regression: an explicit ladder whose largest bucket holds fewer
+    graph slots than max_batch_size must cap the coalesced shard at
+    cap_graphs — not assert in bucket selection and fail the batch."""
+    import dataclasses
+    from hydragnn_tpu.graphs.packing import sample_sizes
+    samples, _, mcfg, model, variables = served
+    nodes, edges = sample_sizes(samples)
+    ladder = bucket_ladder(nodes, edges, 16)
+    small_cap = tuple(dataclasses.replace(b, n_graph=min(b.n_graph, 5))
+                      for b in ladder)
+    eng = InferenceEngine(model, variables, mcfg, buckets=small_cap,
+                          proto_sample=samples[0], max_batch_size=16,
+                          max_wait_ms=50.0, neighbor_format=True,
+                          neighbor_k=8 * 8)
+    try:
+        res = eng.predict(samples[:10], timeout=120)
+        assert len(res) == 10
+        assert eng.stats()["batches"] >= 3  # 10 requests, <=4 per batch
+    finally:
+        eng.shutdown()
+    with pytest.raises(ValueError, match="n_graph >= 2"):
+        InferenceEngine(model, variables, mcfg,
+                        buckets=(dataclasses.replace(ladder[0], n_graph=1),),
+                        proto_sample=samples[0])
+
+
+# ------------------------------------------------------------------ failures
+
+def test_oversized_request_fails_its_future(served, engine):
+    samples, _, _, _, _ = served
+    big_n = engine.buckets[-1].cap_nodes + 8
+    n = big_n + 1
+    huge = GraphSample(x=np.ones((n, 1), np.float32),
+                       pos=np.zeros((n, 3), np.float32),
+                       senders=np.zeros((4,), np.int32),
+                       receivers=np.zeros((4,), np.int32))
+    fut = engine.submit(huge)
+    with pytest.raises(ValueError, match="largest serving bucket"):
+        fut.result(timeout=10)
+    # the engine keeps serving afterwards
+    ok = engine.submit(samples[0])
+    assert ok.result(timeout=60) is not None
+
+
+def test_schema_mismatch_fails_its_future(served, engine):
+    fut = engine.submit(GraphSample(
+        x=np.ones((4, 7), np.float32), pos=np.zeros((4, 3), np.float32),
+        senders=np.asarray([0, 1], np.int32),
+        receivers=np.asarray([1, 0], np.int32)))
+    with pytest.raises(ValueError, match="width"):
+        fut.result(timeout=10)
+
+
+def test_execute_failure_propagates_not_hangs(served):
+    samples, _, mcfg, model, variables = served
+    eng = InferenceEngine(model, variables, mcfg,
+                          reference_samples=samples, max_batch_size=4,
+                          max_wait_ms=5.0, neighbor_format=True)
+    try:
+        def boom(*a, **kw):
+            raise RuntimeError("injected forward failure")
+        eng._forward_requests = boom
+        futs = [eng.submit(s) for s in samples[:6]]
+        for f in futs:
+            with pytest.raises(RuntimeError, match="injected"):
+                f.result(timeout=30)
+    finally:
+        eng.shutdown()
+
+
+def test_clean_shutdown_drains_queued_requests(served):
+    samples, _, mcfg, model, variables = served
+    eng = InferenceEngine(model, variables, mcfg,
+                          reference_samples=samples, max_batch_size=8,
+                          max_wait_ms=200.0, neighbor_format=True)
+    futs = [eng.submit(s) for s in samples[:20]]
+    eng.shutdown(wait=True)  # queued requests must still be served
+    assert all(f.done() for f in futs), "shutdown left callers hanging"
+    for s, f in zip(samples[:20], futs):
+        res = f.result(timeout=0)
+        ref = eng.forward_single(s, bucket=f.bucket)
+        for a, b in zip(res, ref):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    with pytest.raises(RuntimeError):
+        eng.submit(samples[0])
+    eng.shutdown()  # idempotent
+
+
+# ------------------------------------------------------- run_prediction path
+
+def test_run_prediction_engine_matches_legacy(served):
+    from hydragnn_tpu.run_prediction import run_prediction
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.train_step import TrainState
+    samples, cfg, mcfg, model, variables = served
+    n = len(samples)
+    splits = (samples[:int(0.6 * n)], samples[int(0.6 * n):int(0.8 * n)],
+              samples[int(0.8 * n):])
+    state = TrainState.create(
+        variables, select_optimizer(cfg["NeuralNetwork"]["Training"]))
+    t0, p0 = run_prediction(copy.deepcopy(cfg), datasets=splits,
+                            state=state, model=model, serve=False)
+    t1, p1 = run_prediction(copy.deepcopy(cfg), datasets=splits,
+                            state=state, model=model, serve=True)
+    for a, b in zip(t0, t1):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(p0, p1):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(a, b, rtol=2e-6, atol=2e-6)
+
+
+# ------------------------------------------------------------------- config
+
+def test_resolve_serving_precedence(monkeypatch):
+    for var in ("HYDRAGNN_SERVE", "HYDRAGNN_SERVE_MAX_BATCH",
+                "HYDRAGNN_SERVE_MAX_WAIT_MS", "HYDRAGNN_SERVE_BUCKETS"):
+        monkeypatch.delenv(var, raising=False)
+    assert resolve_serving({}) == ServingConfig()
+    cfg = {"Serving": {"enabled": True, "max_batch_size": 64,
+                       "max_wait_ms": 1.5}}
+    sv = resolve_serving(cfg)
+    assert sv.enabled and sv.max_batch_size == 64 and sv.max_wait_ms == 1.5
+    monkeypatch.setenv("HYDRAGNN_SERVE", "0")
+    monkeypatch.setenv("HYDRAGNN_SERVE_MAX_BATCH", "16")
+    sv = resolve_serving(cfg)
+    assert not sv.enabled and sv.max_batch_size == 16
+
+
+def test_resolve_serving_strict_parsing(monkeypatch, caplog):
+    """Typo values warn and fall back — never silently enable (the
+    HYDRAGNN_PALLAS_NBR lesson)."""
+    import logging
+    monkeypatch.setenv("HYDRAGNN_SERVE", "ture")  # typo
+    monkeypatch.setenv("HYDRAGNN_SERVE_MAX_BATCH", "thirty-two")
+    with caplog.at_level(logging.WARNING, logger="hydragnn_tpu"):
+        sv = resolve_serving({})
+    assert sv.enabled is False
+    assert sv.max_batch_size == 32
+    assert sum("HYDRAGNN_SERVE" in r.message for r in caplog.records) >= 2
+
+
+def test_env_strict_number_helpers(monkeypatch, caplog):
+    import logging
+    from hydragnn_tpu.utils.envflags import env_strict_float, env_strict_int
+    monkeypatch.setenv("HYDRAGNN_TEST_INT", "12")
+    monkeypatch.setenv("HYDRAGNN_TEST_FLOAT", "2.5")
+    assert env_strict_int("HYDRAGNN_TEST_INT", 1) == 12
+    assert env_strict_float("HYDRAGNN_TEST_FLOAT", 1.0) == 2.5
+    monkeypatch.setenv("HYDRAGNN_TEST_INT", "oops")
+    with caplog.at_level(logging.WARNING, logger="hydragnn_tpu"):
+        assert env_strict_int("HYDRAGNN_TEST_INT", 7) == 7
+    assert any("HYDRAGNN_TEST_INT" in r.message for r in caplog.records)
+    assert env_strict_int("HYDRAGNN_TEST_UNSET_XYZ", None) is None
+
+
+# ------------------------------------------------------- slow-lane load smoke
+
+@pytest.mark.slow
+def test_bench_serve_load_smoke():
+    """BENCH_SERVE end-to-end in a subprocess at CI scale: emits the
+    BENCH_SERVE.json artifact, bounds the compile count by the bucket
+    ladder, requires bitwise same-bucket parity, and guards a (loose —
+    wall-clock on a shared CI box) speedup floor."""
+    out_path = os.path.join(REPO, "BENCH_SERVE.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_SERVE="1",
+               BENCH_SERVE_REQUESTS="64", BENCH_BATCH="16",
+               BENCH_HIDDEN="32", BENCH_SERVE_VERIFY="8",
+               BENCH_SERVE_OUT=out_path, BENCH_WAIT_TUNNEL_S="0")
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       env=env, capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert os.path.exists(out_path)
+    assert out["compile_count"] <= len(out["buckets"])
+    assert out["outputs_bitwise_equal_same_bucket"] is True
+    assert out["open_loop"]["p99_ms"] >= out["open_loop"]["p50_ms"]
+    # the CPU acceptance target is 3x (ISSUE 3); the CI guard is looser
+    # to keep a busy shared box from flaking the lane
+    assert out["speedup_vs_per_request"] >= 1.5, out

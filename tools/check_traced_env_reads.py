@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Lint: traced model/step/ops modules must not read os.environ directly.
+
+An env read inside code that jax traces (model forward, loss/step bodies,
+ops/kernels) is resolved once at trace time and frozen into the compiled
+program — toggling the variable afterwards silently does nothing, and a
+loosely-parsed value can flip an experimental kernel on from a typo. This
+class of bug has now shipped twice (HYDRAGNN_PALLAS_NBR read at trace time
+in convs.py, r5 advisor; HYDRAGNN_USE_PALLAS loose-truthy in ops/segment.py,
+PR 3), so the rule is structural: env reads belong in utils/envflags.py
+helpers, resolved at construction time and passed in as plain values.
+
+Checked (AST, so comments/strings never trip it):
+* any `os.environ` attribute use (covers .get, [], `in`),
+* any `os.getenv(...)` call,
+* `from os import environ` / `from os import getenv`.
+
+Run: `python tools/check_traced_env_reads.py [repo_root]` — exits 1 and
+prints `file:line` for each violation. tests/test_env_lint.py runs the
+same check in tier-1, so a regression fails CI, not a code review.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Tuple
+
+# the traced surface: modules whose function bodies run under jax.jit /
+# grad tracing. Host-side drivers (trainer, loaders, run_*) legitimately
+# read env at startup and are NOT covered.
+TRACED_DIRS = (
+    os.path.join("hydragnn_tpu", "models"),
+    os.path.join("hydragnn_tpu", "ops"),
+    os.path.join("hydragnn_tpu", "kernels"),
+)
+TRACED_FILES = (
+    os.path.join("hydragnn_tpu", "train", "train_step.py"),
+    os.path.join("hydragnn_tpu", "train", "loss.py"),
+)
+
+
+def find_env_reads(source: str, filename: str = "<str>"
+                   ) -> List[Tuple[str, int, str]]:
+    """(file, lineno, what) for every direct env read in `source`."""
+    out: List[Tuple[str, int, str]] = []
+    tree = ast.parse(source, filename=filename)
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "os"
+                and node.attr in ("environ", "getenv")):
+            out.append((filename, node.lineno, f"os.{node.attr}"))
+        elif isinstance(node, ast.ImportFrom) and node.module == "os":
+            for alias in node.names:
+                if alias.name in ("environ", "getenv"):
+                    out.append((filename, node.lineno,
+                                f"from os import {alias.name}"))
+    return out
+
+
+def traced_module_paths(root: str) -> List[str]:
+    paths: List[str] = []
+    for d in TRACED_DIRS:
+        full = os.path.join(root, d)
+        for dirpath, _, names in os.walk(full):
+            paths.extend(os.path.join(dirpath, n) for n in sorted(names)
+                         if n.endswith(".py"))
+    paths.extend(os.path.join(root, f) for f in TRACED_FILES)
+    return [p for p in paths if os.path.exists(p)]
+
+
+def check(root: str) -> List[Tuple[str, int, str]]:
+    violations: List[Tuple[str, int, str]] = []
+    for path in traced_module_paths(root):
+        with open(path) as f:
+            rel = os.path.relpath(path, root)
+            violations.extend(find_env_reads(f.read(), rel))
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    violations = check(root)
+    for fname, line, what in violations:
+        print(f"{fname}:{line}: {what} read inside a traced module — "
+              "resolve it via utils/envflags.py at construction time")
+    if violations:
+        return 1
+    print(f"ok: no direct env reads in {len(traced_module_paths(root))} "
+          "traced modules")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
