@@ -72,6 +72,20 @@ Env knobs:
                        non-batching server cannot sustain)
   BENCH_SERVE_OUT      also write the serving JSON to this path (the
                        slow-lane smoke emits BENCH_SERVE.json)
+  BENCH_FAULTS         =1: chaos mode (docs/fault_tolerance.md) — run the
+                       fault-tolerance adjudications end-to-end: a
+                       training run killed at an injected forward-step
+                       fault and resumed must reproduce the
+                       uninterrupted loss trajectory bitwise
+                       (recovered-step fraction reported), and a serving
+                       run under injected dispatch faults + admission
+                       bounds + deadlines must leave ZERO futures
+                       unresolved (no-lost-futures)
+  BENCH_FAULTS_EPOCHS / BENCH_FAULTS_KILL_STEP / BENCH_FAULTS_REQUESTS
+                       chaos-mode scale (default 4 epochs, kill at step
+                       5, 64 serving requests)
+  BENCH_FAULTS_OUT     also write the chaos JSON to this path (the
+                       nightly chaos-smoke emits BENCH_FAULTS.json)
 """
 import itertools
 import json
@@ -564,11 +578,19 @@ def run_bench_serve(backend=None):
     use_nbr = os.environ.get("BENCH_NBR", "1") != "0"
 
     variables = init_params(model, collate(samples[:4]))
+    # the failure-semantics knobs (docs/fault_tolerance.md) apply to
+    # live-traffic engines — this open/closed-loop harness is exactly
+    # that, so the Serving/HYDRAGNN_SERVE_* values take effect here
+    # (defaults: unbounded queue, no deadline, breaker 5/30s)
     engine = InferenceEngine(
         model, variables, mcfg, reference_samples=samples,
         max_batch_size=BATCH_GRAPHS, max_wait_ms=wait_ms,
         num_buckets=serving.num_buckets, neighbor_format=use_nbr,
-        compute_dtype=compute_dtype)
+        compute_dtype=compute_dtype,
+        max_queue=serving.max_queue,
+        default_deadline_ms=serving.deadline_ms or None,
+        breaker_threshold=serving.breaker_threshold,
+        breaker_reset_s=serving.breaker_reset_s)
     engine.warmup()
     compiles_after_warmup = engine.compile_count
 
@@ -684,6 +706,176 @@ def run_bench_serve(backend=None):
     return out
 
 
+def run_bench_faults(backend=None):
+    """BENCH_FAULTS: chaos adjudication (docs/fault_tolerance.md).
+
+    Training: an uninterrupted reference run, a run killed at an injected
+    forward-step fault, and a resume of the killed run — the resumed loss
+    trajectory must equal the reference BITWISE, and the recovered-step
+    fraction (checkpointed steps over steps executed before the kill)
+    quantifies how much work the periodic checkpoint cadence preserves.
+
+    Serving: a request stream through an engine with injected dispatch
+    faults, a bounded admission queue, deadlines, and the circuit breaker
+    — every accepted future must resolve (no-lost-futures), fast-fail
+    rejections are counted separately."""
+    import shutil
+    import tempfile
+    from concurrent.futures import TimeoutError as FutTimeout
+
+    from hydragnn_tpu.config import get_log_name_config
+    from hydragnn_tpu.graphs.batch import collate
+    from hydragnn_tpu.models.create import init_params
+    from hydragnn_tpu.preprocess.load_data import split_dataset
+    from hydragnn_tpu.run_training import run_training
+    from hydragnn_tpu.serving.engine import (CircuitOpenError,
+                                             InferenceEngine,
+                                             QueueFullError)
+    from hydragnn_tpu.utils.faults import (InjectedFault,
+                                           install_fault_plan,
+                                           parse_fault_plan)
+    from tests.deterministic_data import deterministic_graph_dataset
+    from tests.utils import make_config
+
+    if backend is None:
+        backend = _resolve_backend_and_cache()
+    num_epoch = int(os.environ.get("BENCH_FAULTS_EPOCHS", "4"))
+    kill_step = int(os.environ.get("BENCH_FAULTS_KILL_STEP", "5"))
+    n_req = int(os.environ.get("BENCH_FAULTS_REQUESTS", "64"))
+
+    def train_cfg(fault_plan=None, cont=False):
+        c = make_config("GIN")
+        t = c["NeuralNetwork"]["Training"]
+        t["num_epoch"] = num_epoch
+        t["batch_size"] = 8
+        t["EarlyStopping"] = False
+        t["Checkpoint"] = True
+        t["checkpoint_every_n_epochs"] = 1
+        t["keep_best"] = False
+        if fault_plan:
+            t["fault_plan"] = fault_plan
+        if cont:
+            t["continue"] = 1
+        return c
+
+    samples = deterministic_graph_dataset(num_configs=24)
+    splits = split_dataset(samples, 0.7)
+    traj = lambda h: {k: h[k] for k in ("train_loss", "val_loss",
+                                        "test_loss", "lr")}
+    work = tempfile.mkdtemp(prefix="bench_faults_")
+    cwd = os.getcwd()
+    try:
+        ref_dir = os.path.join(work, "ref")
+        chaos_dir = os.path.join(work, "chaos")
+        os.makedirs(ref_dir)
+        os.makedirs(chaos_dir)
+        os.chdir(ref_dir)
+        _, h_ref, _, completed = run_training(train_cfg(), datasets=splits,
+                                              num_shards=1)
+        log_name = get_log_name_config(completed)
+
+        os.chdir(chaos_dir)
+        killed = False
+        try:
+            run_training(train_cfg(fault_plan=f"forward-step@{kill_step}"),
+                         datasets=splits, num_shards=1)
+        except InjectedFault:
+            killed = True
+        ckpt_d = os.path.join(chaos_dir, "logs", log_name, "checkpoint")
+        latest_marker = os.path.join(ckpt_d, "LATEST")
+        # a kill before the first periodic save leaves no checkpoint
+        # (BENCH_FAULTS_KILL_STEP below one epoch): adjudicate honestly —
+        # recovered 0 steps, restart from scratch instead of crashing
+        if os.path.exists(latest_marker):
+            with open(latest_marker) as f:
+                latest = os.path.join(ckpt_d, f.read().strip())
+            with open(os.path.join(latest, "resume.json")) as f:
+                recovered_step = int(json.load(f)["step"])
+            resume_cfg = train_cfg(cont=True)
+        else:
+            recovered_step = 0
+            resume_cfg = train_cfg()
+        state2, h_res, _, _ = run_training(resume_cfg, datasets=splits,
+                                           num_shards=1)
+        bitwise = traj(h_res) == traj(h_ref)
+    finally:
+        os.chdir(cwd)
+        shutil.rmtree(work, ignore_errors=True)
+
+    # serving chaos: injected dispatch faults + bounded queue + deadlines
+    # + breaker; the contract is zero unresolved futures
+    rng = np.random.RandomState(0)
+    serve_samples = synth_samples(n_req, rng, (8, 40))
+    _, mcfg, model, _, _, compute_dtype = _bench_model(serve_samples)
+    variables = init_params(model, collate(serve_samples[:4]))
+    install_fault_plan(parse_fault_plan("serving-dispatch@1,3,5"))
+    eng = InferenceEngine(
+        model, variables, mcfg, reference_samples=serve_samples,
+        max_batch_size=8, max_wait_ms=1.0, max_queue=max(n_req // 2, 8),
+        default_deadline_ms=60000.0, breaker_threshold=4,
+        breaker_reset_s=0.2,
+        neighbor_format=os.environ.get("BENCH_NBR", "1") != "0",
+        compute_dtype=compute_dtype)
+    futs, rejected = [], 0
+    try:
+        for s in serve_samples:
+            try:
+                futs.append(eng.submit(s))
+            except (QueueFullError, CircuitOpenError):
+                rejected += 1
+        ok = errored = unresolved = 0
+        for f in futs:
+            try:
+                exc = f.exception(timeout=120)
+            except FutTimeout:
+                unresolved += 1
+                continue
+            if exc is None:
+                ok += 1
+            else:
+                errored += 1
+        health = eng.health()
+    finally:
+        eng.shutdown()
+        install_fault_plan(None)
+
+    recovered_frac = recovered_step / kill_step if kill_step else 0.0
+    passed = killed and bitwise and unresolved == 0
+    out = {
+        "metric": "fault_recovery_chaos",
+        "value": 1.0 if passed else 0.0,
+        "unit": "pass",
+        "vs_baseline": None,
+        "backend": backend,
+        "training": {
+            "epochs": num_epoch,
+            "killed": killed,
+            "killed_at_step": kill_step,
+            "recovered_step": recovered_step,
+            "recovered_step_fraction": round(recovered_frac, 4),
+            "trajectory_bitwise_equal": bitwise,
+            "final_step": int(state2.step),
+        },
+        "serving": {
+            "requests": n_req,
+            "accepted": len(futs),
+            "rejected_fast_fail": rejected,
+            "resolved_ok": ok,
+            "resolved_error": errored,
+            "unresolved": unresolved,
+            "no_lost_futures": unresolved == 0,
+            "batch_failures": health["batch_failures"],
+            "breaker_trips": health["trip_count"],
+            "deadline_expired": health["deadline_expired"],
+        },
+    }
+    out_path = os.environ.get("BENCH_FAULTS_OUT", "").strip()
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1)
+    return out
+
+
 def sweep():
     """Run the (nbr-layout x pallas x steps-per-call) grid, each point in a
     fresh subprocess (the flags are read once per process), and report the
@@ -726,6 +918,8 @@ def main():
         out = sweep()
     elif os.environ.get("BENCH_SERVE") == "1":
         out = run_bench_serve()
+    elif os.environ.get("BENCH_FAULTS") == "1":
+        out = run_bench_faults()
     else:
         out = run_bench()
     print(json.dumps(out))

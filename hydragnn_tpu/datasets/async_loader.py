@@ -84,6 +84,44 @@ def resolve_cache_bytes(override_mb: Optional[int] = None) -> int:
     return max(int(mb), 0) * (1 << 20)
 
 
+def fetch_samples(dataset, indices, what: str = "dataset") -> list:
+    """Fetch `dataset[i]` for each index with bounded-backoff retry over
+    transient I/O (docs/fault_tolerance.md).
+
+    File/socket-backed datasets (GraphStore, DDStore, network filesystems)
+    throw OSErrors under exactly the flaky-filesystem conditions long
+    campaigns hit; one transient hiccup must not kill an epoch. Retries are
+    bounded (HYDRAGNN_LOADER_RETRIES total attempts, exponential backoff
+    from HYDRAGNN_LOADER_RETRY_BACKOFF_S capped at 1s) so a genuinely dead
+    path still surfaces promptly. The ``loader-fetch`` fault site
+    (utils/faults.py) fires once per ATTEMPT, so a single injected index
+    is recovered by the retry while `attempts` consecutive indices exhaust
+    it — both paths deterministic under test."""
+    from ..utils.envflags import resolve_loader_retries
+    from ..utils.faults import fault_point
+    attempts, backoff = resolve_loader_retries()
+    out = []
+    for i in indices:
+        for attempt in range(attempts):
+            try:
+                fault_point("loader-fetch")
+                out.append(dataset[i])
+                break
+            except OSError as exc:
+                if attempt + 1 >= attempts:
+                    raise
+                import logging
+                import time as _time
+                delay = min(backoff * (2 ** attempt), 1.0)
+                logging.getLogger("hydragnn_tpu").warning(
+                    "transient fetch failure for %s[%s] (%s: %s); "
+                    "retry %d/%d after %.3fs", what, i,
+                    type(exc).__name__, exc, attempt + 1, attempts - 1,
+                    delay)
+                _time.sleep(delay)
+    return out
+
+
 def _batch_nbytes(batch) -> int:
     import dataclasses
     total = 0
@@ -206,7 +244,7 @@ def iterate_async(loader, selections: Sequence[Tuple[int, ...]],
             # the loader so the fetch order matches _build_batch_from_samples
             flat = getattr(loader, "_flat_indices", None)
             idx = flat(sel) if flat is not None else sel
-            samples = [loader.dataset[i] for i in idx]
+            samples = fetch_samples(loader.dataset, idx)
             fut = ex.submit(loader._build_batch_from_samples, sel, samples)
         pending.append((sel, fut, None))
 

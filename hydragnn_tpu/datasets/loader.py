@@ -261,8 +261,11 @@ class GraphDataLoader:
                 for ib in range(len(self))]
 
     def _build_batch(self, sel: Tuple[int, ...]) -> GraphBatch:
+        # sample fetch goes through the bounded-backoff transient-I/O
+        # retry (and the loader-fetch fault site) — docs/fault_tolerance.md
+        from .async_loader import fetch_samples
         return self._build_batch_from_samples(
-            sel, [self.dataset[i] for i in self._flat_indices(sel)])
+            sel, fetch_samples(self.dataset, self._flat_indices(sel)))
 
     def _build_batch_from_samples(self, sel, samples) -> GraphBatch:
         if self.packing:

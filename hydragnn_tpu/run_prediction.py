@@ -209,7 +209,15 @@ def _predict_with_engine(model, state, mcfg, testset, serving, num_shards,
         num_buckets=serving.num_buckets,
         bucket_multiple=serving.bucket_multiple,
         num_shards=num_shards if num_shards and num_shards > 1 else 1,
-        neighbor_format=neighbor_format, neighbor_k=neighbor_k)
+        neighbor_format=neighbor_format, neighbor_k=neighbor_k,
+        # the failure-semantics knobs (max_queue/deadline_ms/breaker_*)
+        # deliberately stay at their permissive defaults here: this is the
+        # OFFLINE batch-predict path, which submits the whole testset at
+        # once — an online admission bound or deadline tuned for a
+        # deployment would fast-fail/expire a perfectly good prediction
+        # run (docs/fault_tolerance.md). They apply to engines serving
+        # live traffic via the InferenceEngine API.
+        breaker_threshold=0)
     try:
         engine.warmup()
         results = engine.predict(testset)

@@ -97,6 +97,15 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     from .utils.devices import (enable_compile_cache,
                                 resolve_compile_cache_dir)
     enable_compile_cache(resolve_compile_cache_dir())
+    # deterministic fault injection (docs/fault_tolerance.md): the plan —
+    # HYDRAGNN_FAULT_PLAN env over Training.fault_plan, strict parsing —
+    # is installed per run so site counters start fresh; a stale
+    # preemption flag from an earlier run in this process is cleared
+    from .train.trainer import clear_preemption
+    from .utils.faults import install_fault_plan, resolve_fault_plan
+    install_fault_plan(resolve_fault_plan(
+        config.get("NeuralNetwork", {}).get("Training", {})))
+    clear_preemption()
     init_distributed()
     # TRACE_LEVEL>0 also turns on synchronous region timing (the cudasync
     # analogue: block_until_ready before closing a span — reference:
@@ -345,11 +354,14 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     # resume / transfer: Training.continue + startfrom name the run whose
     # checkpoint seeds this one (reference: load_existing_model_config,
     # utils/model/model.py:91-98, called from run_training.py:113-115)
+    start_epoch, resume_trainer = 0, None
+    best_state0, best_val0 = None, None
     if train_cfg.get("continue"):
-        from .utils.checkpoint import load_existing_model
+        from .utils.checkpoint import load_best_model, load_existing_model
         start_name = train_cfg.get("startfrom") or log_name
         try:
-            restored = load_existing_model(state, start_name)
+            restored, ckpt_meta = load_existing_model(
+                state, start_name, with_metadata=True)
         except Exception as exc:  # noqa: BLE001 — orbax raises opaque
             # tree-mismatch errors when the checkpointed optimizer state
             # doesn't match this config's (different Optimizer.type /
@@ -365,7 +377,18 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
                 f"Training.continue is set but run '{start_name}' has no "
                 "checkpoint under ./logs")
         state = restored
-        log(f"resumed from '{start_name}' at step {int(state.step)}")
+        # resume metadata (epoch/step/scheduler counters/history) only
+        # applies when continuing the SAME run: a startfrom transfer from
+        # another run seeds weights but trains from epoch 0, the
+        # reference's transfer-learning semantics
+        if ckpt_meta and start_name == log_name:
+            start_epoch = int(ckpt_meta.get("next_epoch", 0))
+            resume_trainer = ckpt_meta.get("trainer")
+            if bool(train_cfg.get("keep_best", True)):
+                best_state0, best_val0 = load_best_model(state, start_name,
+                                                         with_val=True)
+        log(f"resumed from '{start_name}' at step {int(state.step)}"
+            + (f" (epoch {start_epoch})" if start_epoch else ""))
 
     accum = int(train_cfg.get("gradient_accumulation_steps", 1) or 1)
     if accum > 1 and len(train_loader) % accum:
@@ -480,10 +503,34 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     # on filesystem writes; the final save below synchronizes. Installed on
     # ALL ranks — orbax save() is a multihost collective; gating it to rank
     # 0 deadlocked multi-process runs (checkpoint.make_async_best_checkpoint_fn)
+    keep_last_k = int(train_cfg.get("checkpoint_keep_last_k", 3) or 3)
+    ckpt_every = int(train_cfg.get("checkpoint_every_n_epochs", 0) or 0)
     ckpt_fn = None
     if train_cfg.get("Checkpoint", False):
         from .utils.checkpoint import make_async_best_checkpoint_fn
-        ckpt_fn = make_async_best_checkpoint_fn(log_name)
+        ckpt_fn = make_async_best_checkpoint_fn(log_name,
+                                                keep_last_k=keep_last_k)
+
+    # preemption-safe periodic/final saves (docs/fault_tolerance.md):
+    # synchronous, with resume metadata, serialized behind any in-flight
+    # async best-val save — both can target the same step dir and two
+    # concurrent force-writes would race
+    periodic_fn = preempt_fn = None
+    if ckpt_every or train_cfg.get("Checkpoint", False):
+        from .utils.checkpoint import wait_for_checkpoints
+
+        def _sync_checkpoint(ckpt_state, meta):
+            try:
+                wait_for_checkpoints()
+            except Exception as exc:  # noqa: BLE001 — a failed OPTIONAL
+                # best-val save must not abort the periodic save
+                import logging
+                logging.getLogger("hydragnn_tpu").warning(
+                    "async checkpoint failed: %s", exc)
+            save_model(ckpt_state, log_name, metadata=meta,
+                       keep_last_k=keep_last_k)
+
+        periodic_fn = preempt_fn = _sync_checkpoint
 
     # visualization wiring (reference: run_training.py:76-78 reads the
     # Visualization section; train_validate_test.py:100-125,264-311 builds
@@ -570,31 +617,58 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
             patience=int(pcfg.get("patience", 5)),
             min_lr=float(pcfg.get("min_lr", 1e-6)))
 
-    state, history = train_validate_test(
-        train_step, eval_step, state, train_loader, val_loader, test_loader,
-        plateau=plateau,
-        num_epochs=int(train_cfg["num_epoch"]), log_name=log_name,
-        patience=int(train_cfg.get("patience", 10)),
-        use_early_stopping=bool(train_cfg.get("EarlyStopping", False)),
-        checkpoint_warmup=int(train_cfg.get("checkpoint_warmup", 0)),
-        checkpoint_fn=ckpt_fn, verbosity=verbosity, tracer=tr.get(),
-        place_fn=place_fn, profiler=profiler, walltime_deadline=deadline,
-        multi_train_step=multi_step, steps_per_call=steps_per_call,
-        place_group_fn=place_group_fn, multi_eval_step=multi_eval,
-        keep_best=bool(train_cfg.get("keep_best", True)))
+    final_resume: dict = {}
+    # SIGTERM (the SLURM/TPU preemption signal) -> one final synchronous
+    # save at the next step boundary + clean exit. Installed HERE,
+    # adjacent to the try whose finally restores it — installing earlier
+    # would leave the flag-only handler live forever if anything between
+    # raised first.
+    if preempt_fn is not None:
+        from .train.trainer import install_sigterm_handler
+        install_sigterm_handler()
+    try:
+        state, history = train_validate_test(
+            train_step, eval_step, state, train_loader, val_loader,
+            test_loader, plateau=plateau,
+            num_epochs=int(train_cfg["num_epoch"]), log_name=log_name,
+            patience=int(train_cfg.get("patience", 10)),
+            use_early_stopping=bool(train_cfg.get("EarlyStopping", False)),
+            checkpoint_warmup=int(train_cfg.get("checkpoint_warmup", 0)),
+            checkpoint_fn=ckpt_fn, verbosity=verbosity, tracer=tr.get(),
+            place_fn=place_fn, profiler=profiler, walltime_deadline=deadline,
+            multi_train_step=multi_step, steps_per_call=steps_per_call,
+            place_group_fn=place_group_fn, multi_eval_step=multi_eval,
+            keep_best=bool(train_cfg.get("keep_best", True)),
+            start_epoch=start_epoch, resume=resume_trainer,
+            checkpoint_every_n_epochs=ckpt_every,
+            periodic_checkpoint_fn=periodic_fn, preempt_save_fn=preempt_fn,
+            initial_best_state=best_state0, initial_best_val=best_val0,
+            resume_meta_out=final_resume)
+    finally:
+        # the flag-only SIGTERM handler must not outlive the epoch loop:
+        # after training, the previous disposition (usually terminate) is
+        # the right response to a preemption signal
+        if preempt_fn is not None:
+            from .train.trainer import restore_sigterm_handler
+            restore_sigterm_handler()
 
+    from .train.trainer import preemption_requested
+    if preemption_requested():
+        # the trainer already wrote the resume point; the "run complete"
+        # final save below would overwrite LATEST with next_epoch =
+        # num_epoch and destroy resumability. Exit promptly — the SIGTERM
+        # grace window is short.
+        tr.print_timers(os.path.join("./logs", log_name))
+        return state, history, model, config
     if train_cfg.get("Checkpoint", False):
-        from .utils.checkpoint import wait_for_checkpoints
-        # drain async best-val saves first: the final state can share its
-        # step dir with an in-flight save of the same (best) state. A
-        # failed optional mid-training save must not discard the run.
-        try:
-            wait_for_checkpoints()
-        except Exception as exc:  # noqa: BLE001
-            import logging
-            logging.getLogger("hydragnn_tpu").warning(
-                "async checkpoint failed: %s", exc)
-        save_model(state, log_name)
+        # final save via the same drain-then-save closure the periodic
+        # path uses (an in-flight async best-val save can share the final
+        # state's step dir). Its metadata marks the run COMPLETE
+        # (next_epoch = num_epoch): a later Training.continue trains only
+        # if num_epoch was raised, instead of silently replaying from
+        # epoch 0 — and carries the full trainer counters so that
+        # continuation resumes the scheduler/early-stop/best-val state.
+        _sync_checkpoint(state, final_resume or None)
 
     if visualizer is not None:
         # final test-set predictions -> parity/global/error plots + history

@@ -1,11 +1,19 @@
 """Batched inference serving: request micro-batching over a bucketed
-compile cache (docs/serving.md)."""
+compile cache (docs/serving.md), with explicit failure semantics —
+bounded admission, per-request deadlines, dispatcher circuit breaker
+(docs/fault_tolerance.md)."""
 from .config import ServingConfig, resolve_serving
-from .engine import InferenceEngine, bucket_ladder, select_bucket
+from .engine import (CircuitOpenError, DeadlineExceededError,
+                     InferenceEngine, QueueFullError, ServingError,
+                     bucket_ladder, select_bucket)
 
 __all__ = [
+    "CircuitOpenError",
+    "DeadlineExceededError",
     "InferenceEngine",
+    "QueueFullError",
     "ServingConfig",
+    "ServingError",
     "bucket_ladder",
     "resolve_serving",
     "select_bucket",

@@ -10,12 +10,20 @@ lesson).
 Config schema (top-level block, alongside "Dataset"/"NeuralNetwork"):
 
     "Serving": {
-        "enabled": false,        # engine path in run_prediction
-        "max_batch_size": 32,    # requests coalesced per dispatch
-        "max_wait_ms": 5.0,      # batching window for a lone request
-        "num_buckets": 0,        # 0 = full capacity ladder
-        "bucket_multiple": 64    # shape rounding (MXU-friendly)
+        "enabled": false,          # engine path in run_prediction
+        "max_batch_size": 32,      # requests coalesced per dispatch
+        "max_wait_ms": 5.0,        # batching window for a lone request
+        "num_buckets": 0,          # 0 = full capacity ladder
+        "bucket_multiple": 64,     # shape rounding (MXU-friendly)
+        "max_queue": 0,            # bounded admission queue (0 = unbounded)
+        "deadline_ms": 0.0,        # default per-request deadline (0 = none)
+        "breaker_threshold": 5,    # consecutive batch failures to trip
+        "breaker_reset_s": 30.0    # open -> half-open probe window
     }
+
+The last four are the failure-semantics knobs (docs/fault_tolerance.md):
+QueueFullError backpressure, DeadlineExceededError expiry, and the
+dispatcher circuit breaker.
 """
 from __future__ import annotations
 
@@ -30,6 +38,10 @@ class ServingConfig:
     max_wait_ms: float = 5.0
     num_buckets: int = 0          # 0 = full ladder (1, 2, 4, ..., max)
     bucket_multiple: int = 64
+    max_queue: int = 0            # 0 = unbounded admission queue
+    deadline_ms: float = 0.0      # 0 = no default per-request deadline
+    breaker_threshold: int = 5    # 0 disables the circuit breaker
+    breaker_reset_s: float = 30.0
 
 
 def resolve_serving(config: Optional[Dict[str, Any]]) -> ServingConfig:
@@ -45,6 +57,10 @@ def resolve_serving(config: Optional[Dict[str, Any]]) -> ServingConfig:
         max_wait_ms=float(block.get("max_wait_ms", 5.0)),
         num_buckets=int(block.get("num_buckets", 0)),
         bucket_multiple=int(block.get("bucket_multiple", 64)),
+        max_queue=int(block.get("max_queue", 0)),
+        deadline_ms=float(block.get("deadline_ms", 0.0)),
+        breaker_threshold=int(block.get("breaker_threshold", 5)),
+        breaker_reset_s=float(block.get("breaker_reset_s", 30.0)),
     )
     return ServingConfig(
         enabled=env_strict_flag("HYDRAGNN_SERVE", base.enabled),
@@ -56,4 +72,12 @@ def resolve_serving(config: Optional[Dict[str, Any]]) -> ServingConfig:
                                    base.num_buckets),
         bucket_multiple=env_strict_int("HYDRAGNN_SERVE_BUCKET_MULTIPLE",
                                        base.bucket_multiple),
+        max_queue=env_strict_int("HYDRAGNN_SERVE_MAX_QUEUE",
+                                 base.max_queue),
+        deadline_ms=env_strict_float("HYDRAGNN_SERVE_DEADLINE_MS",
+                                     base.deadline_ms),
+        breaker_threshold=env_strict_int("HYDRAGNN_SERVE_BREAKER_THRESHOLD",
+                                         base.breaker_threshold),
+        breaker_reset_s=env_strict_float("HYDRAGNN_SERVE_BREAKER_RESET_S",
+                                         base.breaker_reset_s),
     )

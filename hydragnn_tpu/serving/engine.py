@@ -35,6 +35,26 @@ Multi-device serving (``num_shards > 1``) splits each coalesced batch
 into per-shard sub-batches on one bucket shape and runs the SPMD forward
 (parallel/spmd.make_spmd_forward) — the same shard_map layout training
 uses, with outputs concatenated device-major.
+
+Failure semantics (docs/fault_tolerance.md) — the engine's availability
+contract is that EVERY accepted future resolves, with a result or an
+error, under any single-batch failure:
+
+* bounded admission queue — ``max_queue`` > 0 makes ``submit`` fast-fail
+  with ``QueueFullError`` instead of queueing unboundedly behind a slow
+  dispatcher (backpressure the caller can act on);
+* per-request deadlines — ``deadline_ms`` (per submit, or the engine
+  default) resolves expired requests with ``DeadlineExceededError``; an
+  expired request never occupies a batch slot;
+* dispatcher supervision — a failed batch resolves only ITS OWN futures
+  with the error; a run of ``breaker_threshold`` consecutive batch
+  failures trips a circuit breaker to fast-fail (``CircuitOpenError``)
+  for ``breaker_reset_s``, then admits one probe batch (half-open) whose
+  outcome closes or re-opens the circuit. ``health()`` reports
+  state/queue depth/trip count for monitors;
+* the ``serving-dispatch`` fault site (utils/faults.py) fires once per
+  executed batch, so all of the above is exercised deterministically by
+  tier-1 tests and the BENCH_FAULTS chaos mode.
 """
 from __future__ import annotations
 
@@ -49,8 +69,26 @@ import numpy as np
 
 from ..graphs.batch import GraphBatch, GraphSample, collate
 from ..graphs.packing import MAX_GRAPH_SLOTS, PackBudget, choose_budget
+from ..utils.faults import fault_point
 
 _SHUTDOWN = object()
+
+
+class ServingError(RuntimeError):
+    """Base of the engine's failure-semantics errors."""
+
+
+class QueueFullError(ServingError):
+    """submit() fast-fail: the bounded admission queue is at max_queue."""
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline expired before a batch could serve it."""
+
+
+class CircuitOpenError(ServingError):
+    """The dispatcher circuit breaker is open (consecutive batch
+    failures); requests fast-fail until the probe window."""
 
 
 def bucket_ladder(nodes, edges, max_batch_size: int, num_buckets: int = 0,
@@ -97,14 +135,18 @@ def select_bucket(buckets: Sequence[PackBudget], count: int, tot_n: int,
 
 
 class _Request:
-    __slots__ = ("sample", "future", "n", "e", "t_submit")
+    __slots__ = ("sample", "future", "n", "e", "t_submit", "deadline")
 
-    def __init__(self, sample: GraphSample, future: Future):
+    def __init__(self, sample: GraphSample, future: Future,
+                 deadline_ms: Optional[float] = None):
         self.sample = sample
         self.future = future
         self.n = sample.num_nodes
         self.e = sample.num_edges
         self.t_submit = time.perf_counter()
+        # absolute expiry on the same clock as t_submit; None/0 = none
+        self.deadline = (self.t_submit + float(deadline_ms) / 1e3
+                         if deadline_ms else None)
 
 
 class InferenceEngine:
@@ -129,7 +171,11 @@ class InferenceEngine:
                  num_shards: int = 1, neighbor_format: bool = False,
                  neighbor_k: Optional[int] = None,
                  batch_transform: Optional[Callable] = None,
-                 compute_dtype: Optional[str] = None):
+                 compute_dtype: Optional[str] = None,
+                 max_queue: int = 0,
+                 default_deadline_ms: Optional[float] = None,
+                 breaker_threshold: int = 5,
+                 breaker_reset_s: float = 30.0):
         import jax
         from ..train.train_step import make_forward_fn
 
@@ -137,6 +183,13 @@ class InferenceEngine:
         self.max_batch_size = max(int(max_batch_size), 1)
         self.max_wait_s = max(float(max_wait_ms), 0.0) / 1e3
         self.num_shards = max(int(num_shards), 1)
+        # failure-semantics knobs (docs/fault_tolerance.md): 0 disables
+        # the bound / deadline / breaker respectively
+        self.max_queue = max(int(max_queue), 0)
+        self.default_deadline_ms = (float(default_deadline_ms)
+                                    if default_deadline_ms else None)
+        self.breaker_threshold = max(int(breaker_threshold), 0)
+        self.breaker_reset_s = max(float(breaker_reset_s), 0.0)
         # bucket shapes are PER SHARD; the ladder is sized for this many
         # requests per shard so num_shards * cap covers max_batch_size
         self.per_shard_cap = -(-self.max_batch_size // self.num_shards)
@@ -212,6 +265,15 @@ class InferenceEngine:
         self._total_edge_slots = 0
         self.max_queue_depth = 0
         self._latencies: List[float] = []
+        # circuit-breaker + failure accounting (all under self._lock)
+        self._breaker_state = "closed"     # closed | open | half_open
+        self._consec_failures = 0
+        self._open_until = 0.0             # time.monotonic() probe point
+        self.trip_count = 0
+        self.batch_failures = 0
+        self.deadline_expired = 0
+        self.queue_rejections = 0
+        self.circuit_rejections = 0
         self._dispatcher = threading.Thread(target=self._loop,
                                             name="serve-dispatch",
                                             daemon=True)
@@ -219,14 +281,24 @@ class InferenceEngine:
 
     # ------------------------------------------------------------- client API
 
-    def submit(self, sample: GraphSample) -> Future:
+    def submit(self, sample: GraphSample,
+               deadline_ms: Optional[float] = None) -> Future:
         """Enqueue one request; returns a Future resolving to the per-head
-        outputs (or raising the per-request failure). Thread-safe."""
+        outputs (or raising the per-request failure). Thread-safe.
+
+        Fast-fail admission control (raised HERE, no future is created):
+        `QueueFullError` when the bounded queue is at max_queue,
+        `CircuitOpenError` while the breaker is open. ``deadline_ms``
+        (default: the engine's default_deadline_ms) bounds how long the
+        request may wait — once expired it resolves with
+        `DeadlineExceededError` instead of occupying a batch slot."""
         fut: Future = Future()
         err = self._validate(sample)
         if err is not None:
             fut.set_exception(err)
             return fut
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
         # closed-check + put under the lock: shutdown() flips _closed
         # under the same lock BEFORE enqueuing the sentinel, so a request
         # can never land behind the sentinel on a queue nobody drains
@@ -236,11 +308,53 @@ class InferenceEngine:
             if self._fatal is not None:
                 raise RuntimeError(
                     "InferenceEngine dispatcher died") from self._fatal
-            self._queue.put(_Request(sample, fut))
+            breaker = self._breaker_state
+            if breaker == "half_open":
+                # exactly ONE probe at a time: its outcome decides the
+                # circuit before anyone else is admitted
+                self.circuit_rejections += 1
+                raise CircuitOpenError(
+                    "circuit half-open: probe in flight; retry shortly")
+            if breaker == "open":
+                now = time.monotonic()
+                if now < self._open_until:
+                    self.circuit_rejections += 1
+                    raise CircuitOpenError(
+                        f"circuit open after {self.trip_count} trip(s) "
+                        f"({self._consec_failures} consecutive batch "
+                        f"failures); probing in {self._open_until - now:.2f}s")
+            if self.max_queue and self._queue.qsize() >= self.max_queue:
+                self.queue_rejections += 1
+                raise QueueFullError(
+                    f"admission queue full ({self.max_queue} pending); "
+                    "retry with backoff or raise Serving.max_queue")
+            if breaker == "open":
+                # all admission checks passed: this request IS the probe
+                self._breaker_state = "half_open"
+            self._queue.put(_Request(sample, fut, deadline_ms=deadline_ms))
             depth = self._queue.qsize()
             if depth > self.max_queue_depth:
                 self.max_queue_depth = depth
         return fut
+
+    def health(self) -> dict:
+        """Liveness/saturation snapshot for monitors and load balancers:
+        breaker state, queue depth, trip/failure counters, dispatcher
+        liveness. Cheap — counters only, no device work."""
+        with self._lock:
+            return {
+                "state": ("shutdown" if self._closed
+                          else self._breaker_state),
+                "queue_depth": self._queue.qsize(),
+                "trip_count": self.trip_count,
+                "consecutive_failures": self._consec_failures,
+                "batch_failures": self.batch_failures,
+                "deadline_expired": self.deadline_expired,
+                "queue_rejections": self.queue_rejections,
+                "circuit_rejections": self.circuit_rejections,
+                "requests_done": self.requests_done,
+                "dispatcher_alive": self._dispatcher.is_alive(),
+            }
 
     def predict(self, samples: Sequence[GraphSample], timeout=None):
         """Submit all samples, wait, return the list of results in order."""
@@ -332,6 +446,11 @@ class InferenceEngine:
                 "max_queue_depth": self.max_queue_depth,
                 "compile_count": self.compile_count,
                 "num_buckets": len(self.buckets),
+                "batch_failures": self.batch_failures,
+                "deadline_expired": self.deadline_expired,
+                "queue_rejections": self.queue_rejections,
+                "circuit_rejections": self.circuit_rejections,
+                "trip_count": self.trip_count,
             }
             out.update(latency_percentiles(self._latencies))
         return out
@@ -461,9 +580,61 @@ class InferenceEngine:
                 no += req.n
         return results
 
+    def _fail_expired(self, req: _Request) -> None:
+        with self._lock:
+            self.deadline_expired += 1
+        if not req.future.done():
+            req.future.set_exception(DeadlineExceededError(
+                f"deadline expired after "
+                f"{(time.perf_counter() - req.t_submit) * 1e3:.1f} ms "
+                "in queue"))
+
+    def _record_batch_failure(self) -> None:
+        with self._lock:
+            self.batch_failures += 1
+            self._consec_failures += 1
+            trip = (self._breaker_state == "half_open"
+                    or (self._breaker_state == "closed"
+                        and self.breaker_threshold > 0
+                        and self._consec_failures >= self.breaker_threshold))
+            if trip:
+                self._breaker_state = "open"
+                self._open_until = time.monotonic() + self.breaker_reset_s
+                self.trip_count += 1
+
+    def _record_batch_success(self) -> None:
+        with self._lock:
+            self._consec_failures = 0
+            self._breaker_state = "closed"
+
     def _execute(self, shards: List[List[_Request]]):
+        # deadline sweep at dispatch time: requests that expired while
+        # coalescing/queueing resolve with DeadlineExceededError and never
+        # occupy a batch slot (their FLOPs would be pure waste — nobody is
+        # waiting for the answer anymore)
+        now = time.perf_counter()
+        live: List[List[_Request]] = []
+        for sh in shards:
+            kept = []
+            for r in sh:
+                if r.deadline is not None and now > r.deadline:
+                    self._fail_expired(r)
+                else:
+                    kept.append(r)
+            live.append(kept)
+        shards = live
         reqs = [r for sh in shards for r in sh]
+        if not reqs:
+            with self._lock:
+                if self._breaker_state == "half_open":
+                    # the whole batch (the probe included) expired before
+                    # executing: re-open so the next submit re-probes
+                    self._breaker_state = "open"
+            return
         try:
+            # deterministic batch-failure injection; counted per executed
+            # batch (utils/faults.py serving-dispatch site)
+            fault_point("serving-dispatch")
             count = max(len(sh) for sh in shards)
             need_n = max(sum(r.n for r in sh) for sh in shards)
             need_e = max(sum(r.e for r in sh) for sh in shards)
@@ -488,9 +659,15 @@ class InferenceEngine:
                 req.future.bucket = bucket  # adjudication breadcrumb
                 req.future.set_result(res)
         except BaseException as e:  # noqa: BLE001 — must reach the callers
+            # dispatcher supervision: a failed batch resolves only ITS OWN
+            # futures; the dispatcher survives and the breaker decides
+            # whether to keep admitting
+            self._record_batch_failure()
             for req in reqs:
                 if not req.future.done():
                     req.future.set_exception(e)
+        else:
+            self._record_batch_success()
 
     def _coalesce(self, first: _Request, wait: bool = True):
         """Greedy arrival-order coalescing into per-shard bins: the
@@ -517,6 +694,10 @@ class InferenceEngine:
             if nxt is _SHUTDOWN:
                 leftover = nxt
                 break
+            if (nxt.deadline is not None
+                    and time.perf_counter() > nxt.deadline):
+                self._fail_expired(nxt)
+                continue
             if (nxt.n > rem_n or nxt.e > rem_e
                     or len(shards[-1]) >= self._shard_fill_cap):
                 if len(shards) >= self.num_shards:
@@ -532,6 +713,37 @@ class InferenceEngine:
             shards.append([])
         return shards, leftover
 
+    def _fast_fail(self, req: _Request) -> bool:
+        """Dispatcher-side admission: resolve (with an error, True) a
+        dequeued request that must not enter a batch — an expired deadline,
+        or a request caught in the queue behind an open breaker. Reaching
+        the probe window flips the breaker to half_open and lets the
+        request through as the probe."""
+        if req.deadline is not None and time.perf_counter() > req.deadline:
+            self._fail_expired(req)
+            with self._lock:
+                if self._breaker_state == "half_open":
+                    # the probe expired unexecuted: re-open (the window is
+                    # already past) so the next submit becomes the probe —
+                    # otherwise half_open would reject everyone forever
+                    self._breaker_state = "open"
+            return True
+        err = None
+        with self._lock:
+            if self._breaker_state == "open":
+                if time.monotonic() < self._open_until:
+                    self.circuit_rejections += 1
+                    err = CircuitOpenError(
+                        f"circuit open after {self.trip_count} trip(s); "
+                        "request was queued before the trip")
+                else:
+                    self._breaker_state = "half_open"
+        if err is None:
+            return False
+        if not req.future.done():
+            req.future.set_exception(err)
+        return True
+
     def _loop(self):
         pending = None
         try:
@@ -542,6 +754,8 @@ class InferenceEngine:
                     req, pending = pending, None
                 if req is _SHUTDOWN:
                     break
+                if self._fast_fail(req):
+                    continue
                 shards, pending = self._coalesce(req)
                 self._execute(shards)
                 if pending is _SHUTDOWN:

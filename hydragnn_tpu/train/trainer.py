@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -17,10 +18,66 @@ import jax
 import numpy as np
 
 from ..datasets.loader import prefetch_to_device
+from ..utils.faults import fault_point
 from ..utils.print_utils import iterate_tqdm, log, print_distributed
 from ..utils.profiling import Tracer
 from .optimizer import (get_learning_rate, set_learning_rate,
                         supports_lr_schedule)
+
+# ---------------------------------------------------------------- preemption
+# SLURM/TPU preemption delivers SIGTERM with a grace window; the handler
+# only sets a flag (signal-safe), and the epoch loop performs ONE final
+# synchronous save at the next step boundary before exiting cleanly
+# (docs/fault_tolerance.md). Tests drive the same path deterministically
+# via request_preemption().
+
+_PREEMPT = threading.Event()
+_PREV_SIGTERM: list = [None, False]  # (previous handler, installed?)
+
+
+def install_sigterm_handler() -> bool:
+    """Route SIGTERM to the preemption flag; returns False when not on the
+    main thread (signal handlers can only be installed there). The
+    previous disposition is remembered (first install wins across nested
+    installs) so `restore_sigterm_handler` can put it back after training
+    — leaving the flag-only handler installed would make the process
+    silently ignore SIGTERM forever after the run completes."""
+    import signal
+
+    def _handler(signum, frame):
+        _PREEMPT.set()
+
+    try:
+        prev = signal.signal(signal.SIGTERM, _handler)
+    except ValueError:
+        return False
+    if not _PREV_SIGTERM[1]:
+        _PREV_SIGTERM[0], _PREV_SIGTERM[1] = prev, True
+    return True
+
+
+def restore_sigterm_handler() -> None:
+    """Put back the SIGTERM disposition that predated
+    `install_sigterm_handler`; no-op when nothing was installed."""
+    import signal
+    if _PREV_SIGTERM[1]:
+        try:
+            signal.signal(signal.SIGTERM, _PREV_SIGTERM[0])
+        except (ValueError, TypeError):
+            pass
+        _PREV_SIGTERM[0], _PREV_SIGTERM[1] = None, False
+
+
+def request_preemption() -> None:
+    _PREEMPT.set()
+
+
+def preemption_requested() -> bool:
+    return _PREEMPT.is_set()
+
+
+def clear_preemption() -> None:
+    _PREEMPT.clear()
 
 
 class EarlyStopping:
@@ -116,10 +173,26 @@ def train_validate_test(
     steps_per_call: int = 1,
     place_group_fn: Optional[Callable] = None,
     multi_eval_step: Optional[Callable] = None,
+    start_epoch: int = 0,
+    resume: Optional[Dict[str, Any]] = None,
+    checkpoint_every_n_epochs: int = 0,
+    periodic_checkpoint_fn: Optional[Callable] = None,
+    preempt_save_fn: Optional[Callable] = None,
+    initial_best_state=None,
+    initial_best_val: Optional[float] = None,
+    resume_meta_out: Optional[Dict[str, Any]] = None,
 ):
     """Returns (final_state, history dict). With `keep_best` the returned
     state is the best-validation one (mirrors the reference's best-val
-    checkpoint + reload flow, utils/model/model.py:258-298)."""
+    checkpoint + reload flow, utils/model/model.py:258-298).
+
+    Fault tolerance (docs/fault_tolerance.md): `start_epoch`/`resume`
+    restore a preempted run's trainer state (history, scheduler and
+    early-stop counters, best-val) so replayed epochs are bitwise-identical
+    to the uninterrupted run; `periodic_checkpoint_fn(state, meta)` fires
+    every `checkpoint_every_n_epochs` completed epochs with the resume
+    metadata; `preempt_save_fn(state, meta)` fires EXACTLY ONCE when
+    SIGTERM (or request_preemption) arrives, then the loop exits cleanly."""
     run_dir = os.path.join(log_dir, log_name)
     os.makedirs(run_dir, exist_ok=True)
     tb = _tensorboard_writer(run_dir)
@@ -129,7 +202,74 @@ def train_validate_test(
     tr = tracer or Tracer()
     history: Dict[str, List[float]] = {"train_loss": [], "val_loss": [],
                                        "test_loss": [], "lr": []}
-    best_state, best_val = None, float("inf")
+    best_state, best_val = initial_best_state, float("inf")
+    if resume:
+        # restore the trainer-side state the checkpointed pytree doesn't
+        # carry: without it the LR plateau / early-stop counters restart
+        # from zero and the resumed trajectory diverges from the
+        # uninterrupted one
+        for k, v in (resume.get("history") or {}).items():
+            history[k] = list(v)
+        p = resume.get("plateau") or {}
+        plateau.best = float(p.get("best", plateau.best))
+        plateau.count = int(p.get("count", plateau.count))
+        e = resume.get("early") or {}
+        if early is not None and e:
+            early.best = float(e.get("best", early.best))
+            early.count = int(e.get("count", early.count))
+        gate.best = float(resume.get("gate_best", gate.best))
+        if initial_best_state is not None:
+            # adopt the BEST checkpoint's OWN recorded val when available
+            # (the marker's line 2): the trainer's in-memory best_val can
+            # belong to a failed/warmup-skipped save and would block
+            # adoption of genuinely better resumed epochs
+            best_val = float(initial_best_val
+                             if initial_best_val is not None
+                             else resume.get("best_val", best_val))
+        # without a restored best-state pytree (no BEST checkpoint, e.g. a
+        # periodic-only config) the pre-kill best_val must NOT be adopted:
+        # keep_best would then never snapshot a best_state and return the
+        # final state instead of the best reachable one — re-track the
+        # best over the resumed epochs instead
+
+    def _resume_meta(next_epoch: int, state) -> Dict[str, Any]:
+        """Everything a resumed run needs to continue bitwise-identically;
+        persisted as resume.json next to the checkpointed pytree. The
+        history is SNAPSHOTTED here: async best-val saves serialize the
+        metadata later on the commit-watcher thread, and the live dict
+        keeps growing — a by-reference capture could commit more epochs
+        than next_epoch claims and corrupt the resume."""
+        return {
+            "next_epoch": int(next_epoch),
+            "step": int(state.step),
+            # loader permutations are pure functions of (seed, epoch), so
+            # the loader epoch always equals next_epoch; recorded
+            # explicitly so external tooling can reconstruct the exact
+            # resumed data stream from the metadata alone
+            "loader_epoch": int(next_epoch),
+            "trainer": {
+                "history": {k: list(v) for k, v in history.items()},
+                "plateau": {"best": plateau.best, "count": plateau.count},
+                "early": ({"best": early.best, "count": early.count}
+                          if early is not None else None),
+                "gate_best": gate.best,
+                "best_val": best_val,
+            },
+        }
+
+    preempt_saved = [False]
+
+    def _preempt_save(next_epoch: int, state) -> None:
+        # exactly-once: the batch-level and epoch-level checks can both
+        # observe the same SIGTERM
+        if preempt_saved[0]:
+            return
+        preempt_saved[0] = True
+        if preempt_save_fn is not None:
+            preempt_save_fn(state, _resume_meta(next_epoch, state))
+        print_distributed(verbosity, 0,
+                          f"preemption: checkpoint saved at epoch "
+                          f"{next_epoch} boundary; exiting cleanly")
 
     # env-flag layer (reference: HYDRAGNN_MAX_NUM_BATCH caps batches/epoch
     # for scaling runs, train_validate_test.py:39-49; HYDRAGNN_VALTEST
@@ -149,13 +289,36 @@ def train_validate_test(
     stall = HostStallMonitor(tracer=tr)
     prev_compiled = 0  # jit-recompile counter baseline (utils/profiling)
 
-    for epoch in range(num_epochs):
+    import inspect
+    ckpt_accepts_meta = False
+    if checkpoint_fn is not None:
+        try:
+            ckpt_accepts_meta = "meta" in inspect.signature(
+                checkpoint_fn).parameters
+        except (TypeError, ValueError):
+            pass
+
+    prev_boundary_committed = False
+    for epoch in range(start_epoch, num_epochs):
         train_loader.set_epoch(epoch)
         profiler.set_current_epoch(epoch)
         stall.reset()
+        # epoch-start snapshot for the mid-epoch preemption save: resume
+        # replays the WHOLE epoch, so the saved pytree must be the state
+        # before any of this epoch's updates — saving the partial-epoch
+        # state would double-apply the completed batches on replay. One
+        # host copy per epoch, only when a preempt save is installed AND
+        # the previous boundary's periodic checkpoint doesn't already
+        # hold this exact state (then LATEST is the resume point and the
+        # copy would be pure waste).
+        epoch_start_state = (jax.device_get(state)
+                             if (preempt_save_fn is not None
+                                 and not prev_boundary_committed)
+                             else None)
         # ---- train pass (reference: train, :449-565) ----
         acc_train: Dict[str, float] = {}
         nb = 0
+        preempted = False
         with tr.timer("train_epoch"), profiler:
             # double-buffered device prefetch only when the caller supplies
             # a placement (meshes need mesh-aware sharding; committing to a
@@ -186,6 +349,15 @@ def train_validate_test(
             for batch in iterate_tqdm(stream, verbosity,
                                       desc=f"epoch {epoch} train",
                                       total=n_items):
+                # step-boundary preemption check: the SIGTERM handler only
+                # sets a flag, so the interrupted step always completes and
+                # the saved state is a clean step boundary
+                if preemption_requested():
+                    preempted = True
+                    break
+                # deterministic crash injection (utils/faults.py): one
+                # forward-step index per train-loop dispatch
+                fault_point("forward-step")
                 full_group = (group
                               and batch.x.shape[0] == steps_per_call
                               and (max_num_batch is None
@@ -214,6 +386,24 @@ def train_validate_test(
                         nb += 1
                 if max_num_batch is not None and nb >= max_num_batch:
                     break
+        if preempted:
+            # mid-epoch preemption: save the EPOCH-START state with
+            # next_epoch = THIS epoch, so the resumed run replays the
+            # whole epoch from its deterministic permutation — the partial
+            # epoch's updates are discarded in favor of a bitwise-exact
+            # trajectory (docs/fault_tolerance.md)
+            if epoch_start_state is None and prev_boundary_committed:
+                # the previous boundary's periodic checkpoint IS this
+                # epoch's start state — LATEST already holds the resume
+                # point, a second identical save would only burn grace
+                preempt_saved[0] = True
+                print_distributed(verbosity, 0,
+                                  f"preemption: resuming from the epoch "
+                                  f"{epoch} boundary checkpoint; exiting "
+                                  "cleanly")
+            else:
+                _preempt_save(epoch, epoch_start_state)
+            break
         train_loss = acc_train.pop("loss", 0.0) / max(nb, 1)
         task_tot = acc_train
         # host-stall report: fraction of the train pass the host (and so
@@ -319,7 +509,33 @@ def train_validate_test(
 
         if (checkpoint_fn is not None and val_loss == val_loss
                 and gate.should_save(epoch, val_loss)):
-            checkpoint_fn(state, epoch, val_loss)
+            if ckpt_accepts_meta:
+                checkpoint_fn(state, epoch, val_loss,
+                              meta=_resume_meta(epoch + 1, state))
+            else:
+                checkpoint_fn(state, epoch, val_loss)
+        # periodic preemption-safe checkpoint: every n completed epochs,
+        # synchronous, with full resume metadata — the restartable points
+        # a SIGTERM-less kill (OOM, node loss) falls back to
+        boundary_saved = False
+        if (checkpoint_every_n_epochs and periodic_checkpoint_fn is not None
+                and (epoch + 1) % checkpoint_every_n_epochs == 0):
+            periodic_checkpoint_fn(state, _resume_meta(epoch + 1, state))
+            boundary_saved = True
+        if preemption_requested():
+            if boundary_saved:
+                # the periodic save above IS this boundary's resume point;
+                # a second identical full save would double exit latency
+                # inside the preemption grace window
+                preempt_saved[0] = True
+                print_distributed(verbosity, 0,
+                                  f"preemption: periodic checkpoint at "
+                                  f"epoch {epoch + 1} boundary is the "
+                                  "resume point; exiting cleanly")
+            else:
+                _preempt_save(epoch + 1, state)
+            break
+        prev_boundary_committed = boundary_saved
         if early is not None and val_loss == val_loss and early(val_loss):
             print_distributed(verbosity, 1, f"early stop at epoch {epoch}")
             break
@@ -334,6 +550,12 @@ def train_validate_test(
         tb.close()
     if keep_best and best_state is not None:
         state = best_state
+    if resume_meta_out is not None:
+        # the run-complete resume point (next_epoch = num_epochs) for the
+        # caller's final save: carries the FULL trainer state, so a later
+        # continue with a raised num_epoch resumes scheduler/early-stop
+        # counters and best_val instead of resetting them
+        resume_meta_out.update(_resume_meta(num_epochs, state))
     return state, history
 
 

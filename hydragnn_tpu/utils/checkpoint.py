@@ -1,20 +1,47 @@
-"""Checkpoint save/load via orbax.
+"""Checkpoint save/load via orbax, with preemption-safe resume metadata.
 
 reference: hydragnn/utils/model/model.py:63-122 (`save_model`,
 `load_existing_model[_config]` — torch pickle of model+optimizer state with
 DDP "module." key fixup). TPU equivalent: orbax checkpoint of the
 (params, batch_stats, opt_state, step) pytree; no key fixup needed because
 SPMD has no module wrappers. Async-capable (SURVEY.md §5.3 suggestion).
+
+Fault-tolerance layer (docs/fault_tolerance.md):
+
+* every step dir carries a ``COMMITTED`` marker written strictly AFTER the
+  orbax save finalizes (and after ``resume.json``), so readers can tell a
+  complete checkpoint from one whose writer died mid-flight;
+* ``resume.json`` holds the trainer's resume metadata (next epoch, step,
+  loader epoch, scheduler/early-stop state, history) — restoring it
+  replays the remaining epochs bitwise-identically to an uninterrupted
+  run (tests/test_faults.py);
+* ``gc_checkpoints`` enforces a keep-last-k retention policy that never
+  touches the ``LATEST``/``BEST`` targets, and deletes via rename-then-rm
+  so a crash mid-GC can't leave a half-deleted dir that still looks like
+  a checkpoint;
+* restore verifies commit state and falls back to the newest verified
+  step dir when the preferred one is corrupt or uncommitted.
+
+The ``checkpoint-write`` fault site (utils/faults.py) fires at the top of
+``save_model`` so disk-full/permission failures are exercised
+deterministically in tests rather than hoped-for.
 """
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Optional
+import shutil
+import threading
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
 
 from ..train.train_step import TrainState
+from .faults import fault_point
+
+COMMIT_MARKER = "COMMITTED"
+RESUME_META = "resume.json"
 
 
 def _ckpt_dir(log_name: str, path: str = "./logs") -> str:
@@ -25,14 +52,27 @@ _ASYNC_STATE: dict = {}
 
 
 def save_model(state: TrainState, log_name: str, path: str = "./logs",
-               use_async: bool = False) -> str:
+               use_async: bool = False,
+               metadata: Optional[Dict[str, Any]] = None,
+               mark_best: bool = False,
+               best_val: Optional[float] = None,
+               keep_last_k: Optional[int] = None) -> str:
     """Rank-0-coordinated atomic save (reference: save_model,
     utils/model/model.py:63-77).
 
     ``use_async=True`` hands the host copy to a background orbax
     AsyncCheckpointer so the train loop isn't blocked on filesystem writes
     (SURVEY.md §5.3: mid-training best-val checkpoints); call
-    `wait_for_checkpoints()` before reading the files or exiting."""
+    `wait_for_checkpoints()` before reading the files or exiting.
+
+    ``metadata`` is written as ``resume.json`` inside the step dir (the
+    trainer's preemption-resume state); ``mark_best`` points the BEST
+    marker at this save (``best_val`` records the marked save's own
+    validation loss in the marker, so resume adopts a (state, val) pair
+    that actually match); ``keep_last_k`` runs the retention GC after the
+    commit. All three are finalized strictly after the orbax save — a
+    crash mid-save leaves no COMMITTED marker and restore skips the dir."""
+    fault_point("checkpoint-write")
     d = _ckpt_dir(log_name, path)
     target = os.path.join(d, f"step_{int(state.step)}")
     host_state = jax.device_get(state)
@@ -44,62 +84,211 @@ def save_model(state: TrainState, log_name: str, path: str = "./logs",
         ckptr = _ASYNC_STATE["ckptr"]
         ckptr.save(target, args=ocp.args.StandardSave(host_state),
                    force=True)
-        # LATEST must only ever name a finalized step dir: defer the marker
-        # to a background commit-watcher instead of writing it at enqueue
-        # time (a crash mid-finalize would otherwise leave a dangling
-        # pointer and silently roll readers back to an older checkpoint)
+        # markers (LATEST/BEST/COMMITTED) must only ever name a finalized
+        # step dir: defer them to a background commit-watcher instead of
+        # writing them at enqueue time (a crash mid-finalize would
+        # otherwise leave a dangling pointer and silently roll readers
+        # back to an older checkpoint)
         if jax.process_index() == 0:
             with _ASYNC_LOCK:
-                _ASYNC_STATE["pending_latest"] = target
+                _ASYNC_STATE["pending_latest"] = {
+                    "target": target, "metadata": metadata,
+                    "mark_best": mark_best, "best_val": best_val,
+                    "keep_last_k": keep_last_k}
             _spawn_latest_writer()
     else:
         ckptr = ocp.StandardCheckpointer()
         ckptr.save(target, host_state, force=True)
         ckptr.wait_until_finished()
         if jax.process_index() == 0:
-            _write_latest(target)
+            _finalize_commit(target, metadata, mark_best, keep_last_k,
+                             best_val=best_val)
     return target
 
 
-def make_async_best_checkpoint_fn(log_name: str, path: str = "./logs"):
+def make_async_best_checkpoint_fn(log_name: str, path: str = "./logs",
+                                  keep_last_k: Optional[int] = None,
+                                  max_consecutive_failures: int = 3):
     """Best-val mid-training checkpoint callback for the trainer.
 
     Must be installed (and invoked) on ALL ranks: orbax ``save()`` is a
     multihost collective (sync_global_processes barrier), so the old
     ``jax.process_index() == 0`` gate deadlocked rank 0 at the barrier on
     the first best-val save while other ranks never joined (r5 advisor,
-    run_training.py:422). `save_model` already restricts the LATEST marker
-    to rank 0 and orbax coordinates the writers internally — the same
+    run_training.py:422). `save_model` already restricts the markers to
+    rank 0 and orbax coordinates the writers internally — the same
     contract the final-save path always used.
 
     A failed optional save (the error surfaces on the NEXT save, when
-    orbax drains the previous one) must not abort training."""
-    def ckpt_fn(state, epoch, val_loss):
+    orbax drains the previous one) must not abort training — but a save
+    path that fails EVERY time (disk full, dead filesystem) must not
+    silently yield a checkpoint-less run either: after
+    ``max_consecutive_failures`` straight failures the error escalates to
+    a hard RuntimeError. Any success resets the counter."""
+    failures = [0]
+
+    def ckpt_fn(state, epoch, val_loss, meta=None):
         try:
-            save_model(state, log_name, path=path, use_async=True)
+            save_model(state, log_name, path=path, use_async=True,
+                       metadata=meta, mark_best=True,
+                       best_val=float(val_loss),
+                       keep_last_k=keep_last_k)
+            failures[0] = 0
         except Exception as exc:  # noqa: BLE001
+            failures[0] += 1
             import logging
             logging.getLogger("hydragnn_tpu").warning(
-                "async checkpoint failed: %s", exc)
+                "async checkpoint failed (%d/%d consecutive): %s",
+                failures[0], max_consecutive_failures, exc)
+            if failures[0] >= max_consecutive_failures:
+                raise RuntimeError(
+                    f"checkpointing failed {failures[0]} times in a row "
+                    f"(last: {type(exc).__name__}: {exc}) — the run would "
+                    "silently lose all its work; fix the checkpoint "
+                    "filesystem or disable Training.Checkpoint") from exc
     return ckpt_fn
 
 
-def _write_latest(target: str) -> None:
-    d = os.path.dirname(target)
-    tmp = os.path.join(d, "LATEST.tmp")
+def _write_marker(d: str, name: str, content: str) -> None:
+    tmp = os.path.join(d, f"{name}.tmp")
     with open(tmp, "w") as f:
-        f.write(os.path.basename(target))
-    os.replace(tmp, os.path.join(d, "LATEST"))
+        f.write(content)
+    os.replace(tmp, os.path.join(d, name))
 
 
-import threading
+def _write_latest(target: str) -> None:
+    _write_marker(os.path.dirname(target), "LATEST",
+                  os.path.basename(target))
+
+
+def _finalize_commit(target: str, metadata: Optional[Dict[str, Any]] = None,
+                     mark_best: bool = False,
+                     keep_last_k: Optional[int] = None,
+                     best_val: Optional[float] = None) -> None:
+    """Post-save commit sequence (rank 0): resume metadata, then the
+    COMMITTED marker, then the LATEST/BEST pointers, then retention GC.
+    Ordering is the crash-safety contract — a dir only becomes COMMITTED
+    once everything a restore needs is on disk, and pointers only ever
+    name committed dirs."""
+    d = os.path.dirname(target)
+    if metadata is not None:
+        _write_marker(target, RESUME_META, json.dumps(metadata))
+    _write_marker(target, COMMIT_MARKER, os.path.basename(target))
+    _write_latest(target)
+    if mark_best:
+        # line 2 records the marked save's OWN val loss (repr round-trips
+        # floats exactly): on resume the adopted best_val must describe
+        # the restorable BEST state, not the trainer's in-memory best
+        # (which may have belonged to a failed/warmup-skipped save)
+        content = os.path.basename(target)
+        if best_val is not None:
+            content += f"\n{best_val!r}"
+        _write_marker(d, "BEST", content)
+    if keep_last_k:
+        gc_checkpoints(d, keep_last_k)
+
+
+def verify_checkpoint(target: str) -> bool:
+    """A step dir is restorable when our COMMITTED marker AND orbax's own
+    checkpoint metadata are both present — the marker is written strictly
+    after the orbax finalize, so its presence implies a complete save."""
+    if not os.path.isdir(target):
+        return False
+    if not os.path.exists(os.path.join(target, COMMIT_MARKER)):
+        return False
+    return _orbax_complete(target)
+
+
+def _orbax_complete(target: str) -> bool:
+    """Structural check: orbax writes its metadata files before the atomic
+    tmp-dir rename, so a step dir missing them was partially written by a
+    non-atomic path (or is foreign junk) and must never be restored."""
+    return any(os.path.exists(os.path.join(target, name))
+               for name in ("_CHECKPOINT_METADATA", "_METADATA",
+                            "checkpoint"))
+
+
+def load_checkpoint_metadata(target: str) -> Optional[Dict[str, Any]]:
+    """The resume metadata saved alongside a checkpoint, or None."""
+    meta_path = os.path.join(target, RESUME_META)
+    if not os.path.exists(meta_path):
+        return None
+    try:
+        with open(meta_path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _step_dirs(d: str):
+    """(step, path) for every step_N dir, newest first. Orbax tmp dirs
+    (step_N.orbax-checkpoint-tmp-*) fail the integer parse and are
+    excluded by construction."""
+    out = []
+    for p in os.listdir(d):
+        full = os.path.join(d, p)
+        if (p.startswith("step_") and os.path.isdir(full)
+                and p.split("_")[-1].isdigit()):
+            out.append((int(p.split("_")[-1]), full))
+    return sorted(out, reverse=True)
+
+
+def gc_checkpoints(d: str, keep_last_k: int,
+                   protect: Tuple[str, ...] = ()) -> int:
+    """Retention policy: keep the newest `keep_last_k` committed step dirs
+    plus whatever LATEST and BEST point at (and `protect` basenames);
+    delete the rest. Deletion is rename-then-rmtree so a crash mid-delete
+    leaves a ``.gc-`` prefixed dir that no reader mistakes for a
+    checkpoint. Crash leftovers are reaped too: ``.gc-`` trash from an
+    interrupted delete, and uncommitted step dirs strictly OLDER than the
+    newest committed save (saves are monotone in step, so those can never
+    be in-flight async writes — they are dead writers that would
+    otherwise leak a full checkpoint's disk per crash, forever). Returns
+    the number of dirs removed."""
+    keep_last_k = max(int(keep_last_k), 1)
+    for p in os.listdir(d):
+        if p.startswith(".gc-"):
+            shutil.rmtree(os.path.join(d, p), ignore_errors=True)
+    protected = set(protect)
+    for marker in ("LATEST", "BEST"):
+        m = os.path.join(d, marker)
+        if os.path.exists(m):
+            try:
+                with open(m) as f:
+                    # first line only: BEST's second line is its val loss
+                    protected.add(f.readline().strip())
+            except OSError:
+                pass
+    all_steps = _step_dirs(d)
+    committed = [(step, full) for step, full in all_steps
+                 if os.path.exists(os.path.join(full, COMMIT_MARKER))]
+    victims = list(committed[keep_last_k:])
+    if committed:
+        newest_committed = committed[0][0]
+        victims += [(step, full) for step, full in all_steps
+                    if step < newest_committed
+                    and not os.path.exists(os.path.join(full,
+                                                        COMMIT_MARKER))]
+    removed = 0
+    for step, full in victims:
+        if os.path.basename(full) in protected:
+            continue
+        trash = os.path.join(d, f".gc-{os.path.basename(full)}")
+        try:
+            os.replace(full, trash)
+            shutil.rmtree(trash, ignore_errors=True)
+            removed += 1
+        except OSError:
+            continue  # racing writer/reader: skip, next GC retries
+    return removed
+
 
 _ASYNC_LOCK = threading.Lock()
 
 
 def _spawn_latest_writer() -> None:
     """One background thread that waits for the async checkpointer to
-    finalize, then points LATEST at the newest committed save. The
+    finalize, then commits the newest save (markers + GC). The
     check-and-clear of ``pending_latest`` and the is-alive spawn guard are
     serialized under one lock: without it, a save enqueued between the old
     thread's final check and its exit would never get its marker written."""
@@ -124,15 +313,19 @@ def _spawn_latest_writer() -> None:
             try:
                 while True:
                     with _ASYNC_LOCK:
-                        target = _ASYNC_STATE.get("pending_latest")
-                        if target is None:
+                        pending = _ASYNC_STATE.get("pending_latest")
+                        if pending is None:
                             _ASYNC_STATE["latest_thread"] = None
                             return
                     _ASYNC_STATE["ckptr"].wait_until_finished()
-                    if os.path.isdir(target):
-                        _write_latest(target)
+                    if os.path.isdir(pending["target"]):
+                        _finalize_commit(pending["target"],
+                                         pending["metadata"],
+                                         pending["mark_best"],
+                                         pending["keep_last_k"],
+                                         best_val=pending["best_val"])
                     with _ASYNC_LOCK:
-                        if _ASYNC_STATE.get("pending_latest") == target:
+                        if _ASYNC_STATE.get("pending_latest") is pending:
                             _ASYNC_STATE["pending_latest"] = None
                             _ASYNC_STATE["latest_thread"] = None
                             return
@@ -151,8 +344,8 @@ def _spawn_latest_writer() -> None:
 
 def wait_for_checkpoints():
     """Block until every async save has been finalized on disk (and the
-    LATEST marker points at a committed step dir). Writes any leftover
-    pending marker itself, so a wedged/raced writer thread cannot leave
+    LATEST marker points at a committed step dir). Commits any leftover
+    pending save itself, so a wedged/raced writer thread cannot leave
     LATEST stale."""
     ckptr = _ASYNC_STATE.get("ckptr")
     if ckptr is not None:
@@ -161,35 +354,92 @@ def wait_for_checkpoints():
     if t is not None and t.is_alive():
         t.join(timeout=60)
     with _ASYNC_LOCK:
-        target = _ASYNC_STATE.get("pending_latest")
-        if target is not None and os.path.isdir(target):
-            _write_latest(target)
+        pending = _ASYNC_STATE.get("pending_latest")
+        if pending is not None and os.path.isdir(pending["target"]):
+            _finalize_commit(pending["target"], pending["metadata"],
+                             pending["mark_best"], pending["keep_last_k"],
+                             best_val=pending["best_val"])
             _ASYNC_STATE["pending_latest"] = None
 
 
+def _restore_candidates(d: str):
+    """Step dirs to try, best first: the LATEST target when committed,
+    then every committed dir newest-first, then (only when NOTHING is
+    committed — checkpoints written before the marker existed) dirs that
+    at least pass the orbax structural check. Partially-written dirs
+    (no orbax metadata) never qualify."""
+    latest = os.path.join(d, "LATEST")
+    preferred = None
+    if os.path.exists(latest):
+        with open(latest) as f:
+            preferred = os.path.join(d, f.read().strip())
+    committed = [full for _, full in _step_dirs(d)
+                 if verify_checkpoint(full)]
+    if committed:
+        ordered = committed
+    else:
+        ordered = [full for _, full in _step_dirs(d)
+                   if _orbax_complete(full)]
+    if preferred is not None and preferred in ordered:
+        ordered = [preferred] + [p for p in ordered if p != preferred]
+    return ordered
+
+
 def load_existing_model(state_like: TrainState, log_name: str,
-                        path: str = "./logs") -> Optional[TrainState]:
-    """Restore the latest checkpoint onto a template state
+                        path: str = "./logs", with_metadata: bool = False):
+    """Restore the newest verified checkpoint onto a template state
     (reference: load_existing_model, utils/model/model.py:101-122). Returns
     None when no checkpoint exists (startfrom semantics,
-    run_training.py:114-116)."""
+    run_training.py:114-116).
+
+    Restore-side integrity: the LATEST target is preferred, but any
+    uncommitted or corrupt dir (a writer killed between the orbax rename
+    and the marker, a truncated array file) is skipped with a warning and
+    the next-newest verified dir is tried — a crash can cost at most the
+    in-flight save, never the run. ``with_metadata=True`` additionally
+    returns the restored dir's resume.json (or None)."""
     d = _ckpt_dir(log_name, path)
-    latest = os.path.join(d, "LATEST")
-    if not os.path.exists(latest):
-        return None
-    with open(latest) as f:
-        target = os.path.join(d, f.read().strip())
-    if not os.path.isdir(target):
-        # LATEST can point at an async save still being finalized (orbax
-        # writes to a tmp dir and renames); fall back to the newest
-        # completed step dir
-        done = sorted((p for p in os.listdir(d)
-                       if p.startswith("step_")
-                       and os.path.isdir(os.path.join(d, p))
-                       and p.split("_")[-1].isdigit()),
-                      key=lambda p: int(p.split("_")[-1]))
-        if not done:
-            return None
-        target = os.path.join(d, done[-1])
+    if not os.path.isdir(d):
+        return (None, None) if with_metadata else None
+    import logging
+    logger = logging.getLogger("hydragnn_tpu")
     ckptr = ocp.StandardCheckpointer()
-    return ckptr.restore(target, state_like)
+    for target in _restore_candidates(d):
+        try:
+            restored = ckptr.restore(target, state_like)
+        except Exception as exc:  # noqa: BLE001 — corrupt/mismatched dir:
+            # fall back to the previous verified save instead of dying
+            logger.warning(
+                "checkpoint %s is unrestorable (%s: %s); falling back to "
+                "the previous verified step", target,
+                type(exc).__name__, exc)
+            continue
+        if with_metadata:
+            return restored, load_checkpoint_metadata(target)
+        return restored
+    return (None, None) if with_metadata else None
+
+
+def load_best_model(state_like: TrainState, log_name: str,
+                    path: str = "./logs", with_val: bool = False):
+    """Restore the checkpoint the BEST marker names (the best-validation
+    save), or None when there is none / it is not verified.
+    ``with_val=True`` returns ``(state, val_loss_or_None)`` — the marked
+    save's OWN recorded val loss (marker line 2), the value a resumed
+    trainer must compare against."""
+    d = _ckpt_dir(log_name, path)
+    none = (None, None) if with_val else None
+    best = os.path.join(d, "BEST")
+    if not os.path.exists(best):
+        return none
+    with open(best) as f:
+        lines = f.read().splitlines()
+    target = os.path.join(d, lines[0].strip())
+    val = float(lines[1]) if len(lines) > 1 else None
+    if not verify_checkpoint(target):
+        return none
+    try:
+        restored = ocp.StandardCheckpointer().restore(target, state_like)
+    except Exception:  # noqa: BLE001
+        return none
+    return (restored, val) if with_val else restored

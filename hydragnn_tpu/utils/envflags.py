@@ -99,6 +99,33 @@ def resolve_pack_lookahead(train_cfg) -> "int | None":
     return None if la is None else int(la)
 
 
+_LOADER_RETRY_MEMO: dict = {}
+
+
+def resolve_loader_retries() -> "tuple[int, float]":
+    """(attempts, backoff_base_s) for the loader's transient-I/O retry
+    (datasets/async_loader.fetch_samples): HYDRAGNN_LOADER_RETRIES bounds
+    the total tries per sample fetch (default 3, min 1 — a 0 would mean
+    "never even try"), HYDRAGNN_LOADER_RETRY_BACKOFF_S the exponential
+    backoff base (default 0.05s, doubling per retry, capped at 1s by the
+    retry loop). Strict parsing: a typo value warns and keeps the default
+    rather than silently disabling recovery.
+
+    Memoized on the raw env strings: this runs per batch fetch on the
+    collation hot path, and a typo value must warn once per distinct
+    value, not once per batch."""
+    key = (os.getenv("HYDRAGNN_LOADER_RETRIES"),
+           os.getenv("HYDRAGNN_LOADER_RETRY_BACKOFF_S"))
+    hit = _LOADER_RETRY_MEMO.get(key)
+    if hit is None:
+        attempts = env_strict_int("HYDRAGNN_LOADER_RETRIES", 3)
+        backoff = env_strict_float("HYDRAGNN_LOADER_RETRY_BACKOFF_S", 0.05)
+        hit = (max(int(attempts), 1), max(float(backoff), 0.0))
+        _LOADER_RETRY_MEMO[key] = hit  # a handful of distinct values per
+        # process at most (None + explicit test settings)
+    return hit
+
+
 def resolve_steps_per_call(train_cfg) -> int:
     """Steps-per-call dispatch batching knob: HYDRAGNN_STEPS_PER_CALL env
     overrides Training.steps_per_call (default 1). Shared by run_training

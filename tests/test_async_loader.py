@@ -246,7 +246,7 @@ def test_checkpoint_fn_runs_on_every_rank(monkeypatch, samples):
     calls = []
     monkeypatch.setattr(
         ckpt, "save_model",
-        lambda state, log_name, path="./logs", use_async=False:
+        lambda state, log_name, path="./logs", use_async=False, **kw:
         calls.append((log_name, use_async)))
     fn = ckpt.make_async_best_checkpoint_fn("run")
     monkeypatch.setattr("jax.process_index", lambda: 1)  # a non-zero rank
@@ -366,6 +366,8 @@ def test_input_pipeline_smoke_benchmark():
     # tier; the real expectation is a clear win. The frac comparison is
     # advisory only (printed above) — few-ms per-batch timings flip under
     # a noisy neighbor, and the wall-clock guard already catches a loader
-    # that stopped overlapping.
-    assert async_t <= sync_t * 1.25, (
+    # that stopped overlapping. 1.5x because mid-suite contention has been
+    # observed pushing a healthy run to 1.30x (isolated runs sit at ~0.9x);
+    # a loader that stopped overlapping regresses to ~2x+, still caught.
+    assert async_t <= sync_t * 1.5, (
         f"async loader slower than sync: {async_t:.3f}s vs {sync_t:.3f}s")

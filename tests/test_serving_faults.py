@@ -1,0 +1,242 @@
+"""Serving failure semantics (docs/fault_tolerance.md):
+
+* every accepted submit() future resolves — result or error — under
+  injected dispatch faults (the ISSUE 4 zero-lost-futures adjudication),
+* the bounded admission queue fast-fails with QueueFullError without
+  blocking the dispatcher,
+* deadline-expired requests resolve with DeadlineExceededError and never
+  occupy a batch slot,
+* the consecutive-failure circuit breaker trips, fast-fails, and recovers
+  through a half-open probe — deterministically, driven by the fault plan.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.config import build_model_config, update_config
+from hydragnn_tpu.models.create import create_model, init_params
+from hydragnn_tpu.graphs.batch import collate
+from hydragnn_tpu.serving.engine import (CircuitOpenError,
+                                         DeadlineExceededError,
+                                         InferenceEngine, QueueFullError)
+from hydragnn_tpu.utils.faults import (InjectedFault, install_fault_plan,
+                                       parse_fault_plan)
+
+from tests.deterministic_data import deterministic_graph_dataset
+from tests.utils import make_config
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    yield
+    install_fault_plan(None)
+
+
+@pytest.fixture(scope="module")
+def served():
+    samples = deterministic_graph_dataset(num_configs=24)
+    cfg = make_config("GIN")
+    cfg = update_config(cfg, samples)
+    mcfg = build_model_config(cfg)
+    model = create_model(mcfg)
+    variables = init_params(model, collate(samples[:4]))
+    return samples, mcfg, model, variables
+
+
+def _engine(served, **kw):
+    samples, mcfg, model, variables = served
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_wait_ms", 5.0)
+    return InferenceEngine(model, variables, mcfg,
+                           reference_samples=samples, **kw)
+
+
+class _BlockedDispatcher:
+    """Deterministically park the dispatcher inside its first _execute so
+    tests can fill/expire the queue without racing the batch loop."""
+
+    def __init__(self, eng):
+        self.entered = threading.Event()
+        self.release = threading.Event()
+        self._orig = eng._execute
+
+        def blocked(shards):
+            self.entered.set()
+            assert self.release.wait(30)
+            return self._orig(shards)
+
+        eng._execute = blocked
+
+
+# ------------------------------------------------------- injected failures
+
+def test_dispatch_fault_resolves_only_its_batch(served):
+    samples, _, _, _ = served
+    eng = _engine(served, max_batch_size=2, breaker_threshold=0)
+    try:
+        install_fault_plan(parse_fault_plan("serving-dispatch@0"))
+        futs = [eng.submit(s) for s in samples[:8]]
+        for f in futs:
+            f.exception(timeout=60)  # blocks until resolved either way
+        assert all(f.done() for f in futs)  # EVERY future resolved
+        errs = [f for f in futs if f.exception(timeout=0) is not None]
+        oks = [f for f in futs if f.exception(timeout=0) is None]
+        # exactly the first executed batch failed (<= max_batch_size
+        # requests); everyone else was served by the surviving dispatcher
+        assert 1 <= len(errs) <= 2
+        for f in errs:
+            assert isinstance(f.exception(timeout=0), InjectedFault)
+        assert oks, "dispatcher must survive a failed batch"
+        for s, f in zip(samples[:8], futs):
+            if f.exception(timeout=0) is None:
+                ref = eng.forward_single(s, bucket=f.bucket)
+                for a, b in zip(f.result(timeout=0), ref):
+                    np.testing.assert_array_equal(np.asarray(a),
+                                                  np.asarray(b))
+        assert eng.health()["batch_failures"] == 1
+    finally:
+        eng.shutdown()
+
+
+def test_no_futures_lost_under_repeated_faults(served):
+    """The ISSUE 4 serving adjudication: with dispatch faults injected
+    mid-stream, zero futures are left unresolved."""
+    samples, _, _, _ = served
+    eng = _engine(served, max_batch_size=2, breaker_threshold=0)
+    try:
+        install_fault_plan(parse_fault_plan("serving-dispatch@0,2,4"))
+        futs = [eng.submit(s) for s in samples[:16]]
+        for f in futs:
+            f.exception(timeout=60)  # blocks until resolved either way
+        assert all(f.done() for f in futs)
+        health = eng.health()
+        assert health["batch_failures"] == 3
+        assert health["dispatcher_alive"]
+        # the engine still serves cleanly afterwards
+        assert eng.submit(samples[0]).result(timeout=60) is not None
+    finally:
+        eng.shutdown()
+
+
+# -------------------------------------------------------------- admission
+
+def test_queue_full_fast_fails_without_blocking(served):
+    samples, _, _, _ = served
+    eng = _engine(served, max_batch_size=1, max_wait_ms=0.0, max_queue=2)
+    block = _BlockedDispatcher(eng)
+    try:
+        f1 = eng.submit(samples[0])
+        assert block.entered.wait(30)  # dispatcher is parked mid-batch
+        f2 = eng.submit(samples[1])
+        f3 = eng.submit(samples[2])
+        t0 = time.perf_counter()
+        with pytest.raises(QueueFullError):
+            eng.submit(samples[3])
+        assert time.perf_counter() - t0 < 1.0  # fast-fail, no blocking
+        assert eng.health()["queue_rejections"] == 1
+        block.release.set()
+        for f in (f1, f2, f3):
+            assert f.result(timeout=60) is not None
+    finally:
+        block.release.set()
+        eng.shutdown()
+
+
+def test_deadline_expired_never_enters_a_batch(served):
+    samples, _, _, _ = served
+    eng = _engine(served, max_batch_size=1, max_wait_ms=0.0)
+    block = _BlockedDispatcher(eng)
+    try:
+        f1 = eng.submit(samples[0])
+        assert block.entered.wait(30)
+        f2 = eng.submit(samples[1], deadline_ms=1.0)
+        time.sleep(0.05)  # let the deadline lapse while queued
+        block.release.set()
+        assert f1.result(timeout=60) is not None
+        with pytest.raises(DeadlineExceededError):
+            f2.result(timeout=60)
+        st = eng.stats()
+        assert st["deadline_expired"] == 1
+        assert st["requests"] == 1  # the expired request ran NO batch
+    finally:
+        block.release.set()
+        eng.shutdown()
+
+
+# --------------------------------------------------------- circuit breaker
+
+def test_circuit_breaker_trips_and_recovers(served):
+    samples, _, _, _ = served
+    eng = _engine(served, max_batch_size=1, max_wait_ms=0.0,
+                  breaker_threshold=2, breaker_reset_s=0.2)
+    try:
+        install_fault_plan(parse_fault_plan("serving-dispatch@0,1"))
+        for i in range(2):  # two consecutive failed batches -> trip
+            with pytest.raises(InjectedFault):
+                eng.submit(samples[i]).result(timeout=60)
+        health = eng.health()
+        assert health["state"] == "open"
+        assert health["trip_count"] == 1
+        assert health["consecutive_failures"] == 2
+        # open: fast-fail at submit, no future created
+        with pytest.raises(CircuitOpenError):
+            eng.submit(samples[2])
+        assert eng.health()["circuit_rejections"] == 1
+
+        time.sleep(0.25)  # past breaker_reset_s: probe window
+        probe = eng.submit(samples[3])  # admitted as the half-open probe
+        assert probe.result(timeout=60) is not None
+        health = eng.health()
+        assert health["state"] == "closed"
+        assert health["consecutive_failures"] == 0
+        # normal service resumed
+        assert eng.submit(samples[4]).result(timeout=60) is not None
+    finally:
+        eng.shutdown()
+
+
+def test_breaker_reopens_on_failed_probe(served):
+    samples, _, _, _ = served
+    eng = _engine(served, max_batch_size=1, max_wait_ms=0.0,
+                  breaker_threshold=1, breaker_reset_s=0.15)
+    try:
+        # batch 0 fails (trip #1); the probe batch 1 fails too -> re-trip
+        install_fault_plan(parse_fault_plan("serving-dispatch@0,1"))
+        with pytest.raises(InjectedFault):
+            eng.submit(samples[0]).result(timeout=60)
+        assert eng.health()["state"] == "open"
+        time.sleep(0.2)
+        with pytest.raises(InjectedFault):
+            eng.submit(samples[1]).result(timeout=60)  # failed probe
+        health = eng.health()
+        assert health["state"] == "open"
+        assert health["trip_count"] == 2
+        time.sleep(0.2)
+        assert eng.submit(samples[2]).result(timeout=60) is not None
+        assert eng.health()["state"] == "closed"
+    finally:
+        eng.shutdown()
+
+
+def test_queued_requests_fail_fast_behind_open_breaker(served):
+    """Requests already queued when the breaker trips must not hang: the
+    dispatcher resolves them with CircuitOpenError."""
+    samples, _, _, _ = served
+    eng = _engine(served, max_batch_size=1, max_wait_ms=0.0,
+                  breaker_threshold=1, breaker_reset_s=30.0)
+    block = _BlockedDispatcher(eng)
+    try:
+        install_fault_plan(parse_fault_plan("serving-dispatch@0"))
+        f1 = eng.submit(samples[0])
+        assert block.entered.wait(30)
+        f2 = eng.submit(samples[1])  # queued before the trip
+        block.release.set()
+        with pytest.raises(InjectedFault):
+            f1.result(timeout=60)
+        with pytest.raises(CircuitOpenError):
+            f2.result(timeout=60)
+    finally:
+        block.release.set()
+        eng.shutdown()

@@ -5,7 +5,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-shard="${1:?usage: ci_shards.sh core|data|train|parallel|zoo|sweep}"
+shard="${1:?usage: ci_shards.sh core|data|train|parallel|robust|zoo|sweep}"
 
 case "$shard" in
   core)
@@ -33,6 +33,15 @@ case "$shard" in
     python -m pytest -q tests/test_multiprocess.py tests/test_composite.py \
       tests/test_pipeline_config.py tests/test_graph_parallel.py \
       tests/test_pipeline.py
+    ;;
+  robust)
+    # infrastructure robustness: input pipeline, packing, serving engine,
+    # fault tolerance (kill/resume + serving failure semantics), env-read
+    # lint, reference shims — files that grew after the original shard
+    # split and were previously in no shard
+    python -m pytest -q tests/test_async_loader.py tests/test_packing.py \
+      tests/test_serving.py tests/test_serving_faults.py \
+      tests/test_faults.py tests/test_env_lint.py tests/test_ref_shims.py
     ;;
   zoo)
     # the 13-model accuracy battery (per-model thresholds)
