@@ -86,6 +86,20 @@ Env knobs:
                        5, 64 serving requests)
   BENCH_FAULTS_OUT     also write the chaos JSON to this path (the
                        nightly chaos-smoke emits BENCH_FAULTS.json)
+  BENCH_PREPROC        =1: preprocessing mode (docs/preprocessing.md) —
+                       vectorized neighbor-construction throughput
+                       (atoms/s, edges/s, speedup vs the embedded seed
+                       implementation; identical edge sets asserted),
+                       cold vs warm preprocessed-cache samples/s with
+                       hit counters, and serial vs parallel sample-build
+                       speedup with a bitwise-equality check
+  BENCH_PREPROC_ATOMS / BENCH_PREPROC_FILES / BENCH_PREPROC_FILE_ATOMS /
+  BENCH_PREPROC_WORKERS
+                       preprocessing-mode scale (default 2048-atom
+                       system, 96 files x 384 atoms, 4 workers)
+  BENCH_PREPROC_OUT    also write the preprocessing JSON to this path
+                       (the nightly preproc-bench emits
+                       BENCH_PREPROC.json)
 """
 import itertools
 import json
@@ -876,6 +890,229 @@ def run_bench_faults(backend=None):
     return out
 
 
+# ---- seed neighbor-construction implementations (pre-fast-path), kept
+# here verbatim as the BENCH_PREPROC baseline so the reported speedup is
+# measured against the exact code this PR replaced, not a strawman ----
+def _seed_cell_list_pairs(pos, r, loop=False):
+    mins = pos.min(axis=0)
+    cell_idx = np.floor((pos - mins) / r).astype(np.int64)
+    dims = cell_idx.max(axis=0) + 1
+    key = (cell_idx[:, 0] * dims[1] + cell_idx[:, 1]) * dims[2] + cell_idx[:, 2]
+    order = np.argsort(key, kind="stable")
+    sorted_key = key[order]
+    starts = np.searchsorted(sorted_key, np.arange(dims.prod()))
+    ends = np.searchsorted(sorted_key, np.arange(dims.prod()), side="right")
+    send_l, recv_l = [], []
+    offsets = [(dx, dy, dz) for dx in (-1, 0, 1) for dy in (-1, 0, 1)
+               for dz in (-1, 0, 1)]
+    r2 = r * r
+    for i in range(pos.shape[0]):
+        c = cell_idx[i]
+        cand = []
+        for dx, dy, dz in offsets:
+            nc = c + (dx, dy, dz)
+            if np.any(nc < 0) or np.any(nc >= dims):
+                continue
+            k = (nc[0] * dims[1] + nc[1]) * dims[2] + nc[2]
+            cand.append(order[starts[k]:ends[k]])
+        cand = np.concatenate(cand) if cand else np.empty(0, np.int64)
+        d2 = np.sum((pos[cand] - pos[i]) ** 2, axis=-1)
+        ok = d2 <= r2
+        if not loop:
+            ok &= cand != i
+        nb = cand[ok]
+        send_l.append(nb)
+        recv_l.append(np.full(nb.shape, i, np.int64))
+    return np.concatenate(send_l), np.concatenate(recv_l)
+
+
+def _seed_radius_graph_pbc(pos, cell, r):
+    recip = np.linalg.inv(cell).T
+    nmax = [int(np.ceil(r / (1.0 / np.linalg.norm(recip[a]))))
+            for a in range(3)]
+    shift_range = [np.arange(-m, m + 1) for m in nmax]
+    sends, recvs, shifts = [], [], []
+    r2 = r * r
+    for sx in shift_range[0]:
+        for sy in shift_range[1]:
+            for sz in shift_range[2]:
+                sh = np.array([sx, sy, sz], np.float64)
+                disp = (pos[None, :, :] + (sh @ cell)[None, None, :]
+                        - pos[:, None, :])
+                d2 = np.sum(disp * disp, axis=-1)
+                ok = d2 <= r2
+                if sx == 0 and sy == 0 and sz == 0:
+                    np.fill_diagonal(ok, False)
+                rc, sd = np.nonzero(ok)
+                sends.append(sd)
+                recvs.append(rc)
+                shifts.append(np.tile(sh, (len(sd), 1)))
+    return np.concatenate(sends), np.concatenate(recvs), np.concatenate(shifts)
+
+
+def run_bench_preproc(backend=None):
+    """BENCH_PREPROC: preprocessing fast-path adjudication
+    (docs/preprocessing.md), three legs.
+
+    1. Neighbor construction: atoms/s and edges/s of the vectorized
+       radius_graph / radius_graph_pbc against the embedded seed
+       implementations on a >=512-atom system (identical edge sets
+       asserted before any timing).
+    2. Preprocessed cache: cold build vs warm (cache-hit) load of a
+       synthetic XYZ directory, samples/s each + hit counters.
+    3. Parallel builds: the same directory built with
+       preprocess_workers 0 vs 4, bitwise-equal outputs asserted.
+    """
+    import shutil
+    import tempfile
+
+    from hydragnn_tpu.graphs.radius import radius_graph, radius_graph_pbc
+
+    if backend is None:
+        backend = _resolve_backend_and_cache()
+    n_atoms = int(os.environ.get("BENCH_PREPROC_ATOMS", "2048"))
+    n_files = int(os.environ.get("BENCH_PREPROC_FILES", "96"))
+    atoms_per_file = int(os.environ.get("BENCH_PREPROC_FILE_ATOMS", "384"))
+    reps = 3
+    rng = np.random.RandomState(0)
+
+    def best(fn):
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn()
+            times.append(time.perf_counter() - t0)
+        return out, min(times)
+
+    # ---- leg 1: open-boundary neighbor construction ----
+    # density tuned for ~30 neighbors/atom, the OC20-ish regime
+    box = (n_atoms * 4.0 * np.pi * 0.343 / (3 * 30.0)) ** (1 / 3)
+    pos = rng.rand(n_atoms, 3) * box
+    radius = 0.7
+    (send, recv), t_new = best(lambda: radius_graph(pos, radius))
+    (s0, r0), t_seed = best(lambda: _seed_cell_list_pairs(
+        pos.astype(np.float64), radius))
+    assert (set(zip(send.tolist(), recv.tolist()))
+            == set(zip(s0.tolist(), r0.tolist()))), "edge-set mismatch"
+    open_stats = {
+        "n_atoms": n_atoms, "n_edges": int(len(send)),
+        "atoms_per_s": n_atoms / t_new, "edges_per_s": len(send) / t_new,
+        "seed_atoms_per_s": n_atoms / t_seed,
+        "speedup_vs_seed": t_seed / t_new,
+    }
+
+    # ---- leg 1b: PBC neighbor construction (8x8x8 supercell, 512 atoms) --
+    reps_cell = np.eye(3) * 8.0
+    frac = rng.rand(512, 3)
+    ppos = frac @ reps_cell
+    (psend, precv, pshift), tp_new = best(
+        lambda: radius_graph_pbc(ppos, reps_cell, 1.2))
+    (ps0, pr0, psh0), tp_seed = best(
+        lambda: _seed_radius_graph_pbc(ppos.astype(np.float64),
+                                       reps_cell, 1.2))
+    ish = np.round(pshift @ np.linalg.inv(
+        reps_cell.astype(np.float32))).astype(int)
+    got = set(zip(psend.tolist(), precv.tolist(), ish[:, 0].tolist(),
+                  ish[:, 1].tolist(), ish[:, 2].tolist()))
+    want = set(zip(ps0.astype(int).tolist(), pr0.astype(int).tolist(),
+                   psh0[:, 0].astype(int).tolist(),
+                   psh0[:, 1].astype(int).tolist(),
+                   psh0[:, 2].astype(int).tolist()))
+    assert got == want, "PBC edge-set mismatch"
+    pbc_stats = {
+        "n_atoms": 512, "n_edges": int(len(psend)),
+        "atoms_per_s": 512 / tp_new, "edges_per_s": len(psend) / tp_new,
+        "seed_atoms_per_s": 512 / tp_seed,
+        "speedup_vs_seed": tp_seed / tp_new,
+    }
+
+    # ---- legs 2+3: cache + parallel builds over a synthetic XYZ dir ----
+    from hydragnn_tpu.datasets.xyzdataset import XYZDataset
+    tmp = tempfile.mkdtemp(prefix="bench_preproc_")
+    rawdir = os.path.join(tmp, "raw")
+    os.makedirs(rawdir)
+    for i in range(n_files):
+        p = rng.rand(atoms_per_file, 3) * 6
+        with open(os.path.join(rawdir, f"s{i:04d}.xyz"), "w") as f:
+            f.write(f"{atoms_per_file}\nbench\n")
+            for j in range(atoms_per_file):
+                f.write(f"6 {p[j, 0]:.8f} {p[j, 1]:.8f} {p[j, 2]:.8f}\n")
+    cfg = {
+        "Dataset": {"format": "XYZ", "path": {"total": rawdir},
+                    "node_features": {"dim": [1], "column_index": [0]}},
+        "NeuralNetwork": {
+            "Architecture": {"radius": 1.5, "max_neighbours": 20,
+                             "edge_features": True},
+            "Variables_of_interest": {"input_node_features": [0],
+                                      "type": ["node"],
+                                      "output_index": [0]},
+            "Training": {"preprocess_workers": 0},
+        },
+    }
+    env_keys = ("HYDRAGNN_PREPROC_WORKERS", "HYDRAGNN_PREPROC_CACHE_DIR")
+    saved_env = {k: os.environ.pop(k, None) for k in env_keys}
+    try:
+        cfg["Dataset"]["preprocessed_cache_dir"] = os.path.join(tmp, "cache")
+        t0 = time.perf_counter()
+        ds_cold = XYZDataset(cfg, rawdir)
+        t_cold = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        ds_warm = XYZDataset(cfg, rawdir)
+        t_warm = time.perf_counter() - t0
+        assert ds_cold.cache_stats["misses"] == 1
+        assert ds_warm.cache_stats["hits"] == 1
+        for a, b in zip(ds_cold.samples, ds_warm.samples):
+            assert np.array_equal(a.senders, b.senders)
+        cache_stats = {
+            "files": n_files,
+            "cold_samples_per_s": n_files / t_cold,
+            "warm_samples_per_s": n_files / t_warm,
+            "warm_speedup": t_cold / t_warm,
+            "cold": ds_cold.cache_stats, "warm": ds_warm.cache_stats,
+        }
+
+        cfg["Dataset"]["preprocessed_cache_dir"] = ""
+        t0 = time.perf_counter()
+        ds_serial = XYZDataset(cfg, rawdir)
+        t_serial = time.perf_counter() - t0
+        workers = int(os.environ.get("BENCH_PREPROC_WORKERS", "4"))
+        cfg["NeuralNetwork"]["Training"]["preprocess_workers"] = workers
+        t0 = time.perf_counter()
+        ds_par = XYZDataset(cfg, rawdir)
+        t_par = time.perf_counter() - t0
+        for a, b in zip(ds_serial.samples, ds_par.samples):
+            assert np.array_equal(a.x, b.x)
+            assert np.array_equal(a.senders, b.senders)
+        parallel_stats = {
+            "workers": workers,
+            "serial_samples_per_s": n_files / t_serial,
+            "parallel_samples_per_s": n_files / t_par,
+            "parallel_speedup": t_serial / t_par,
+            "bitwise_equal": True,
+        }
+    finally:
+        for k, v in saved_env.items():
+            if v is not None:
+                os.environ[k] = v
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    out = {
+        "metric": "preproc_nbr_speedup",
+        "value": open_stats["speedup_vs_seed"],
+        "unit": "x vs seed neighbor construction",
+        "backend": backend,
+        "neighbor_open": open_stats,
+        "neighbor_pbc": pbc_stats,
+        "cache": cache_stats,
+        "parallel": parallel_stats,
+    }
+    out_path = os.environ.get("BENCH_PREPROC_OUT", "").strip()
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1)
+    return out
+
+
 def sweep():
     """Run the (nbr-layout x pallas x steps-per-call) grid, each point in a
     fresh subprocess (the flags are read once per process), and report the
@@ -920,6 +1157,8 @@ def main():
         out = run_bench_serve()
     elif os.environ.get("BENCH_FAULTS") == "1":
         out = run_bench_faults()
+    elif os.environ.get("BENCH_PREPROC") == "1":
+        out = run_bench_preproc()
     else:
         out = run_bench()
     print(json.dumps(out))

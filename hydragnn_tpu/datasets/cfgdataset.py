@@ -19,7 +19,7 @@ import numpy as np
 
 from ..graphs.batch import GraphSample
 from ..preprocess.load_data import split_dataset
-from ..preprocess.transforms import build_graph_sample, normalize_edge_lengths
+from ..preprocess.transforms import normalize_edge_lengths
 from ..utils.elements import symbol_to_z
 from .lsmsdataset import _minmax_normalize, normalize_sidecar_graph_targets
 from .xyzdataset import _read_sidecar_graph_feats
@@ -86,39 +86,70 @@ def parse_cfg_file(filepath: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     return feats, pos.astype(np.float32), h0.astype(np.float32)
 
 
+def _parse_cfg_entry(fp: str, gf_dims, gf_cols):
+    """One structure + its sidecar graph target (module-level so the
+    preprocessing worker pool can pickle it)."""
+    feats, pos, cell = parse_cfg_file(fp)
+    gfeat = _read_sidecar_graph_feats(
+        os.path.splitext(fp)[0] + ".bulk", gf_dims, gf_cols)
+    return feats, pos, cell, gfeat
+
+
 class CFGDataset:
     """Directory of ``*.cfg`` files (+ optional ``*.bulk`` graph-target
     sidecars) -> GraphSamples."""
 
     def __init__(self, config: Dict, dirpath: str):
+        import functools
+
+        from ..preprocess.cache import cached_sample_build
+        from ..preprocess.transforms import build_graph_samples
+        from ..preprocess.load_data import resolve_preprocess_settings
+        from ..preprocess.workers import parallel_map
         ds = config["Dataset"]
         gf = ds.get("graph_features", {"dim": [], "column_index": []})
         files = sorted(glob.glob(os.path.join(dirpath, "*.cfg")))
         if not files:
             raise FileNotFoundError(f"no .cfg files in {dirpath}")
-        feats_all, pos_all, cell_all, gfeat_all = [], [], [], []
-        for fp in files:
-            feats, pos, cell = parse_cfg_file(fp)
-            gfeat = _read_sidecar_graph_feats(
-                os.path.splitext(fp)[0] + ".bulk",
-                gf["dim"], gf["column_index"])
-            feats_all.append(feats)
-            pos_all.append(pos)
-            cell_all.append(cell)
-            gfeat_all.append(gfeat)
-        # dataset-wide min-max feature normalization (reference:
-        # AbstractRawDataset normalize, utils/datasets/abstractrawdataset.py:29)
-        feats_all, self.minmax_node_feature = _minmax_normalize(feats_all)
         needs_graph_target = "graph" in config["NeuralNetwork"][
             "Variables_of_interest"]["type"]
-        gfeat_all, self.minmax_graph_feature = normalize_sidecar_graph_targets(
-            gfeat_all, gf["dim"], needs_graph_target, ".bulk", dirpath)
-        self.samples = []
-        for feats, pos, cell, gfeat in zip(feats_all, pos_all, cell_all,
-                                           gfeat_all):
-            self.samples.append(build_graph_sample(
-                feats, pos, config, graph_feats=gfeat, cell=cell))
-        normalize_edge_lengths(self.samples)
+        workers, _ = resolve_preprocess_settings(config)
+
+        def build():
+            parse = functools.partial(_parse_cfg_entry, gf_dims=gf["dim"],
+                                      gf_cols=gf["column_index"])
+            parsed = parallel_map(parse, files, workers=workers,
+                                  what="cfg file", labels=files)
+            feats_all = [p[0] for p in parsed]
+            pos_all = [p[1] for p in parsed]
+            cell_all = [p[2] for p in parsed]
+            gfeat_all = [p[3] for p in parsed]
+            # dataset-wide min-max feature normalization (reference:
+            # AbstractRawDataset normalize,
+            # utils/datasets/abstractrawdataset.py:29)
+            feats_all, mm_node = _minmax_normalize(feats_all)
+            gfeat_all, mm_graph = normalize_sidecar_graph_targets(
+                gfeat_all, gf["dim"], needs_graph_target, ".bulk", dirpath)
+            samples = build_graph_samples(
+                [dict(node_feature_matrix=feats, pos=pos, graph_feats=gfeat,
+                      cell=cell)
+                 for feats, pos, cell, gfeat in zip(feats_all, pos_all,
+                                                    cell_all, gfeat_all)],
+                config, workers=workers)
+            normalize_edge_lengths(samples)
+            return samples, {"minmax_node_feature": mm_node,
+                             "minmax_graph_feature": mm_graph}
+
+        sidecars = [s for s in (os.path.splitext(fp)[0] + ".bulk"
+                                for fp in files) if os.path.isfile(s)]
+        self.samples, extra, self.cache_stats = cached_sample_build(
+            config, files + sidecars, build,
+            extra_key={"loader": "CFGDataset",
+                       "dir": os.path.abspath(dirpath)})
+        self.minmax_node_feature = (
+            extra.get("minmax_node_feature") if extra else None)
+        self.minmax_graph_feature = (
+            extra.get("minmax_graph_feature") if extra else None)
 
     def __len__(self):
         return len(self.samples)

@@ -18,8 +18,7 @@ import numpy as np
 
 from ..graphs.batch import GraphSample
 from ..preprocess.load_data import split_dataset
-from ..preprocess.transforms import (build_graph_sample,
-                                     normalize_edge_lengths)
+from ..preprocess.transforms import normalize_edge_lengths
 
 
 def parse_lsms_file(filepath: str, node_feature_dims: Sequence[int],
@@ -93,35 +92,59 @@ class LSMSDataset:
     the LSMS format."""
 
     def __init__(self, config: Dict, dirpath: str):
+        import functools
+
+        from ..preprocess.cache import cached_sample_build
+        from ..preprocess.transforms import build_graph_samples
+        from ..preprocess.load_data import resolve_preprocess_settings
+        from ..preprocess.workers import parallel_map
         ds = config["Dataset"]
         nf = ds["node_features"]
         gf = ds.get("graph_features", {"dim": [], "column_index": []})
         files = sorted(glob.glob(os.path.join(dirpath, "*")))
         files = [f for f in files if os.path.isfile(f)]
-        node_mats, poss, gfeats = [], [], []
-        for fp in files:
-            n, p, g = parse_lsms_file(
-                fp, nf["dim"], nf["column_index"], gf["dim"],
-                gf["column_index"],
-                apply_charge_density=ds.get("name", "").startswith("FePt"))
-            node_mats.append(n)
-            poss.append(p)
-            gfeats.append(g)
-        if not node_mats:
+        if not files:
             raise FileNotFoundError(f"no LSMS files found in {dirpath}")
-        # dataset-wide min-max normalization (reference: abstractrawdataset
-        # normalize; unit-test path keeps raw values in [0,1] already)
-        node_mats, self.minmax_node_feature = _minmax_normalize(node_mats)
-        if gfeats[0].size:
-            gfeats, self.minmax_graph_feature = _minmax_normalize(
-                [g[None, :] for g in gfeats])
-            gfeats = [g[0] for g in gfeats]
-        else:
-            self.minmax_graph_feature = None
-        self.samples = [
-            build_graph_sample(n, p, config, graph_feats=g)
-            for n, p, g in zip(node_mats, poss, gfeats)]
-        normalize_edge_lengths(self.samples)
+        workers, _ = resolve_preprocess_settings(config)
+
+        def build():
+            parse = functools.partial(
+                parse_lsms_file, node_feature_dims=nf["dim"],
+                node_feature_cols=nf["column_index"],
+                graph_feature_dims=gf["dim"],
+                graph_feature_cols=gf["column_index"],
+                apply_charge_density=ds.get("name", "").startswith("FePt"))
+            parsed = parallel_map(parse, files, workers=workers,
+                                  what="LSMS file", labels=files)
+            node_mats = [p[0] for p in parsed]
+            poss = [p[1] for p in parsed]
+            gfeats = [p[2] for p in parsed]
+            # dataset-wide min-max normalization (reference:
+            # abstractrawdataset normalize; unit-test path keeps raw values
+            # in [0,1] already)
+            node_mats, mm_node = _minmax_normalize(node_mats)
+            if gfeats[0].size:
+                gfeats, mm_graph = _minmax_normalize(
+                    [g[None, :] for g in gfeats])
+                gfeats = [g[0] for g in gfeats]
+            else:
+                mm_graph = None
+            samples = build_graph_samples(
+                [dict(node_feature_matrix=n, pos=p, graph_feats=g)
+                 for n, p, g in zip(node_mats, poss, gfeats)],
+                config, workers=workers)
+            normalize_edge_lengths(samples)
+            return samples, {"minmax_node_feature": mm_node,
+                             "minmax_graph_feature": mm_graph}
+
+        self.samples, extra, self.cache_stats = cached_sample_build(
+            config, files, build,
+            extra_key={"loader": "LSMSDataset",
+                       "dir": os.path.abspath(dirpath)})
+        self.minmax_node_feature = (
+            extra.get("minmax_node_feature") if extra else None)
+        self.minmax_graph_feature = (
+            extra.get("minmax_graph_feature") if extra else None)
 
     def __len__(self):
         return len(self.samples)

@@ -51,11 +51,14 @@ class AbstractRawDataset(AbstractBaseDataset):
         self.normalize = bool(ds.get("normalize_features", False))
         self.minmax_node_feature = None
         self.minmax_graph_feature = None
-        raws: List[RawSample] = []
-        parse_err: Optional[Exception] = None
+        self._dist = dist
         path_dict = ds["path"]
         if isinstance(path_dict, str):
             path_dict = {"total": path_dict}
+        self._paths = sorted(path_dict.values())
+
+        world = rank = None
+        fps: List[str] = []
         for _split, raw_path in sorted(path_dict.items()):
             if not os.path.isabs(raw_path):
                 raw_path = os.path.join(os.getcwd(), raw_path)
@@ -86,36 +89,27 @@ class AbstractRawDataset(AbstractBaseDataset):
                 filelist = filelist[rank::world]
             for name in filelist:
                 fp = os.path.join(raw_path, name)
-                if not os.path.isfile(fp):  # deleted since the listdir
-                    continue
-                try:
-                    raw = self.transform_input_to_data_object_base(
-                        filepath=fp)
-                except Exception as exc:  # noqa: BLE001
-                    if not dist:
-                        raise  # single process: fail fast
-                    # dist: defer so the failure is exchanged with the
-                    # peers before any collective (see _validate) instead
-                    # of stranding them in it
-                    parse_err = parse_err or ValueError(
-                        f"transform_input_to_data_object_base failed on "
-                        f"{fp}: {type(exc).__name__}: {exc}")
-                    continue
-                if raw is not None:
-                    if raw.graph_features is not None:
-                        # enforce the documented 1-D [C_graph] contract —
-                        # a 2-D array would alias whole rows in the
-                        # per-num-nodes column scaling below
-                        raw.graph_features = np.asarray(
-                            raw.graph_features, np.float32).ravel()
-                    raws.append(raw)
-        self._dist = dist
-        self._validate(raws, sorted(path_dict.values()), parse_err)
-        self._scale_features_by_num_nodes(raws)
-        if self.normalize:
-            self._normalize(raws)
-        for raw in raws:
-            self.dataset.append(self._build(raw))
+                if os.path.isfile(fp):  # may be deleted since the listdir
+                    fps.append(fp)
+
+        from ..preprocess.cache import cached_sample_build
+        from ..preprocess.load_data import resolve_preprocess_settings
+        self._preproc_workers, _ = resolve_preprocess_settings(config)
+        # content-addressed preprocessed cache (docs/preprocessing.md):
+        # a warm hit skips parse + neighbor construction entirely. The
+        # per-rank shard coordinates are part of the key (each rank
+        # caches its own nsplit shard), and under multi-process the
+        # hit decision is agreed across ranks — a mixed hit/miss would
+        # desync the min-max collectives inside the build.
+        extra_key = {"loader": type(self).__name__, "dist": bool(dist),
+                     "sampling": sampling, "world": world, "rank": rank}
+        samples, extra, self.cache_stats = cached_sample_build(
+            config, fps, lambda: self._build_all(fps),
+            extra_key=extra_key, agree_fn=self._cache_agree)
+        if extra is not None:
+            self.minmax_node_feature = extra.get("minmax_node_feature")
+            self.minmax_graph_feature = extra.get("minmax_graph_feature")
+        self.dataset.extend(samples)
 
     # ------------------------------------------------------------- hook --
     @abstractmethod
@@ -125,6 +119,74 @@ class AbstractRawDataset(AbstractBaseDataset):
         (reference: abstractrawdataset.py:292-294)."""
 
     # -------------------------------------------------------- pipeline --
+    def _parse_one(self, fp: str):
+        return self.transform_input_to_data_object_base(filepath=fp)
+
+    def _parse_guarded(self, fp: str):
+        """dist-mode parse: capture any failure as a message naming the
+        file — errors must cross the worker-process boundary AND be
+        deferred (exchanged with peers before any collective, see
+        _validate) instead of stranding them in it."""
+        try:
+            return True, self.transform_input_to_data_object_base(
+                filepath=fp)
+        except Exception as exc:  # noqa: BLE001
+            return False, (f"transform_input_to_data_object_base failed on "
+                           f"{fp}: {type(exc).__name__}: {exc}")
+
+    def _build_all(self, fps: List[str]):
+        """The full raw→GraphSample pipeline (cache-miss path): parallel
+        parse, validation, scaling, normalization, parallel graph builds.
+        Deterministic for any worker count — parallel_map preserves input
+        order and every stage is pure numpy."""
+        from ..preprocess.workers import parallel_map
+        if self._dist:
+            parsed = parallel_map(self._parse_guarded, fps,
+                                  workers=self._preproc_workers,
+                                  what="raw file", labels=fps)
+        else:
+            # single process: fail fast — parallel_map raises
+            # PreprocessError naming the file at the first failure (the
+            # serial path stops parsing immediately), original chained
+            parsed = [(True, raw) for raw in parallel_map(
+                self._parse_one, fps, workers=self._preproc_workers,
+                what="raw file", labels=fps)]
+        raws: List[RawSample] = []
+        parse_err: Optional[Exception] = None
+        for fp, (ok, payload) in zip(fps, parsed):
+            if not ok:
+                parse_err = parse_err or ValueError(payload)
+                continue
+            raw = payload
+            if raw is not None:
+                if raw.graph_features is not None:
+                    # enforce the documented 1-D [C_graph] contract —
+                    # a 2-D array would alias whole rows in the
+                    # per-num-nodes column scaling below
+                    raw.graph_features = np.asarray(
+                        raw.graph_features, np.float32).ravel()
+                raws.append(raw)
+        self._validate(raws, self._paths, parse_err)
+        self._scale_features_by_num_nodes(raws)
+        if self.normalize:
+            self._normalize(raws)
+        samples = parallel_map(self._build, raws,
+                               workers=self._preproc_workers,
+                               what="raw sample")
+        return samples, {"minmax_node_feature": self.minmax_node_feature,
+                         "minmax_graph_feature": self.minmax_graph_feature}
+
+    def _cache_agree(self, local_hit: bool) -> bool:
+        """All-ranks cache-hit agreement: serve the cache only when every
+        rank hit, else every rank rebuilds (keeping the collective
+        normalization in lockstep)."""
+        import jax
+        if not self._dist or jax.process_count() == 1:
+            return local_hit
+        from jax.experimental import multihost_utils
+        flags = multihost_utils.process_allgather(
+            np.asarray([int(local_hit)], np.int32))
+        return bool(int(flags.min()))
     def _validate(self, raws: List[RawSample], paths,
                   parse_err: Optional[Exception] = None):
         """Empty-shard / parse-failure / mixed-graph-features / feature-width

@@ -19,7 +19,7 @@ import numpy as np
 
 from ..graphs.batch import GraphSample
 from ..preprocess.load_data import split_dataset
-from ..preprocess.transforms import build_graph_sample, normalize_edge_lengths
+from ..preprocess.transforms import normalize_edge_lengths
 from ..utils.elements import symbol_to_z
 
 
@@ -61,11 +61,26 @@ def _read_sidecar_graph_feats(filepath: str, graph_feature_dims,
     return np.asarray(feats, np.float32)
 
 
+def _parse_xyz_entry(fp: str, gf_dims, gf_cols):
+    """One structure + its sidecar graph target (module-level so the
+    preprocessing worker pool can pickle it)."""
+    z, pos, cell = parse_xyz_file(fp)
+    gfeat = _read_sidecar_graph_feats(
+        os.path.splitext(fp)[0] + "_energy.txt", gf_dims, gf_cols)
+    return z, pos, cell, gfeat
+
+
 class XYZDataset:
     """Directory of ``*.xyz`` files (+ ``*_energy.txt`` graph-target
     sidecars) -> GraphSamples through the standard raw pipeline."""
 
     def __init__(self, config: Dict, dirpath: str):
+        import functools
+
+        from ..preprocess.cache import cached_sample_build
+        from ..preprocess.transforms import build_graph_samples
+        from ..preprocess.load_data import resolve_preprocess_settings
+        from ..preprocess.workers import parallel_map
         ds = config["Dataset"]
         gf = ds.get("graph_features", {"dim": [], "column_index": []})
         files = sorted(glob.glob(os.path.join(dirpath, "*.xyz")))
@@ -73,28 +88,44 @@ class XYZDataset:
             raise FileNotFoundError(f"no .xyz files in {dirpath}")
         needs_graph_target = "graph" in config["NeuralNetwork"][
             "Variables_of_interest"]["type"]
-        z_all, pos_all, cell_all, gfeat_all = [], [], [], []
-        for fp in files:
-            z, pos, cell = parse_xyz_file(fp)
-            sidecar = os.path.splitext(fp)[0] + "_energy.txt"
-            gfeat = _read_sidecar_graph_feats(
-                sidecar, gf["dim"], gf["column_index"])
-            z_all.append(z)
-            pos_all.append(pos)
-            cell_all.append(cell)
-            gfeat_all.append(gfeat)
-        # dataset-wide min-max normalization of graph targets (reference:
-        # AbstractRawDataset normalize, utils/datasets/abstractrawdataset.py:29;
-        # node features here are bare atomic numbers, left unscaled)
-        from .lsmsdataset import normalize_sidecar_graph_targets
+        workers, _ = resolve_preprocess_settings(config)
+
+        def build():
+            parse = functools.partial(_parse_xyz_entry, gf_dims=gf["dim"],
+                                      gf_cols=gf["column_index"])
+            parsed = parallel_map(parse, files, workers=workers,
+                                  what="xyz file", labels=files)
+            z_all = [p[0] for p in parsed]
+            pos_all = [p[1] for p in parsed]
+            cell_all = [p[2] for p in parsed]
+            gfeat_all = [p[3] for p in parsed]
+            # dataset-wide min-max normalization of graph targets
+            # (reference: AbstractRawDataset normalize,
+            # utils/datasets/abstractrawdataset.py:29; node features here
+            # are bare atomic numbers, left unscaled)
+            from .lsmsdataset import normalize_sidecar_graph_targets
+            gfeat_all, mm_graph = normalize_sidecar_graph_targets(
+                gfeat_all, gf["dim"], needs_graph_target, "*_energy.txt",
+                dirpath)
+            samples = build_graph_samples(
+                [dict(node_feature_matrix=z, pos=pos, graph_feats=gfeat,
+                      cell=cell)
+                 for z, pos, cell, gfeat in zip(z_all, pos_all, cell_all,
+                                                gfeat_all)],
+                config, workers=workers)
+            normalize_edge_lengths(samples)
+            return samples, {"minmax_node_feature": None,
+                             "minmax_graph_feature": mm_graph}
+
+        sidecars = [s for s in (os.path.splitext(fp)[0] + "_energy.txt"
+                                for fp in files) if os.path.isfile(s)]
+        self.samples, extra, self.cache_stats = cached_sample_build(
+            config, files + sidecars, build,
+            extra_key={"loader": "XYZDataset",
+                       "dir": os.path.abspath(dirpath)})
         self.minmax_node_feature = None
-        gfeat_all, self.minmax_graph_feature = normalize_sidecar_graph_targets(
-            gfeat_all, gf["dim"], needs_graph_target, "*_energy.txt", dirpath)
-        self.samples = []
-        for z, pos, cell, gfeat in zip(z_all, pos_all, cell_all, gfeat_all):
-            self.samples.append(build_graph_sample(
-                z, pos, config, graph_feats=gfeat, cell=cell))
-        normalize_edge_lengths(self.samples)
+        self.minmax_graph_feature = (
+            extra.get("minmax_graph_feature") if extra else None)
 
     def __len__(self):
         return len(self.samples)

@@ -2,10 +2,32 @@
 
 Replaces the reference's PyG ``RadiusGraph`` wrapper and its ase-neighborlist
 PBC variant (reference: hydragnn/preprocess/graph_samples_checks_and_updates.py:102-171).
-Pure numpy: a cell-list algorithm for O(N) open-boundary graphs and an image
--shift enumeration for PBC, with the same duplicate-edge guard the reference
-applies (RadiusGraphPBC.__call__ raises on duplicate edges from too-small
-cells; here we keep shift vectors per edge so duplicates are legal and exact).
+Pure numpy: a vectorized cell-list algorithm for O(N + E) open-boundary
+graphs, and the same machinery over pruned ghost/image atoms for PBC, with
+the same duplicate-edge guard the reference applies (RadiusGraphPBC.__call__
+raises on duplicate edges from too-small cells; here we keep shift vectors
+per edge so duplicates are legal and exact).
+
+There are **zero per-atom Python loops** on the construction path
+(docs/preprocessing.md): the only Python-level loop runs over the 27 cell
+offsets, each iteration a whole-array numpy expansion (sorted cell keys +
+``searchsorted`` over the *occupied* cells only — sparse, widely separated
+systems never allocate a dense grid). The former per-atom loop and the
+dense N×N-per-shift PBC enumeration cost O(N²·images); this path is
+O(N + E) and is adjudicated against a brute-force oracle in
+tests/test_radius_fast.py and for throughput in bench.py BENCH_PREPROC.
+
+Determinism contract:
+* open-boundary edges are emitted receiver-major, sender-ascending — the
+  exact order of the dense reference path, so the n=512↔513 implementation
+  straddle is bitwise-invisible;
+* PBC edges are emitted receiver-major, then sender, then shift-id
+  ascending (shift ids enumerate (sx, sy, sz) lexicographically);
+* ``max_neighbours`` truncation keeps, per receiver, the ``k`` smallest
+  (d², sender[, shift-id]) in that lexicographic key order — a total
+  order, so the kept edge set is bitwise-reproducible across runs,
+  worker counts, and platforms regardless of construction order. The
+  pack-plan (PR 2) and resume (PR 4) contracts depend on this.
 
 Runs in the input pipeline, never inside jit — graph construction is
 data-dependent and belongs on the host, feeding static-shape batches to XLA.
@@ -15,6 +37,13 @@ from __future__ import annotations
 from typing import Optional, Tuple
 
 import numpy as np
+
+# below this node count the dense O(N²) path wins on constant factors; the
+# cell-list path must stay edge-for-edge identical across the boundary
+# (tests/test_radius_fast.py::test_dense_cell_list_straddle)
+_DENSE_MAX = 512
+
+_EMPTY_I64 = np.empty(0, np.int64)
 
 
 def radius_graph(
@@ -31,7 +60,9 @@ def radius_graph(
     """
     pos = np.asarray(pos, dtype=np.float64)
     n = pos.shape[0]
-    if n <= 512:
+    if n == 0:
+        return np.empty(0, np.int32), np.empty(0, np.int32)
+    if n <= _DENSE_MAX:
         d2 = np.sum((pos[:, None, :] - pos[None, :, :]) ** 2, axis=-1)
         adj = d2 <= r * r
         if not loop:
@@ -40,50 +71,113 @@ def radius_graph(
     else:
         send, recv = _cell_list_pairs(pos, r, loop)
     if max_neighbours is not None and len(recv):
-        send, recv = _cap_neighbours(pos, send, recv, max_neighbours)
+        d2 = np.sum((pos[send] - pos[recv]) ** 2, axis=-1)
+        keep = _cap_neighbours(d2, recv, max_neighbours, send)
+        send, recv = send[keep], recv[keep]
     return send.astype(np.int32), recv.astype(np.int32)
 
 
+def _compress_cells(coords: np.ndarray) -> np.ndarray:
+    """Adjacency-preserving per-axis compression of integer cell coords.
+
+    Maps each axis through its sorted unique values with gaps clamped to 2:
+    a coordinate difference of 0/1 stays 0/1 (same/adjacent cell), any
+    larger gap becomes exactly 2 (still non-adjacent). Keeps the packed
+    scalar keys below int64 overflow (each axis extent ≤ 2·N) and costs
+    O(N log N) regardless of how widely separated the atoms are — the
+    former dense ``dims.prod()`` grid exploded for sparse systems.
+    """
+    out = np.empty_like(coords)
+    for a in range(coords.shape[1]):
+        u = np.unique(coords[:, a])
+        comp = np.concatenate(
+            ([0], np.cumsum(np.minimum(np.diff(u), 2))))
+        out[:, a] = comp[np.searchsorted(u, coords[:, a])]
+    return out
+
+
+def _cell_candidate_blocks(grid_pos: np.ndarray, query_pos: np.ndarray,
+                           r: float):
+    """Yield (cand, center) whole-array candidate index blocks: for each of
+    the 27 cell offsets, grid points in cell(center)+offset for every query
+    point. Only *occupied* cells are materialized (hashed via sorted unique
+    keys), so memory is O(N), never O(grid volume).
+
+    Query cell coordinates must coincide with grid cell coordinates for the
+    compression mapping to be exact — callers pass query points that are a
+    subset of the grid points (open boundary: identical; PBC: the real atoms
+    within the ghost array).
+    """
+    mins = grid_pos.min(axis=0)
+    # bin width a hair above r: a pair at distance exactly r can then never
+    # land 2 cells apart through floating-point rounding of the floor
+    inv = 1.0 / (float(r) * (1.0 + 1e-9))
+    gcell = np.floor((grid_pos - mins) * inv).astype(np.int64)
+    qcell = np.floor((query_pos - mins) * inv).astype(np.int64)
+    both = _compress_cells(np.concatenate([gcell, qcell]))
+    gcell, qcell = both[: len(gcell)], both[len(gcell):]
+    dims = gcell.max(axis=0) + 1
+    gkey = (gcell[:, 0] * dims[1] + gcell[:, 1]) * dims[2] + gcell[:, 2]
+    order = np.argsort(gkey, kind="stable")
+    skey = gkey[order]
+    uniq, starts = np.unique(skey, return_index=True)
+    counts = np.diff(np.append(starts, len(skey)))
+    nq = qcell.shape[0]
+    centers = np.arange(nq, dtype=np.int64)
+    for dx in (-1, 0, 1):
+        for dy in (-1, 0, 1):
+            for dz in (-1, 0, 1):
+                nc = qcell + (dx, dy, dz)
+                valid = np.logical_and(nc >= 0, nc < dims).all(axis=1)
+                nkey = (nc[:, 0] * dims[1] + nc[:, 1]) * dims[2] + nc[:, 2]
+                j = np.searchsorted(uniq, nkey)
+                jc = np.minimum(j, len(uniq) - 1)
+                hit = valid & (uniq[jc] == nkey)
+                cnt = np.where(hit, counts[jc], 0)
+                total = int(cnt.sum())
+                if total == 0:
+                    continue
+                center = np.repeat(centers, cnt)
+                # intra-run offsets: position within each center's block
+                intra = np.arange(total) - np.repeat(
+                    np.cumsum(cnt) - cnt, cnt)
+                cand = order[np.repeat(starts[jc], cnt) + intra]
+                yield cand, center
+
+
 def _cell_list_pairs(pos, r, loop):
-    mins = pos.min(axis=0)
-    cell_idx = np.floor((pos - mins) / r).astype(np.int64)
-    dims = cell_idx.max(axis=0) + 1
-    key = (cell_idx[:, 0] * dims[1] + cell_idx[:, 1]) * dims[2] + cell_idx[:, 2]
-    order = np.argsort(key, kind="stable")
-    sorted_key = key[order]
-    starts = np.searchsorted(sorted_key, np.arange(dims.prod()))
-    ends = np.searchsorted(sorted_key, np.arange(dims.prod()), side="right")
-    send_l, recv_l = [], []
-    offsets = [(dx, dy, dz) for dx in (-1, 0, 1) for dy in (-1, 0, 1)
-               for dz in (-1, 0, 1)]
+    """Vectorized open-boundary pair search. Emits edges in the dense
+    reference order (receiver-major, sender ascending)."""
     r2 = r * r
-    for i in range(pos.shape[0]):
-        c = cell_idx[i]
-        cand = []
-        for dx, dy, dz in offsets:
-            nc = c + (dx, dy, dz)
-            if np.any(nc < 0) or np.any(nc >= dims):
-                continue
-            k = (nc[0] * dims[1] + nc[1]) * dims[2] + nc[2]
-            cand.append(order[starts[k]:ends[k]])
-        cand = np.concatenate(cand) if cand else np.empty(0, np.int64)
-        d2 = np.sum((pos[cand] - pos[i]) ** 2, axis=-1)
+    send_l, recv_l = [], []
+    for cand, center in _cell_candidate_blocks(pos, pos, r):
+        d2 = np.sum((pos[cand] - pos[center]) ** 2, axis=-1)
         ok = d2 <= r2
         if not loop:
-            ok &= cand != i
-        nb = cand[ok]
-        send_l.append(nb)
-        recv_l.append(np.full(nb.shape, i, np.int64))
-    return np.concatenate(send_l), np.concatenate(recv_l)
+            ok &= cand != center
+        send_l.append(cand[ok])
+        recv_l.append(center[ok])
+    send = np.concatenate(send_l) if send_l else _EMPTY_I64
+    recv = np.concatenate(recv_l) if recv_l else _EMPTY_I64
+    order = np.lexsort((send, recv))
+    return send[order], recv[order]
 
 
-def _cap_neighbours(pos, send, recv, max_neighbours):
-    d2 = np.sum((pos[send] - pos[recv]) ** 2, axis=-1)
-    order = np.lexsort((d2, recv))
-    send, recv, d2 = send[order], recv[order], d2[order]
-    rank = np.arange(len(recv)) - np.searchsorted(recv, recv, side="left")
-    keep = rank < max_neighbours
-    return send[keep], recv[keep]
+def _cap_neighbours(d2: np.ndarray, recv: np.ndarray, max_neighbours: int,
+                    *tie_keys: np.ndarray) -> np.ndarray:
+    """Keep mask selecting, per receiver, the ``max_neighbours`` edges
+    smallest under the total order (d², *tie_keys) — lexsort keyed
+    (recv, d², tie_keys...), so truncation is bitwise-reproducible across
+    runs and platforms independent of the input edge order
+    (docs/preprocessing.md; the pack-plan/resume contracts need
+    deterministic edge counts). Returns a boolean mask in input order.
+    """
+    order = np.lexsort(tuple(reversed(tie_keys)) + (d2, recv))
+    srecv = recv[order]
+    rank = np.arange(len(srecv)) - np.searchsorted(srecv, srecv, side="left")
+    keep = np.zeros(len(recv), bool)
+    keep[order[rank < max_neighbours]] = True
+    return keep
 
 
 def radius_graph_pbc(
@@ -95,15 +189,24 @@ def radius_graph_pbc(
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """PBC radius graph: returns (senders, receivers, shifts).
 
-    ``shifts[k]`` is the integer image vector such that the displacement of
-    edge k is ``pos[send] + shifts @ cell - pos[recv]``. The reference keeps
-    ``edge_shifts`` on the Data object for the same purpose
+    ``shifts[k]`` is the cartesian image displacement such that the
+    displacement of edge k is ``pos[send] + shifts - pos[recv]``. The
+    reference keeps ``edge_shifts`` on the Data object for the same purpose
     (reference: graph_samples_checks_and_updates.py:134-171;
     hydragnn/utils/model/operations.py:20).
+
+    Implementation: ghost/image atoms — every periodic image within the
+    shift range is materialized once, pruned to the bounding box of the
+    real atoms inflated by ``r``, and the open-boundary cell-list machinery
+    searches real→ghost pairs. Cost O(N + E) instead of the former dense
+    O(N²·images) per-shift enumeration.
     """
     pos = np.asarray(pos, dtype=np.float64)
     cell = np.asarray(cell, dtype=np.float64).reshape(3, 3)
     n = pos.shape[0]
+    if n == 0:
+        return (np.empty(0, np.int32), np.empty(0, np.int32),
+                np.empty((0, 3), np.float32))
     # number of images needed per axis: ceil(r / plane-distance)
     recip = np.linalg.inv(cell).T  # rows = reciprocal vectors / 2pi
     nmax = []
@@ -113,32 +216,52 @@ def radius_graph_pbc(
             nmax.append(int(np.ceil(r / plane_d)))
         else:
             nmax.append(0)
-    shift_range = [np.arange(-m, m + 1) for m in nmax]
-    sends, recvs, shifts = [], [], []
+    # integer shifts enumerated (sx, sy, sz)-lexicographically: shift id 0
+    # is the most-negative image; the all-zero shift sits at index
+    # `zero_id`. The id is the deterministic tie key for truncation.
+    ax = [np.arange(-m, m + 1) for m in nmax]
+    sx, sy, sz = np.meshgrid(ax[0], ax[1], ax[2], indexing="ij")
+    shifts_int = np.stack([sx.ravel(), sy.ravel(), sz.ravel()],
+                          axis=1).astype(np.float64)  # [S, 3]
+    s_total = shifts_int.shape[0]
+    zero_id = int(np.nonzero((shifts_int == 0).all(axis=1))[0][0])
+
+    # ghosts: image s of atom j lands at index s*n + j
+    ghost_pos = (pos[None, :, :]
+                 + (shifts_int @ cell)[:, None, :]).reshape(-1, 3)
+    ghost_src = np.tile(np.arange(n, dtype=np.int64), s_total)
+    ghost_sid = np.repeat(np.arange(s_total, dtype=np.int64), n)
+    # prune images that cannot reach any real atom; the zero-shift block is
+    # always inside the box, so the grid keeps the query points it needs
+    lo, hi = pos.min(axis=0) - r, pos.max(axis=0) + r
+    keep = np.logical_and(ghost_pos >= lo, ghost_pos <= hi).all(axis=1)
+    keep[zero_id * n:(zero_id + 1) * n] = True
+    ghost_pos = ghost_pos[keep]
+    ghost_src = ghost_src[keep]
+    ghost_sid = ghost_sid[keep]
+
     r2 = r * r
-    for sx in shift_range[0]:
-        for sy in shift_range[1]:
-            for sz in shift_range[2]:
-                sh = np.array([sx, sy, sz], np.float64)
-                disp = pos[None, :, :] + (sh @ cell)[None, None, :] - pos[:, None, :]
-                d2 = np.sum(disp * disp, axis=-1)  # [recv, send]
-                ok = d2 <= r2
-                if sx == 0 and sy == 0 and sz == 0:
-                    np.fill_diagonal(ok, False)
-                rc, sd = np.nonzero(ok)
-                sends.append(sd)
-                recvs.append(rc)
-                shifts.append(np.tile(sh, (len(sd), 1)))
-    send = np.concatenate(sends)
-    recv = np.concatenate(recvs)
-    shift = np.concatenate(shifts)
+    send_l, recv_l, sid_l = [], [], []
+    for cand, center in _cell_candidate_blocks(ghost_pos, pos, r):
+        d2 = np.sum((ghost_pos[cand] - pos[center]) ** 2, axis=-1)
+        ok = d2 <= r2
+        # exclude only the self edge in the home image; images of the same
+        # atom are legal neighbors (small cells)
+        ok &= ~((ghost_src[cand] == center) & (ghost_sid[cand] == zero_id))
+        send_l.append(ghost_src[cand[ok]])
+        recv_l.append(center[ok])
+        sid_l.append(ghost_sid[cand[ok]])
+    send = np.concatenate(send_l) if send_l else _EMPTY_I64
+    recv = np.concatenate(recv_l) if recv_l else _EMPTY_I64
+    sid = np.concatenate(sid_l) if sid_l else _EMPTY_I64
+    # canonical order: receiver-major, sender, shift id
+    order = np.lexsort((sid, send, recv))
+    send, recv, sid = send[order], recv[order], sid[order]
+    shift = shifts_int[sid]
     if max_neighbours is not None and len(recv):
         disp = pos[send] + shift @ cell - pos[recv]
         d2 = np.sum(disp * disp, axis=-1)
-        order = np.lexsort((d2, recv))
-        send, recv, shift = send[order], recv[order], shift[order]
-        rank = np.arange(len(recv)) - np.searchsorted(recv, recv, side="left")
-        keep = rank < max_neighbours
+        keep = _cap_neighbours(d2, recv, max_neighbours, send, sid)
         send, recv, shift = send[keep], recv[keep], shift[keep]
     cart_shift = (shift @ cell).astype(np.float32)
     return send.astype(np.int32), recv.astype(np.int32), cart_shift

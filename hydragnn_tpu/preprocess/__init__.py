@@ -1,4 +1,8 @@
-from .load_data import create_dataloaders, split_dataset, stratified_sampling
-from .transforms import (build_graph_sample, normalize_rotation,
-                         point_pair_features, spherical_coordinates,
-                         update_atom_features, update_predicted_values)
+from .cache import PreprocessedCache, cache_key, cached_sample_build
+from .load_data import (create_dataloaders, resolve_preprocess_settings,
+                        split_dataset, stratified_sampling)
+from .transforms import (build_graph_sample, build_graph_samples,
+                         normalize_rotation, point_pair_features,
+                         spherical_coordinates, update_atom_features,
+                         update_predicted_values)
+from .workers import PreprocessError, parallel_map

@@ -1,0 +1,364 @@
+"""Content-addressed preprocessed-sample cache (docs/preprocessing.md).
+
+Persists built `GraphSample`s as one packed, memory-mapped shard per cache
+key so a warm rerun skips raw parsing and neighbor construction entirely.
+The key is a sha256 over everything the built samples depend on:
+
+* **raw-file fingerprints** — (basename, size, mtime_ns) per input file,
+  in sorted order;
+* **graph-construction config** — the full ``Dataset`` section plus the
+  ``Architecture`` fields that shape edges/features (radius,
+  max_neighbours, periodic_boundary_conditions, edge_features) and the
+  ``Variables_of_interest`` input/target selection, as canonical JSON;
+* **code version** — a hash of the construction code itself
+  (graphs/radius.py + preprocess/transforms.py sources) and the shard
+  schema version.
+
+Any config edit, data change, or code change therefore lands on a *new*
+key — stale shards are simply never addressed, and a corrupted shard
+(truncated, bit-flipped, or from a different key) fails verification and
+is rebuilt, never served (tests/test_preprocess_cache.py).
+
+Shard layout (one directory per key, written to a temp dir and atomically
+renamed into place):
+
+* ``data.bin``  — all sample arrays back to back, 16-byte aligned;
+* ``index.json`` — per-sample field table: name → (dtype, shape, offset);
+* ``meta.json``  — schema version, key, sample count, data byte size,
+  sha256 of ``data.bin``, and loader metadata (e.g. minmax arrays).
+
+Loads memory-map ``data.bin`` read-only: arrays are zero-copy views, so a
+warm start pays one mmap + (by default) one checksum pass, not a rebuild.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import tempfile
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..graphs.batch import GraphSample
+
+CACHE_SCHEMA_VERSION = 1
+
+# GraphSample fields persisted per sample (extras are not cached; the
+# build paths that feed the cache never set them)
+_SAMPLE_FIELDS = ("x", "pos", "senders", "receivers", "edge_attr",
+                  "edge_shifts", "y_graph", "y_node", "cell", "energy",
+                  "forces")
+_ALIGN = 16
+
+
+class CacheInvalid(RuntimeError):
+    """A shard exists but cannot be served (corrupt, truncated, or built
+    for a different key/schema). Callers rebuild."""
+
+
+# --------------------------------------------------------------- keying --
+def file_fingerprints(paths: Sequence[str]) -> List[Tuple[str, int, int]]:
+    """(basename, size, mtime_ns) per file, sorted by basename — the raw
+    data part of the cache key. mtime_ns + size catches in-place edits
+    without hashing file contents on every run."""
+    out = []
+    for p in paths:
+        st = os.stat(p)
+        out.append((os.path.basename(p), int(st.st_size),
+                    int(st.st_mtime_ns)))
+    return sorted(out)
+
+
+def code_fingerprint() -> str:
+    """Hash of the graph-construction code cached samples depend on."""
+    import inspect
+
+    from ..graphs import radius
+    from . import transforms
+    h = hashlib.sha256()
+    h.update(str(CACHE_SCHEMA_VERSION).encode())
+    for mod in (radius, transforms):
+        h.update(inspect.getsource(mod).encode())
+    return h.hexdigest()
+
+
+def graph_config_fingerprint(config: Dict) -> Dict:
+    """The config subset that determines built samples, as a plain dict
+    (canonical-JSON-serialized into the key)."""
+    nn = config.get("NeuralNetwork", {})
+    arch = nn.get("Architecture", {})
+    voi = nn.get("Variables_of_interest", {})
+    ds = dict(config.get("Dataset", {}))
+    # the cache directory itself must not invalidate the key
+    ds.pop("preprocessed_cache_dir", None)
+    return {
+        "dataset": ds,
+        "architecture": {k: arch.get(k) for k in (
+            "radius", "max_neighbours", "periodic_boundary_conditions",
+            "edge_features")},
+        "variables_of_interest": {k: voi.get(k) for k in (
+            "input_node_features", "type", "output_index")},
+    }
+
+
+def cache_key(config: Dict, files: Sequence[str],
+              extra=None) -> str:
+    """Content address for one built dataset: sha256 over (file
+    fingerprints, graph-construction config, code version[, extra]).
+    ``extra`` carries loader-specific context (e.g. the per-rank shard
+    coordinates of a distributed raw dataset)."""
+    payload = {
+        "files": file_fingerprints(files),
+        "config": graph_config_fingerprint(config),
+        "code": code_fingerprint(),
+        "extra": extra,
+    }
+    blob = json.dumps(payload, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()[:32]
+
+
+# ------------------------------------------------------- meta array enc --
+def _encode_meta(extra: Optional[Dict]) -> Optional[Dict]:
+    """JSON-encode a flat dict whose values may be numpy arrays."""
+    if extra is None:
+        return None
+    out = {}
+    for k, v in extra.items():
+        if isinstance(v, np.ndarray):
+            out[k] = {"__ndarray__": True, "dtype": str(v.dtype),
+                      "shape": list(v.shape), "data": v.ravel().tolist()}
+        else:
+            out[k] = v
+    return out
+
+
+def _decode_meta(extra: Optional[Dict]) -> Optional[Dict]:
+    if extra is None:
+        return None
+    out = {}
+    for k, v in extra.items():
+        if isinstance(v, dict) and v.get("__ndarray__"):
+            out[k] = np.asarray(v["data"], dtype=v["dtype"]).reshape(
+                v["shape"])
+        else:
+            out[k] = v
+    return out
+
+
+# ------------------------------------------------------------ shard I/O --
+def _shard_dir(cache_dir: str, key: str) -> str:
+    return os.path.join(cache_dir, f"preproc-{key}")
+
+
+def save_shard(cache_dir: str, key: str, samples: Sequence[GraphSample],
+               extra_meta: Optional[Dict] = None) -> str:
+    """Write one packed shard; atomic rename into place so a crashed or
+    concurrent writer never leaves a half-shard at the served path."""
+    os.makedirs(cache_dir, exist_ok=True)
+    tmp = tempfile.mkdtemp(prefix=f".preproc-{key}-", dir=cache_dir)
+    try:
+        index = []
+        h = hashlib.sha256()
+        offset = 0
+        with open(os.path.join(tmp, "data.bin"), "wb") as f:
+            for s in samples:
+                fields = {}
+                for name in _SAMPLE_FIELDS:
+                    arr = getattr(s, name)
+                    if arr is None:
+                        continue
+                    arr = np.ascontiguousarray(arr)
+                    pad = (-offset) % _ALIGN
+                    if pad:
+                        f.write(b"\0" * pad)
+                        h.update(b"\0" * pad)
+                        offset += pad
+                    buf = arr.tobytes()
+                    f.write(buf)
+                    h.update(buf)
+                    fields[name] = [str(arr.dtype), list(arr.shape), offset]
+                    offset += len(buf)
+                index.append(fields)
+        with open(os.path.join(tmp, "index.json"), "w") as f:
+            json.dump({"samples": index}, f)
+        meta = {
+            "schema": CACHE_SCHEMA_VERSION,
+            "key": key,
+            "num_samples": len(index),
+            "data_size": offset,
+            "data_sha256": h.hexdigest(),
+            "extra": _encode_meta(extra_meta),
+        }
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        dst = _shard_dir(cache_dir, key)
+        if os.path.exists(dst):  # stale/corrupt predecessor: replace it
+            trash = tempfile.mkdtemp(prefix=".preproc-trash-", dir=cache_dir)
+            os.replace(dst, os.path.join(trash, "old"))
+            shutil.rmtree(trash, ignore_errors=True)
+        try:
+            os.replace(tmp, dst)
+        except OSError:
+            # a concurrent writer renamed its shard for the same key into
+            # place between our exists-check and the rename — identical
+            # content by construction, so keep theirs
+            shutil.rmtree(tmp, ignore_errors=True)
+        return dst
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def load_shard(cache_dir: str, key: str, verify: bool = True,
+               ) -> Tuple[List[GraphSample], Optional[Dict]]:
+    """Memory-map one shard back into GraphSamples (zero-copy, read-only
+    arrays). Raises FileNotFoundError on a plain miss and `CacheInvalid`
+    on anything unservable — wrong key/schema, size mismatch, checksum
+    failure, unreadable metadata."""
+    path = _shard_dir(cache_dir, key)
+    if not os.path.isdir(path):
+        raise FileNotFoundError(path)
+    try:
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        with open(os.path.join(path, "index.json")) as f:
+            index = json.load(f)["samples"]
+    except (OSError, ValueError, KeyError) as exc:
+        raise CacheInvalid(f"{path}: unreadable shard metadata "
+                           f"({type(exc).__name__}: {exc})") from exc
+    if meta.get("schema") != CACHE_SCHEMA_VERSION:
+        raise CacheInvalid(
+            f"{path}: shard schema {meta.get('schema')} != "
+            f"{CACHE_SCHEMA_VERSION}")
+    if meta.get("key") != key:
+        raise CacheInvalid(f"{path}: shard was built for key "
+                           f"{meta.get('key')}, not {key}")
+    if len(index) != meta.get("num_samples"):
+        raise CacheInvalid(f"{path}: index lists {len(index)} samples, "
+                           f"meta says {meta.get('num_samples')}")
+    data_path = os.path.join(path, "data.bin")
+    try:
+        size = os.path.getsize(data_path)
+    except OSError as exc:
+        raise CacheInvalid(f"{path}: missing data.bin") from exc
+    if size != meta.get("data_size"):
+        raise CacheInvalid(f"{path}: data.bin is {size} bytes, meta "
+                           f"says {meta.get('data_size')}")
+    mm = (np.memmap(data_path, dtype=np.uint8, mode="r") if size
+          else np.empty(0, np.uint8))
+    if verify and size:
+        digest = hashlib.sha256(mm).hexdigest()
+        if digest != meta.get("data_sha256"):
+            raise CacheInvalid(f"{path}: data.bin checksum mismatch "
+                               "(corrupted shard)")
+    samples = []
+    try:
+        for fields in index:
+            kw = {}
+            for name, (dtype, shape, offset) in fields.items():
+                dt = np.dtype(dtype)
+                count = int(np.prod(shape, dtype=np.int64))
+                if count == 0:
+                    arr = np.empty(shape, dt)
+                else:
+                    arr = np.frombuffer(mm, dtype=dt, count=count,
+                                        offset=int(offset)).reshape(shape)
+                kw[name] = arr
+            samples.append(GraphSample(**kw))
+    except (TypeError, ValueError, KeyError) as exc:
+        raise CacheInvalid(f"{path}: malformed sample index "
+                           f"({type(exc).__name__}: {exc})") from exc
+    return samples, _decode_meta(meta.get("extra"))
+
+
+# ------------------------------------------------------------ high level --
+class PreprocessedCache:
+    """Lookup/store wrapper with hit/miss/corrupt counters (surfaced in
+    BENCH_PREPROC and the run_training startup log)."""
+
+    def __init__(self, cache_dir: str, verify: Optional[bool] = None):
+        from ..utils.envflags import env_strict_flag
+        self.cache_dir = cache_dir
+        self.verify = (env_strict_flag("HYDRAGNN_PREPROC_CACHE_VERIFY", True)
+                       if verify is None else verify)
+        self.hits = 0
+        self.misses = 0
+        self.invalid = 0
+
+    def lookup(self, key: str):
+        """(samples, extra_meta) on a verified hit, else None (miss or
+        invalid — the caller rebuilds either way)."""
+        try:
+            samples, extra = load_shard(self.cache_dir, key,
+                                        verify=self.verify)
+        except FileNotFoundError:
+            self.misses += 1
+            return None
+        except CacheInvalid as exc:
+            import logging
+            logging.getLogger("hydragnn_tpu").warning(
+                "preprocessed cache shard rejected, rebuilding: %s", exc)
+            self.invalid += 1
+            self.misses += 1
+            return None
+        self.hits += 1
+        return samples, extra
+
+    def store(self, key: str, samples: Sequence[GraphSample],
+              extra_meta: Optional[Dict] = None) -> str:
+        return save_shard(self.cache_dir, key, samples, extra_meta)
+
+    def stats(self) -> Dict[str, int]:
+        return {"hits": self.hits, "misses": self.misses,
+                "invalid": self.invalid}
+
+
+def cached_sample_build(config: Dict, files: Sequence[str],
+                        build_fn: Callable[[], Tuple[List[GraphSample],
+                                                     Optional[Dict]]],
+                        extra_key=None,
+                        cache_dir: Optional[str] = None,
+                        agree_fn: Optional[Callable[[bool], bool]] = None,
+                        ) -> Tuple[List[GraphSample], Optional[Dict],
+                                   Dict[str, int]]:
+    """The one-call cache wrapper every dataset loader uses: returns
+    (samples, extra_meta, stats). ``build_fn`` runs only on a miss and
+    returns (samples, extra_meta). ``agree_fn`` lets a multi-process
+    caller turn a local hit into a global decision (all ranks must hit or
+    every rank rebuilds — a mixed hit/miss would desync the min-max
+    collectives inside the build)."""
+    from ..utils.envflags import resolve_preproc_cache_dir
+    if cache_dir is None:
+        cache_dir = resolve_preproc_cache_dir(config.get("Dataset"))
+    if not cache_dir:
+        samples, extra = build_fn()
+        return samples, extra, {"enabled": 0, "hits": 0, "misses": 0,
+                                "invalid": 0}
+    cache = PreprocessedCache(cache_dir)
+    key = cache_key(config, files, extra=extra_key)
+    hit = cache.lookup(key)
+    if agree_fn is not None:
+        if not agree_fn(hit is not None):
+            # some peer missed: rebuild everywhere so the collective
+            # normalization inside build_fn stays in lockstep
+            hit = None
+    if hit is not None:
+        samples, extra = hit
+    else:
+        samples, extra = build_fn()
+        try:
+            cache.store(key, samples, extra)
+        except Exception as exc:  # noqa: BLE001 — a full/read-only cache
+            # disk must not abort a run whose samples were built fine
+            import logging
+            logging.getLogger("hydragnn_tpu").warning(
+                "preprocessed cache store failed for key %s (next run "
+                "rebuilds): %s", key, exc)
+    stats = dict(enabled=1, **cache.stats())
+    import logging
+    logging.getLogger("hydragnn_tpu").info(
+        "preprocessed cache %s for key %s (%d samples, dir %s)",
+        "hit" if hit is not None else "miss", key, len(samples), cache_dir)
+    return samples, extra, stats

@@ -16,6 +16,20 @@ from ..datasets.loader import GraphDataLoader
 from ..graphs.batch import GraphSample
 
 
+def resolve_preprocess_settings(config: Dict) -> Tuple[int, Optional[str]]:
+    """(workers, cache_dir) for the preprocessing fast path
+    (docs/preprocessing.md) — one resolution shared by every raw-format
+    loader, run_training's startup log, and bench.py so the precedence
+    (env over config) can't drift: HYDRAGNN_PREPROC_WORKERS over
+    Training.preprocess_workers, HYDRAGNN_PREPROC_CACHE_DIR over
+    Dataset.preprocessed_cache_dir."""
+    from ..utils.envflags import (resolve_preproc_cache_dir,
+                                  resolve_preproc_workers)
+    return (resolve_preproc_workers(
+                config.get("NeuralNetwork", {}).get("Training")),
+            resolve_preproc_cache_dir(config.get("Dataset")))
+
+
 def split_dataset(dataset: Sequence[GraphSample], perc_train: float,
                   stratify_splitting: bool = False, seed: int = 0):
     """Random or composition-stratified train/val/test split

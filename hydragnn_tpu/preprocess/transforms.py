@@ -145,6 +145,23 @@ def build_graph_sample(
                        energy=energy, forces=forces)
 
 
+def _build_graph_sample_kwargs(kw: Dict, config: Dict) -> GraphSample:
+    return build_graph_sample(config=config, **kw)
+
+
+def build_graph_samples(items: Sequence[Dict], config: Dict,
+                        workers: int = 0) -> List[GraphSample]:
+    """Order-preserving (optionally process-parallel) `build_graph_sample`
+    over a list of kwargs dicts — the shared fan-out point for the raw
+    dataset loaders (docs/preprocessing.md). Bitwise-identical output for
+    any worker count."""
+    import functools
+
+    from .workers import parallel_map
+    fn = functools.partial(_build_graph_sample_kwargs, config=config)
+    return parallel_map(fn, items, workers=workers, what="structure")
+
+
 def _append_edge_attr(edge_attr, extra):
     extra = extra.astype(np.float32)
     if edge_attr is None:

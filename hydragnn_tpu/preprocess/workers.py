@@ -1,0 +1,133 @@
+"""Process-parallel preprocessing: an order-preserving parallel map.
+
+The raw→GraphSample pipeline (parse + radius graph + feature selection) is
+pure numpy per sample, so it fans perfectly across a worker-process pool —
+`parallel_map` is the one primitive every dataset loader uses
+(docs/preprocessing.md). Contract:
+
+* **Deterministic**: the result is ``[fn(x) for x in items]`` in input
+  order, bitwise-identical for every worker count (asserted in
+  tests/test_preprocess_cache.py) — workers change *when* a sample is
+  built, never *what*.
+* **Clean failure**: an exception inside ``fn`` surfaces as a
+  `PreprocessError` naming the failing item (the raw file path), with the
+  original exception chained.
+* **Graceful degradation**: ``workers <= 1``, a single item, or an
+  unpicklable ``fn`` (e.g. a dataset class defined inside a function) all
+  run serially — same results, no pool.
+
+Workers are processes, not threads: the GIL serializes numpy-light Python
+parse loops, and fork (the default start method here) shares the parsed
+config without re-import cost. Forking a process that has already
+initialized JAX draws a RuntimeWarning (a JAX thread could in principle
+hold a lock across the fork) — the children here run pure numpy and never
+touch JAX, the PyTorch-DataLoader tradeoff. ``spawn``/``forkserver``
+re-import ``__main__``, which breaks driver scripts without an import
+guard, so they are opt-in via ``HYDRAGNN_PREPROC_START_METHOD`` rather
+than the default.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import os
+import pickle
+from typing import Callable, List, Optional, Sequence
+
+
+class PreprocessError(RuntimeError):
+    """A preprocessing step failed on one input; the message names it."""
+
+
+def _label(what: str, labels, i: int, item) -> str:
+    if labels is not None:
+        return str(labels[i])
+    return f"{what} #{i}"
+
+
+def _apply_chunk(fn, chunk, start):
+    """Worker-side runner: one IPC round trip per chunk, not per item —
+    per-task submit/result pickling otherwise dominates small builds.
+    Failures return (err, global_index, exc) so the parent can name the
+    failing item; an unpicklable exception degrades to its repr."""
+    out = []
+    for j, item in enumerate(chunk):
+        try:
+            out.append(fn(item))
+        except Exception as exc:  # noqa: BLE001
+            try:
+                pickle.dumps(exc)
+            except Exception:  # noqa: BLE001
+                exc = RuntimeError(f"{type(exc).__name__}: {exc}")
+            return ("err", start + j, exc)
+    return ("ok", out)
+
+
+def parallel_map(fn: Callable, items: Sequence, workers: int = 0,
+                 what: str = "item",
+                 labels: Optional[Sequence] = None) -> List:
+    """``[fn(x) for x in items]`` across a worker-process pool.
+
+    ``workers <= 1`` runs serially (0 and 1 are equivalent by design — the
+    determinism tests assert 0/1/4 produce identical outputs). Failures
+    raise `PreprocessError` naming ``labels[i]`` (or ``what #i``).
+    """
+    items = list(items)
+    if workers > 1 and len(items) > 1:
+        try:
+            pickle.dumps(fn)
+        except Exception:  # noqa: BLE001 — local classes / closures
+            import logging
+            logging.getLogger("hydragnn_tpu").warning(
+                "HYDRAGNN_PREPROC_WORKERS=%d requested but the build "
+                "callable %r is not picklable (defined inside a function?); "
+                "preprocessing serially", workers, fn)
+            workers = 0
+    if workers <= 1 or len(items) <= 1:
+        out = []
+        for i, item in enumerate(items):
+            try:
+                out.append(fn(item))
+            except Exception as exc:  # noqa: BLE001
+                raise PreprocessError(
+                    f"preprocessing failed on {_label(what, labels, i, item)}"
+                    f": {type(exc).__name__}: {exc}") from exc
+        return out
+    from concurrent.futures import ProcessPoolExecutor
+    methods = multiprocessing.get_all_start_methods()
+    method = (os.getenv("HYDRAGNN_PREPROC_START_METHOD") or "").strip()
+    if method and method not in methods:
+        import logging
+        logging.getLogger("hydragnn_tpu").warning(
+            "HYDRAGNN_PREPROC_START_METHOD=%r is not one of %s; using the "
+            "default", method, methods)
+        method = ""
+    ctx = multiprocessing.get_context(
+        method or ("fork" if "fork" in methods else methods[0]))
+    nworkers = min(int(workers), len(items), os.cpu_count() or 1)
+    # ~4 chunks per worker: bounded IPC with decent load balancing
+    chunk = max(1, -(-len(items) // (nworkers * 4)))
+    with ProcessPoolExecutor(max_workers=nworkers, mp_context=ctx) as ex:
+        futures = [(i, ex.submit(_apply_chunk, fn, items[i:i + chunk], i))
+                   for i in range(0, len(items), chunk)]
+        out = []
+        for i, fut in futures:
+            try:
+                res = fut.result()
+            except Exception as exc:  # noqa: BLE001 — pool infrastructure
+                # failure (a killed worker, an unpicklable result, ...)
+                for _, f in futures:
+                    f.cancel()
+                raise PreprocessError(
+                    f"preprocessing failed in the worker pool near "
+                    f"{_label(what, labels, i, items[i])}"
+                    f": {type(exc).__name__}: {exc}") from exc
+            if res[0] == "err":
+                _, idx, exc = res
+                for _, f in futures:
+                    f.cancel()
+                raise PreprocessError(
+                    f"preprocessing failed on "
+                    f"{_label(what, labels, idx, items[idx])}"
+                    f": {type(exc).__name__}: {exc}") from exc
+            out.extend(res[1])
+    return out

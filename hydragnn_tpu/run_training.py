@@ -113,6 +113,14 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     tr.initialize(sync=(env_int("HYDRAGNN_TRACE_LEVEL", 0) or 0) > 0)
 
     if datasets is None:
+        # preprocessing fast path (docs/preprocessing.md): worker-pool
+        # sample builds + the content-addressed preprocessed cache, both
+        # resolved once here so the startup log names what the loaders use
+        from .preprocess.load_data import resolve_preprocess_settings
+        pp_workers, pp_cache = resolve_preprocess_settings(config)
+        if pp_workers or pp_cache:
+            log(f"preprocessing: workers={pp_workers} "
+                f"cache={'on at ' + pp_cache if pp_cache else 'off'}")
         datasets = _load_datasets_from_config(config)
     trainset, valset, testset = datasets
     trainset = list(trainset)

@@ -126,6 +126,29 @@ def resolve_loader_retries() -> "tuple[int, float]":
     return hit
 
 
+def resolve_preproc_workers(train_cfg=None) -> int:
+    """Preprocessing worker-pool size (docs/preprocessing.md): the
+    HYDRAGNN_PREPROC_WORKERS env overrides Training.preprocess_workers
+    (default 0 = serial; 0 and 1 are equivalent by the determinism
+    contract). Strict parsing — a typo value warns and keeps the default
+    instead of silently changing the build path."""
+    w = env_strict_int("HYDRAGNN_PREPROC_WORKERS")
+    if w is None and train_cfg:
+        w = train_cfg.get("preprocess_workers")
+    return max(int(w), 0) if w is not None else 0
+
+
+def resolve_preproc_cache_dir(ds_cfg=None) -> "str | None":
+    """Preprocessed-sample cache directory (docs/preprocessing.md):
+    HYDRAGNN_PREPROC_CACHE_DIR env over Dataset.preprocessed_cache_dir;
+    unset/empty = cache off."""
+    d = os.getenv("HYDRAGNN_PREPROC_CACHE_DIR")
+    if d is None and ds_cfg:
+        d = ds_cfg.get("preprocessed_cache_dir")
+    d = (d or "").strip()
+    return d or None
+
+
 def resolve_steps_per_call(train_cfg) -> int:
     """Steps-per-call dispatch batching knob: HYDRAGNN_STEPS_PER_CALL env
     overrides Training.steps_per_call (default 1). Shared by run_training

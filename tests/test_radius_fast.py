@@ -1,0 +1,219 @@
+"""Adjudication suite for the vectorized neighbor construction
+(hydragnn_tpu/graphs/radius.py, docs/preprocessing.md): randomized
+brute-force O(N²) oracles for the open and PBC paths, the dense↔cell-list
+implementation straddle, the sparse-system memory regression, and the
+documented max_neighbours tie-breaking contract."""
+import numpy as np
+import pytest
+
+from hydragnn_tpu.graphs.radius import (_cap_neighbours, _cell_list_pairs,
+                                        radius_graph, radius_graph_pbc)
+
+
+# ------------------------------------------------------------- oracles --
+def oracle_open(pos, r, loop=False):
+    """Brute-force O(N²) reference: the edge SET within distance r."""
+    pos = np.asarray(pos, np.float64)
+    d2 = np.sum((pos[:, None] - pos[None, :]) ** 2, axis=-1)
+    adj = d2 <= r * r
+    if not loop:
+        np.fill_diagonal(adj, False)
+    rc, sd = np.nonzero(adj)
+    return set(zip(sd.tolist(), rc.tolist()))
+
+
+def oracle_pbc(pos, cell, r, pbc=(True, True, True)):
+    """Brute-force per-shift enumeration: the edge set with integer image
+    shifts, independent of the ghost-atom implementation under test."""
+    pos = np.asarray(pos, np.float64)
+    cell = np.asarray(cell, np.float64)
+    recip = np.linalg.inv(cell).T
+    nmax = [int(np.ceil(r * np.linalg.norm(recip[a]))) if pbc[a] else 0
+            for a in range(3)]
+    out = set()
+    for sx in range(-nmax[0], nmax[0] + 1):
+        for sy in range(-nmax[1], nmax[1] + 1):
+            for sz in range(-nmax[2], nmax[2] + 1):
+                sh = np.array([sx, sy, sz], np.float64)
+                disp = (pos[None, :, :] + (sh @ cell)[None, None, :]
+                        - pos[:, None, :])
+                ok = np.sum(disp * disp, axis=-1) <= r * r
+                if sx == sy == sz == 0:
+                    np.fill_diagonal(ok, False)
+                rc, sd = np.nonzero(ok)
+                for a, b in zip(sd.tolist(), rc.tolist()):
+                    out.add((a, b, sx, sy, sz))
+    return out
+
+
+def edges_with_shifts(pos, cell, send, recv, shifts):
+    ish = np.round(shifts.astype(np.float64)
+                   @ np.linalg.inv(np.asarray(cell, np.float64))).astype(int)
+    return set(zip(send.tolist(), recv.tolist(), ish[:, 0].tolist(),
+                   ish[:, 1].tolist(), ish[:, 2].tolist()))
+
+
+class TestOpenBoundaryOracle:
+    @pytest.mark.parametrize("n", [1, 2, 7, 64, 300, 511, 512, 513, 700])
+    def test_randomized_matches_bruteforce(self, n):
+        rng = np.random.RandomState(n)
+        pos = rng.rand(n, 3) * 4
+        send, recv = radius_graph(pos, 0.8)
+        assert set(zip(send.tolist(), recv.tolist())) == oracle_open(pos, 0.8)
+
+    def test_empty_graph(self):
+        send, recv = radius_graph(np.zeros((0, 3)), 1.0)
+        assert send.shape == (0,) and recv.shape == (0,)
+        assert send.dtype == np.int32
+
+    def test_single_atom(self):
+        send, recv = radius_graph(np.zeros((1, 3)), 1.0)
+        assert len(send) == 0
+
+    def test_duplicate_positions(self):
+        # duplicates at distance 0 are legal edges (the dense reference
+        # keeps them); the cell-list path must agree
+        rng = np.random.RandomState(0)
+        base = rng.rand(400, 3) * 3
+        pos = np.concatenate([base, base[:200]])  # 600 atoms, cell-list path
+        send, recv = radius_graph(pos, 0.5)
+        assert set(zip(send.tolist(), recv.tolist())) == oracle_open(pos, 0.5)
+
+    def test_dense_cell_list_straddle(self):
+        """n=512 runs dense, n=513 runs the cell list: the two
+        implementations must be EDGE-FOR-EDGE identical (same arrays, same
+        order) so the branch boundary can never silently diverge."""
+        rng = np.random.RandomState(7)
+        for n in (512, 513):
+            pos = rng.rand(n, 3) * 4
+            d2 = np.sum((pos[:, None] - pos[None, :]) ** 2, axis=-1)
+            adj = d2 <= 0.7 * 0.7
+            np.fill_diagonal(adj, False)
+            rc, sd = np.nonzero(adj)  # the dense reference, row-major
+            s2, r2 = _cell_list_pairs(pos.astype(np.float64), 0.7, False)
+            np.testing.assert_array_equal(sd, s2)
+            np.testing.assert_array_equal(rc, r2)
+
+    def test_sparse_clusters_no_memory_blowup(self):
+        """Two clusters separated by 1e7 x radius: the seed implementation
+        allocated a dense (extent/r)^3 cell grid (~1e21 entries) and died;
+        the occupied-cell hash must handle it instantly and exactly."""
+        rng = np.random.RandomState(3)
+        a = rng.rand(300, 3)
+        b = rng.rand(300, 3) + 1e7
+        pos = np.concatenate([a, b])
+        send, recv = radius_graph(pos, 0.4)
+        assert set(zip(send.tolist(), recv.tolist())) == oracle_open(pos, 0.4)
+        # no cross-cluster edges, both clusters present
+        cross = (send < 300) != (recv < 300)
+        assert not cross.any()
+        assert (recv < 300).any() and (recv >= 300).any()
+
+    def test_bitwise_deterministic_across_calls(self):
+        rng = np.random.RandomState(11)
+        pos = rng.rand(800, 3) * 3
+        s1, r1 = radius_graph(pos, 0.8, max_neighbours=8)
+        s2, r2 = radius_graph(pos, 0.8, max_neighbours=8)
+        np.testing.assert_array_equal(s1, s2)
+        np.testing.assert_array_equal(r1, r2)
+
+
+class TestPBCOracle:
+    @pytest.mark.parametrize("trial", range(6))
+    def test_randomized_matches_bruteforce(self, trial):
+        rng = np.random.RandomState(trial)
+        n = int(rng.randint(2, 48))
+        cell = np.eye(3) * rng.uniform(1.5, 3.0) + rng.randn(3, 3) * 0.25
+        pos = rng.rand(n, 3) @ cell  # fractional -> cartesian, skewed cell
+        r = float(rng.uniform(0.8, 1.4))
+        pbc = ((True, True, True) if trial < 3 else
+               tuple(bool(b) for b in rng.randint(0, 2, 3)))
+        send, recv, shifts = radius_graph_pbc(pos, cell, r, pbc=pbc)
+        got = edges_with_shifts(pos, cell, send, recv, shifts)
+        assert got == oracle_pbc(pos, cell, r, pbc)
+
+    def test_bcc_first_shell(self):
+        # 1x1x1 BCC cell: every atom has exactly 8 first-shell neighbors
+        pos = np.asarray([[0, 0, 0], [0.5, 0.5, 0.5]], np.float64)
+        send, recv, shifts = radius_graph_pbc(pos, np.eye(3), r=0.9)
+        assert np.bincount(recv, minlength=2).tolist() == [8, 8]
+        d = np.linalg.norm(pos[send] + shifts - pos[recv], axis=1)
+        np.testing.assert_allclose(d, np.sqrt(3) / 2, rtol=1e-6)
+
+    def test_empty_and_single(self):
+        send, recv, shifts = radius_graph_pbc(np.zeros((0, 3)), np.eye(3),
+                                              1.0)
+        assert send.shape == (0,) and shifts.shape == (0, 3)
+        # a single atom in a small cell still sees its own images
+        send, recv, shifts = radius_graph_pbc(np.zeros((1, 3)), np.eye(3),
+                                              1.05)
+        got = edges_with_shifts(np.zeros((1, 3)), np.eye(3), send, recv,
+                                shifts)
+        assert got == oracle_pbc(np.zeros((1, 3)), np.eye(3), 1.05)
+        assert len(got) == 6  # the six face-adjacent images
+
+    def test_large_supercell_matches_oracle(self):
+        # >512 ghosts: exercises the cell-list path end to end under PBC
+        rng = np.random.RandomState(5)
+        cell = np.eye(3) * 6.0
+        pos = rng.rand(200, 3) @ cell
+        send, recv, shifts = radius_graph_pbc(pos, cell, 1.0)
+        got = edges_with_shifts(pos, cell, send, recv, shifts)
+        assert got == oracle_pbc(pos, cell, 1.0)
+
+    def test_max_neighbours_deterministic(self):
+        rng = np.random.RandomState(9)
+        cell = np.eye(3) * 2.0
+        pos = rng.rand(30, 3) @ cell
+        out1 = radius_graph_pbc(pos, cell, 1.4, max_neighbours=5)
+        out2 = radius_graph_pbc(pos, cell, 1.4, max_neighbours=5)
+        for a, b in zip(out1, out2):
+            np.testing.assert_array_equal(a, b)
+        assert np.bincount(out1[1]).max() <= 5
+
+
+class TestCapNeighboursContract:
+    """docs/preprocessing.md: truncation keeps, per receiver, the
+    max_neighbours edges smallest under the total order (d², tie keys) —
+    independent of the input edge order, hence bitwise-reproducible."""
+
+    def test_keeps_nearest_with_documented_tie_break(self):
+        # receiver 0 with four candidate senders: two at d²=1 (senders 3
+        # and 1), one at d²=0.5 (sender 2), one at d²=2 (sender 4).
+        # cap=2 must keep sender 2 (nearest) then sender 1 (d² tie broken
+        # by the smaller sender id).
+        recv = np.zeros(4, np.int64)
+        send = np.asarray([3, 1, 2, 4])
+        d2 = np.asarray([1.0, 1.0, 0.5, 2.0])
+        keep = _cap_neighbours(d2, recv, 2, send)
+        assert sorted(send[keep].tolist()) == [1, 2]
+
+    def test_input_order_independent(self):
+        rng = np.random.RandomState(21)
+        recv = rng.randint(0, 10, 200)
+        send = rng.randint(0, 50, 200)
+        d2 = rng.randint(0, 4, 200).astype(np.float64)  # heavy ties
+        kept = None
+        for _ in range(5):
+            perm = rng.permutation(200)
+            keep = _cap_neighbours(d2[perm], recv[perm], 3, send[perm])
+            got = sorted(zip(recv[perm][keep].tolist(),
+                             send[perm][keep].tolist(),
+                             d2[perm][keep].tolist()))
+            if kept is None:
+                kept = got
+            assert got == kept
+
+    def test_open_cap_matches_explicit_sort(self):
+        rng = np.random.RandomState(2)
+        pos = rng.rand(600, 3) * 2.5
+        send, recv = radius_graph(pos, 0.9, max_neighbours=4)
+        # reference: per receiver, the 4 smallest (d², sender)
+        s_all, r_all = radius_graph(pos, 0.9)
+        d2 = np.sum((pos[s_all] - pos[r_all]) ** 2, axis=1)
+        want = set()
+        for i in np.unique(r_all):
+            sel = r_all == i
+            cand = sorted(zip(d2[sel], s_all[sel]))[:4]
+            want.update((int(s), int(i)) for _, s in cand)
+        assert set(zip(send.tolist(), recv.tolist())) == want

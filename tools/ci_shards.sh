@@ -9,17 +9,21 @@ shard="${1:?usage: ci_shards.sh core|data|train|parallel|robust|zoo|sweep}"
 
 case "$shard" in
   core)
-    # ops, model zoo construction, kernels, symmetry
+    # ops, model zoo construction, kernels, symmetry, neighbor
+    # construction (vectorized radius/PBC oracle suite)
     python -m pytest -q tests/test_graph_core.py tests/test_models.py \
       tests/test_registries.py tests/test_irreps.py tests/test_kernels.py \
-      tests/test_equivariance.py
+      tests/test_equivariance.py tests/test_radius_fast.py
     ;;
   data)
-    # datasets, configs, loaders, postprocess, acquisition tooling
+    # datasets, configs, loaders, postprocess, acquisition tooling,
+    # preprocessing cache + parallel builds (the PR 4 lesson: every new
+    # test file must land in a shard or it never runs)
     python -m pytest -q tests/test_datasets.py tests/test_example_configs.py \
       tests/test_reference_configs.py tests/test_multidataset.py \
       tests/test_sampling.py tests/test_visualizer.py \
-      tests/test_model_loadpred.py tests/test_dataset_tooling.py
+      tests/test_model_loadpred.py tests/test_dataset_tooling.py \
+      tests/test_preprocess_cache.py
     ;;
   train)
     # end-to-end training paths: single-device + examples + HPO
