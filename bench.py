@@ -31,8 +31,10 @@ Env knobs:
   BENCH_BATCH / BENCH_NODES / BENCH_HIDDEN
                        workload scale (default 32/80/128, the CI-sized
                        OC20-like shape); larger fills the MXU better
-  BENCH_DTYPE          compute dtype for the train step (default
-                       float32; bfloat16 = mixed precision on the MXU)
+  BENCH_DTYPE          compute dtype for the train step (bfloat16 =
+                       mixed precision on the MXU); unset defers to the
+                       HYDRAGNN_PRECISION policy knob, then float32
+                       (train/precision.py precedence)
   HYDRAGNN_ASYNC_LOADER / HYDRAGNN_LOADER_WORKERS / HYDRAGNN_BATCH_CACHE_MB
                        async input pipeline knobs (docs/input_pipeline.md);
                        the emitted `input_bound_frac` field measures the
@@ -100,6 +102,27 @@ Env knobs:
   BENCH_PREPROC_OUT    also write the preprocessing JSON to this path
                        (the nightly preproc-bench emits
                        BENCH_PREPROC.json)
+  BENCH_KERNELS        =1: kernel/mixed-precision mode
+                       (docs/kernels_mixed_precision.md) — adjudicate the
+                       fused Pallas message-passing kernels
+                       (HYDRAGNN_FUSED_MP, kernels/fused_mp_pallas.py)
+                       and the bf16 policy: padding-aware graphs/s of
+                       the SchNet and PNA train steps over
+                       {unfused, fused} x {float32, bfloat16} on
+                       identical batches, forward-parity max-abs-diff
+                       per point vs the unfused fp32 path, and a serving
+                       leg comparing a bf16 engine against the fp32
+                       engine on identical buckets vs the documented
+                       tolerance bound (serving/engine.py
+                       SERVE_REDUCED_RTOL/ATOL)
+  BENCH_KERNELS_BATCH / BENCH_KERNELS_NODES / BENCH_KERNELS_DEG /
+  BENCH_KERNELS_HIDDEN / BENCH_KERNELS_STEPS
+                       kernel-mode scale (default 8/40/8/64/3 — CPU
+                       interpret-mode Pallas is orders slower than the
+                       compiled TPU kernel, so the CPU smoke stays
+                       small; crank these up on-chip)
+  BENCH_KERNELS_OUT    also write the kernel JSON to this path (the
+                       nightly kernel-bench emits BENCH_KERNELS.json)
 """
 import itertools
 import json
@@ -372,6 +395,12 @@ def run_bench():
     }
     if flops_per_step is not None:
         out["flops_per_step"] = flops_per_step
+        # estimated achieved FLOP/s of the timed loop (XLA cost analysis
+        # x steps / wall time) — the MFU numerator, reported on EVERY
+        # backend as the first brick of the ROADMAP item 1 BENCH_MFU
+        # story; `mfu` itself stays accelerator-only below
+        achieved = flops_per_step * STEPS / best_dt
+        out["achieved_flops_per_s"] = round(achieved, 1)
         # MFU only for a real accelerator: quoting utilization against an
         # invented CPU "peak" is noise (round-2 verdict, Weak #1)
         if not backend.startswith("cpu"):
@@ -381,7 +410,6 @@ def run_bench():
                 peak = PEAK_FLOPS.get(kind, PEAK_FLOPS["TPU v5e"])
                 if compute_dtype == "float32":
                     peak /= 2.0
-            achieved = flops_per_step * STEPS / best_dt
             out["mfu"] = round(achieved / peak, 5)
             out["peak_flops"] = peak
             out["device_kind"] = kind
@@ -409,7 +437,12 @@ def _bench_model(samples):
     mcfg = build_model_config(cfg)
     model = create_model(mcfg)
     tx = select_optimizer(cfg["NeuralNetwork"]["Training"])
-    compute_dtype = os.environ.get("BENCH_DTYPE", "float32")
+    # precedence (train/precision.py): BENCH_DTYPE explicit override,
+    # then the HYDRAGNN_PRECISION policy knob, then float32 — the
+    # reported `dtype` field is the RESOLVED canonical name
+    from hydragnn_tpu.train.precision import resolve_precision
+    compute_dtype = resolve_precision(
+        None, os.environ.get("BENCH_DTYPE") or None)
     train_step = make_train_step(model, mcfg, tx, loss_name="mae",
                                  compute_grad_energy=True, donate=False,
                                  compute_dtype=compute_dtype)
@@ -564,6 +597,8 @@ def run_bench_sized(backend, size_range):
     }
     if flops_per_step is not None:
         out["flops_per_step"] = flops_per_step
+        out["achieved_flops_per_s"] = round(
+            flops_per_step * len(batches) / best_dt, 1)
     return out
 
 
@@ -1113,6 +1148,212 @@ def run_bench_preproc(backend=None):
     return out
 
 
+def run_bench_kernels(backend=None):
+    """BENCH_KERNELS: fused message-passing + mixed-precision
+    adjudication (docs/kernels_mixed_precision.md).
+
+    For SchNet and PNA (the two conv families the fused kernels cover),
+    time the full train step over {unfused, fused} x {float32, bfloat16}
+    on IDENTICAL edge-list batches. graphs/s counts real graphs only
+    (padding-aware — the fixed pad slots are excluded from the numerator
+    exactly like the sized mode), every point reports the forward
+    max-abs-diff against the unfused fp32 reference, and the fused fp32
+    point's parity against the unfused path is the tier-1 kernel
+    contract re-checked at bench scale. A serving leg then runs a bf16
+    engine and an fp32 engine over identical samples/buckets and
+    adjudicates the bf16 outputs against the documented tolerance bound
+    (serving/engine.py SERVE_REDUCED_RTOL/ATOL).
+
+    The fused points are honest about the backend: on CPU the Pallas
+    kernels run in interpret mode and are expected to be far slower than
+    XLA (the r3 HYDRAGNN_USE_PALLAS lesson) — the CPU numbers guard
+    correctness and wiring; the speedup question is answered on-chip."""
+    import jax
+    from hydragnn_tpu.config import build_model_config, update_config
+    from hydragnn_tpu.graphs.batch import collate
+    from hydragnn_tpu.kernels.fused_mp_pallas import resolve_fused_mp_flag
+    from hydragnn_tpu.models.create import create_model, init_params
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.train_step import (TrainState, make_forward_fn,
+                                               make_train_step)
+    from tests.utils import make_config
+
+    if backend is None:
+        backend = _resolve_backend_and_cache()
+    batch_g = int(os.environ.get("BENCH_KERNELS_BATCH", "8"))
+    nodes_g = int(os.environ.get("BENCH_KERNELS_NODES", "40"))
+    deg = int(os.environ.get("BENCH_KERNELS_DEG", "8"))
+    hidden = int(os.environ.get("BENCH_KERNELS_HIDDEN", "64"))
+    steps = int(os.environ.get("BENCH_KERNELS_STEPS", "3"))
+
+    rng = np.random.RandomState(0)
+    from hydragnn_tpu.graphs.batch import GraphSample
+    samples = []
+    for _ in range(batch_g):
+        pos = rng.rand(nodes_g, 3).astype(np.float32) * 10
+        send = np.repeat(np.arange(nodes_g), deg).astype(np.int32)
+        recv = rng.randint(0, nodes_g, nodes_g * deg).astype(np.int32)
+        x = rng.rand(nodes_g, 1).astype(np.float32)
+        samples.append(GraphSample(x=x, pos=pos, senders=send,
+                                   receivers=recv, y_node=x))
+    n_node = batch_g * nodes_g + 8
+    n_edge = batch_g * nodes_g * deg + 8
+    batch = collate(samples, n_node=n_node, n_edge=n_edge,
+                    n_graph=batch_g + 1)
+    real_graphs = int(np.asarray(batch.graph_mask).sum())
+
+    saved_env = {k: os.environ.pop(k, None)
+                 for k in ("HYDRAGNN_FUSED_MP", "HYDRAGNN_PRECISION",
+                           "BENCH_DTYPE")}
+    grid = []
+    try:
+        for model_type in ("SchNet", "PNA"):
+            cfg = make_config(model_type, heads=("node",),
+                              hidden_dim=hidden, num_conv_layers=2,
+                              radius=6.0)
+            cfg = update_config(cfg, samples)
+            mcfg = build_model_config(cfg)
+            model = create_model(mcfg)
+            tx = select_optimizer(cfg["NeuralNetwork"]["Training"])
+            variables = init_params(model, batch)
+            ref_out = None
+            for dtype in ("float32", "bfloat16"):
+                for fused in (False, True):
+                    os.environ["HYDRAGNN_FUSED_MP"] = "1" if fused else "0"
+                    # the step factory re-resolves the flag at
+                    # construction (the contract this mode relies on)
+                    step = make_train_step(model, mcfg, tx,
+                                           loss_name="mae", donate=False,
+                                           compute_dtype=dtype)
+                    forward = make_forward_fn(model, mcfg,
+                                              compute_dtype=dtype)
+                    state = TrainState.create(variables, tx)
+                    flops = _step_flops(step, state, batch)
+                    state, metrics = step(state, batch)   # warmup/compile
+                    _sync_loss(metrics)
+
+                    def reps():
+                        nonlocal state
+                        m = None
+                        for _ in range(steps):
+                            state, m = step(state, batch)
+                        _sync_loss(m)
+                    dt = _best_of(2, reps)
+                    outs, _ = forward(variables, batch)
+                    if ref_out is None:       # unfused fp32 = reference
+                        ref_out = outs
+                    diff = max(float(np.abs(np.asarray(a, np.float32)
+                                            - np.asarray(b, np.float32)
+                                            ).max())
+                               for a, b in zip(outs, ref_out))
+                    point = {
+                        "model": model_type,
+                        "fused": fused,
+                        "dtype": dtype,
+                        "graphs_per_s": round(real_graphs * steps / dt, 2),
+                        "fwd_max_abs_diff_vs_unfused_fp32": diff,
+                    }
+                    if flops is not None:
+                        point["flops_per_step"] = flops
+                        point["achieved_flops_per_s"] = round(
+                            flops * steps / dt, 1)
+                    grid.append(point)
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        resolve_fused_mp_flag(refresh=True)
+
+    def _gps(model, fused, dtype):
+        return next(p["graphs_per_s"] for p in grid
+                    if (p["model"], p["fused"], p["dtype"])
+                    == (model, fused, dtype))
+
+    # serving leg: bf16 vs fp32 engines on identical samples + explicit
+    # shared buckets — the tolerance-bound adjudication
+    from hydragnn_tpu.serving.engine import (SERVE_REDUCED_ATOL,
+                                             SERVE_REDUCED_RTOL,
+                                             InferenceEngine)
+    cfg = make_config("PNA", heads=("node",), hidden_dim=hidden,
+                      num_conv_layers=2, radius=6.0)
+    cfg = update_config(cfg, samples)
+    mcfg = build_model_config(cfg)
+    model = create_model(mcfg)
+    variables = init_params(model, batch)
+    serve_n = min(len(samples), 8)
+    engines = {}
+    serve_out = {}
+    try:
+        for dtype in ("float32", "bfloat16"):
+            engines[dtype] = InferenceEngine(
+                model, variables, mcfg, reference_samples=samples,
+                max_batch_size=4, max_wait_ms=1.0, num_buckets=1,
+                compute_dtype=dtype)
+            t0 = time.perf_counter()
+            serve_out[dtype] = engines[dtype].predict(samples[:serve_n],
+                                                      timeout=600)
+            serve_out[dtype + "_dt"] = time.perf_counter() - t0
+        worst = -np.inf   # most-positive |diff| - bound; negative = inside
+        within = True
+        for res32, res16 in zip(serve_out["float32"],
+                                serve_out["bfloat16"]):
+            for a, b in zip(res32, res16):
+                a = np.asarray(a, np.float32)
+                b = np.asarray(b, np.float32)
+                bound = SERVE_REDUCED_ATOL + SERVE_REDUCED_RTOL * np.abs(a)
+                worst = max(worst, float((np.abs(b - a) - bound).max()))
+                within = within and bool((np.abs(b - a) <= bound).all())
+        serving = {
+            "requests": serve_n,
+            "fp32_gps": round(serve_n / serve_out["float32_dt"], 2),
+            "bf16_gps": round(serve_n / serve_out["bfloat16_dt"], 2),
+            "tolerance_rtol": SERVE_REDUCED_RTOL,
+            "tolerance_atol": SERVE_REDUCED_ATOL,
+            "bf16_within_bound": within,
+            "worst_margin_to_bound": worst,   # <= 0 means inside
+            "fp32_parity": engines["float32"].parity,
+            "bf16_parity": engines["bfloat16"].parity,
+        }
+    finally:
+        for eng in engines.values():
+            eng.shutdown()
+
+    out = {
+        "metric": "kernels_bf16_speedup_unfused_pna_train",
+        # the headline is the deployable-today win: bf16 over fp32 on the
+        # default (unfused) PNA path; the fused-kernel points are the
+        # on-chip A/B candidates and stay in the grid
+        "value": round(_gps("PNA", False, "bfloat16")
+                       / _gps("PNA", False, "float32"), 3),
+        "unit": "x",
+        "vs_baseline": None,
+        "backend": backend,
+        "shape": {"batch": batch_g, "nodes": nodes_g, "deg": deg,
+                  "hidden": hidden, "steps": steps},
+        "real_graphs_per_step": real_graphs,
+        "padding_frac_nodes": round(
+            1.0 - int(np.asarray(batch.node_mask).sum()) / n_node, 4),
+        "padding_frac_edges": round(
+            1.0 - int(np.asarray(batch.edge_mask).sum()) / n_edge, 4),
+        "fused_speedup_fp32": {
+            m: round(_gps(m, True, "float32") / _gps(m, False, "float32"),
+                     3) for m in ("SchNet", "PNA")},
+        "bf16_speedup_unfused": {
+            m: round(_gps(m, False, "bfloat16")
+                     / _gps(m, False, "float32"), 3)
+            for m in ("SchNet", "PNA")},
+        "grid": grid,
+        "serving": serving,
+    }
+    out_path = os.environ.get("BENCH_KERNELS_OUT", "").strip()
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1)
+    return out
+
+
 def sweep():
     """Run the (nbr-layout x pallas x steps-per-call) grid, each point in a
     fresh subprocess (the flags are read once per process), and report the
@@ -1159,6 +1400,8 @@ def main():
         out = run_bench_faults()
     elif os.environ.get("BENCH_PREPROC") == "1":
         out = run_bench_preproc()
+    elif os.environ.get("BENCH_KERNELS") == "1":
+        out = run_bench_kernels()
     else:
         out = run_bench()
     print(json.dumps(out))

@@ -362,7 +362,11 @@ class ModelConfig:
     initial_bias: Optional[float] = None
     conv_checkpointing: bool = False
     batch_norm: bool = True
-    dtype: str = "float32"         # compute dtype ("bfloat16" on TPU hot path)
+    # compute dtype ("bfloat16" on the TPU hot path). Lowest-precedence
+    # input to the mixed-precision policy — HYDRAGNN_PRECISION and
+    # explicit per-construction overrides win (train/precision.py,
+    # docs/kernels_mixed_precision.md)
+    dtype: str = "float32"
 
 
 def build_model_config(config: Dict[str, Any]) -> ModelConfig:
@@ -449,5 +453,14 @@ def build_model_config(config: Dict[str, Any]) -> ModelConfig:
         initial_bias=arch.get("initial_bias"),
         conv_checkpointing=bool(train_cfg.get("conv_checkpointing", False)),
         batch_norm=not bool(arch.get("equivariance", False)),
-        dtype=arch.get("dtype", "float32"),
+        dtype=_canonical_dtype(arch.get("dtype")),
     )
+
+
+def _canonical_dtype(name) -> str:
+    """Canonicalize Architecture.dtype spellings ("bf16" -> "bfloat16")
+    so ModelConfig carries one name per precision; unrecognized values
+    warn and fall back to float32 via the ONE shared fallback
+    (train/precision.canonical_or_f32)."""
+    from ..train.precision import canonical_or_f32
+    return canonical_or_f32(name)

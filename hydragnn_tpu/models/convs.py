@@ -231,10 +231,24 @@ class PNAConv(nn.Module):
                 mean, mn, mx, sd, deg = seg.neighbor_aggregate(
                     h, batch.nbr_mask)
         else:
-            h = proj_i[batch.receivers] + proj_j[batch.senders]
-            h = edge_terms(h, lambda ev: ev)
-            mean, mn, mx, sd, deg = seg.pna_aggregate(
-                h, batch.receivers, n, batch.edge_mask)
+            from ..kernels.fused_mp_pallas import (fused_mp_enabled,
+                                                   fused_pna_edge_aggregate,
+                                                   interpret_mode)
+            if (not self.edge_dim and not self.rbf
+                    and batch.edge_mask is not None
+                    and fused_mp_enabled(proj_j.shape, proj_j.dtype)):
+                # fused gather->edge-add->stats Pallas kernel: no [E, F]
+                # edge tensor in HBM (HYDRAGNN_FUSED_MP=1, resolved once
+                # at step construction — kernels/fused_mp_pallas.py
+                # decision record; A/B via bench BENCH_KERNELS)
+                mean, mn, mx, sd, deg = fused_pna_edge_aggregate(
+                    proj_i, proj_j, batch.senders, batch.receivers,
+                    batch.edge_mask, n, 1e-5, interpret_mode())
+            else:
+                h = proj_i[batch.receivers] + proj_j[batch.senders]
+                h = edge_terms(h, lambda ev: ev)
+                mean, mn, mx, sd, deg = seg.pna_aggregate(
+                    h, batch.receivers, n, batch.edge_mask)
         aggs = jnp.concatenate([mean, mn, mx, sd], axis=-1)      # [N, 4F]
 
         avg_lin, avg_log = pna_degree_stats(self.deg_hist)

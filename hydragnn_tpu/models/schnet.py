@@ -59,8 +59,11 @@ class CFConv(nn.Module):
             trans = jnp.clip(coord_diff * phi, -100.0, 100.0)
             pos = pos + seg.edge_aggregate_mean(trans, batch)
 
-        msgs = h[batch.senders] * W
-        h = seg.edge_aggregate_sum(msgs, batch)
+        # filter-weighted aggregation: dense layout -> masked K-axis
+        # reduction; edge list -> fused Pallas gather->mult->scatter when
+        # HYDRAGNN_FUSED_MP is on (kernels/fused_mp_pallas.py), else the
+        # unfused gather + segment scatter
+        h = seg.filter_weighted_aggregate(h, W, batch)
         h = nn.Dense(self.num_filters, name="lin2")(h)
         h = shifted_softplus(h)
         h = nn.Dense(self.out_dim, name="lin_out")(h)

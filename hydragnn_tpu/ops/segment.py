@@ -44,6 +44,18 @@ def _use_pallas() -> bool:
     return _PALLAS_STATE["on"]
 
 
+def _accum_f32(data):
+    """Mixed-precision accumulation policy
+    (docs/kernels_mixed_precision.md): reduced-precision segment
+    reductions accumulate in f32 and store back reduced — a bf16
+    pairwise sum over a 30-neighbor radius-graph segment loses low bits
+    at every add otherwise. Returns (upcast data, dtype to cast the
+    result back to, or None for the f32/f64 no-op)."""
+    if data.dtype in (jnp.bfloat16, jnp.float16):
+        return data.astype(jnp.float32), data.dtype
+    return data, None
+
+
 def segment_sum(data, segment_ids, num_segments, mask=None,
                 indices_are_sorted=False):
     """`indices_are_sorted` is the static XLA hint for nondecreasing
@@ -54,13 +66,16 @@ def segment_sum(data, segment_ids, num_segments, mask=None,
     XLA is allowed to return garbage otherwise."""
     if mask is not None:
         data = jnp.where(_bcast(mask, data), data, 0.0)
+    data, store_dtype = _accum_f32(data)
     if (data.ndim == 2 and jnp.issubdtype(data.dtype, jnp.floating)
             and _use_pallas()):
         from ..kernels.segment_pallas import segment_sum_pallas
-        return segment_sum_pallas(data, segment_ids, num_segments,
-                                  _PALLAS_STATE["interpret"])
-    return jax.ops.segment_sum(data, segment_ids, num_segments,
-                               indices_are_sorted=indices_are_sorted)
+        out = segment_sum_pallas(data, segment_ids, num_segments,
+                                 _PALLAS_STATE["interpret"])
+    else:
+        out = jax.ops.segment_sum(data, segment_ids, num_segments,
+                                  indices_are_sorted=indices_are_sorted)
+    return out if store_dtype is None else out.astype(store_dtype)
 
 
 def segment_count(segment_ids, num_segments, mask=None,
@@ -106,6 +121,21 @@ def segment_std(data, segment_ids, num_segments, mask=None, eps=1e-5):
     return jnp.sqrt(var + eps)
 
 
+def pna_stats_epilogue(s, sq, cnt, mn, mx, eps=1e-5):
+    """(mean, min, max, std, degree) from the raw additive accumulators
+    and extrema. The SHARED epilogue of `pna_aggregate` and the fused
+    Pallas kernel (kernels/fused_mp_pallas.py): one traced subgraph, so
+    a composite loss reading several statistics accumulates its
+    cotangents through the mean/std interdependence identically on both
+    paths — splitting this math across the kernel's custom-VJP boundary
+    measurably reorders the last-ulp gradient accumulation."""
+    cnt_safe = jnp.maximum(cnt, 1.0)
+    mean = s / cnt_safe
+    var = jnp.maximum(sq / cnt_safe - mean * mean, 0.0)
+    std = jnp.sqrt(var + eps)
+    return mean, mn, mx, std, cnt[..., 0]
+
+
 def pna_aggregate(data, segment_ids, num_segments, mask=None, eps=1e-5):
     """Fused PNA aggregation -> (mean, min, max, std, degree).
 
@@ -121,13 +151,9 @@ def pna_aggregate(data, segment_ids, num_segments, mask=None, eps=1e-5):
     packed_sum = segment_sum(packed, segment_ids, num_segments, mask)
     s, sq, cnt = (packed_sum[..., :f], packed_sum[..., f:2 * f],
                   packed_sum[..., 2 * f:])
-    cnt_safe = jnp.maximum(cnt, 1.0)
-    mean = s / cnt_safe
-    var = jnp.maximum(sq / cnt_safe - mean * mean, 0.0)
-    std = jnp.sqrt(var + eps)
     mn = segment_min(data, segment_ids, num_segments, mask)
     mx = segment_max(data, segment_ids, num_segments, mask)
-    return mean, mn, mx, std, cnt[..., 0]
+    return pna_stats_epilogue(s, sq, cnt, mn, mx, eps)
 
 
 def neighbor_aggregate(h, nbr_mask, eps=1e-5):
@@ -156,9 +182,14 @@ def neighbor_aggregate(h, nbr_mask, eps=1e-5):
 
 
 def neighbor_sum(h, nbr_mask):
-    """Masked sum over the K axis of [N, K, ...] dense-layout messages."""
+    """Masked sum over the K axis of [N, K, ...] dense-layout messages.
+    Reduced-precision inputs accumulate in f32 (the same policy as
+    `segment_sum` — the dense layout is the moral equivalent of the
+    scatter it replaces)."""
     m = nbr_mask.reshape(nbr_mask.shape + (1,) * (h.ndim - 2))
-    return jnp.sum(jnp.where(m, h, 0.0), axis=1)
+    masked, store_dtype = _accum_f32(jnp.where(m, h, 0.0))
+    out = jnp.sum(masked, axis=1)
+    return out if store_dtype is None else out.astype(store_dtype)
 
 
 def neighbor_mean(h, nbr_mask):
@@ -177,6 +208,34 @@ def edge_aggregate_sum(edge_values, batch):
         return neighbor_sum(edge_values[batch.nbr_edge], batch.nbr_mask)
     return segment_sum(edge_values, batch.receivers, batch.num_nodes,
                        batch.edge_mask)
+
+
+def filter_weighted_aggregate(h, w, batch):
+    """SchNet CFConv aggregation: sum_{e: recv[e]=n} h[send[e]] * w[e]
+    (models/schnet.py; reference: SCFStack.py:143-223 CFConv propagate).
+
+    Routing: the dense neighbor layout keeps its masked K-axis
+    reduction; the edge-list layout goes through the fused
+    gather->multiply->scatter Pallas kernel when HYDRAGNN_FUSED_MP is on
+    and the node array fits VMEM (kernels/fused_mp_pallas.py — parity
+    contract pinned in tests/test_kernels.py), else the unfused
+    gather + masked segment scatter."""
+    if batch.nbr_edge is not None:
+        return neighbor_sum((h[batch.senders] * w)[batch.nbr_edge],
+                            batch.nbr_mask)
+    if batch.edge_mask is not None:
+        from ..kernels.fused_mp_pallas import (fused_filter_scatter,
+                                               fused_mp_enabled,
+                                               interpret_mode)
+        # VMEM bound against the PROMOTED dtype: a bf16 h multiplied by
+        # an f32 filter runs the kernel in f32 (fused_mp_pallas mirrors
+        # the unfused promotion)
+        if fused_mp_enabled(h.shape, jnp.promote_types(h.dtype, w.dtype)):
+            return fused_filter_scatter(h, w, batch.senders,
+                                        batch.receivers, batch.edge_mask,
+                                        batch.num_nodes, interpret_mode())
+    return segment_sum(h[batch.senders] * w, batch.receivers,
+                       batch.num_nodes, batch.edge_mask)
 
 
 def edge_aggregate_mean(edge_values, batch):

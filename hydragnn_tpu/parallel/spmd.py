@@ -31,7 +31,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config.config import ModelConfig
 from ..graphs.batch import GraphBatch
-from ..train.train_step import (TrainState, eval_metrics_and_outputs,
+from ..train.train_step import (TrainState, _nonfinite_watchdog,
+                                eval_metrics_and_outputs,
                                 freeze_conv_grads, make_forward_fn,
                                 make_loss_fn)
 
@@ -73,9 +74,15 @@ def _make_spmd_step_body(model, cfg: ModelConfig,
         local = jax.tree_util.tree_map(
             lambda a: None if a is None else a[0], batch)
         grads_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (_, (new_bs, metrics)), grads = grads_fn(params, batch_stats, local)
+        (total, (new_bs, metrics)), grads = grads_fn(params, batch_stats,
+                                                     local)
+        # per-replica watchdog flag BEFORE the gradient pmean (a pmean'd
+        # NaN poisons every replica — the pre-reduce flag names the step
+        # that actually went bad); pmax: the STEP is bad if ANY shard is
+        nonfinite = _nonfinite_watchdog(total, grads)
         grads = freeze_conv_grads(jax.lax.pmean(grads, "data"), cfg)
-        metrics = jax.lax.pmean(metrics, "data")
+        metrics = dict(jax.lax.pmean(metrics, "data"))
+        metrics["nonfinite_steps"] = jax.lax.pmax(nonfinite, "data")
         # cross-replica BatchNorm running stats (SyncBatchNorm semantics)
         new_bs = jax.lax.pmean(new_bs, "data")
         return grads, new_bs, metrics

@@ -210,6 +210,10 @@ def _predict_with_engine(model, state, mcfg, testset, serving, num_shards,
         bucket_multiple=serving.bucket_multiple,
         num_shards=num_shards if num_shards and num_shards > 1 else 1,
         neighbor_format=neighbor_format, neighbor_k=neighbor_k,
+        # serve-side precision override (Serving.precision /
+        # HYDRAGNN_SERVE_PRECISION, docs/kernels_mixed_precision.md);
+        # None inherits the train-side policy
+        compute_dtype=serving.precision,
         # the failure-semantics knobs (max_queue/deadline_ms/breaker_*)
         # deliberately stay at their permissive defaults here: this is the
         # OFFLINE batch-predict path, which submits the whole testset at

@@ -18,12 +18,20 @@ Config schema (top-level block, alongside "Dataset"/"NeuralNetwork"):
         "max_queue": 0,            # bounded admission queue (0 = unbounded)
         "deadline_ms": 0.0,        # default per-request deadline (0 = none)
         "breaker_threshold": 5,    # consecutive batch failures to trip
-        "breaker_reset_s": 30.0    # open -> half-open probe window
+        "breaker_reset_s": 30.0,   # open -> half-open probe window
+        "precision": null          # serve-side compute dtype override
     }
 
-The last four are the failure-semantics knobs (docs/fault_tolerance.md):
-QueueFullError backpressure, DeadlineExceededError expiry, and the
-dispatcher circuit breaker.
+The queue/deadline/breaker knobs are the failure-semantics layer
+(docs/fault_tolerance.md): QueueFullError backpressure,
+DeadlineExceededError expiry, and the dispatcher circuit breaker.
+
+`precision` (env: HYDRAGNN_SERVE_PRECISION; "float32" | "bfloat16") is
+the serve-side compute-dtype override (docs/kernels_mixed_precision.md):
+unset, the engine inherits the train-side policy (HYDRAGNN_PRECISION /
+Architecture.dtype). A reduced-precision engine relaxes the PR 3
+bitwise-parity adjudication to the documented tolerance bound — each
+resolved future carries the bound (engine.py SERVE_REDUCED_RTOL/ATOL).
 """
 from __future__ import annotations
 
@@ -42,14 +50,16 @@ class ServingConfig:
     deadline_ms: float = 0.0      # 0 = no default per-request deadline
     breaker_threshold: int = 5    # 0 disables the circuit breaker
     breaker_reset_s: float = 30.0
+    precision: Optional[str] = None  # None = inherit the train-side policy
 
 
 def resolve_serving(config: Optional[Dict[str, Any]]) -> ServingConfig:
     """Merge the `Serving` config block and the HYDRAGNN_SERVE_* env knobs
     into one ServingConfig. Shared by run_prediction and bench.py so the
     precedence cannot drift."""
-    from ..utils.envflags import (env_strict_flag, env_strict_float,
-                                  env_strict_int)
+    from ..train.precision import PRECISION_CHOICES, canonical_precision
+    from ..utils.envflags import (env_strict_choice, env_strict_flag,
+                                  env_strict_float, env_strict_int)
     block = (config or {}).get("Serving", {}) or {}
     base = ServingConfig(
         enabled=bool(block.get("enabled", False)),
@@ -61,6 +71,7 @@ def resolve_serving(config: Optional[Dict[str, Any]]) -> ServingConfig:
         deadline_ms=float(block.get("deadline_ms", 0.0)),
         breaker_threshold=int(block.get("breaker_threshold", 5)),
         breaker_reset_s=float(block.get("breaker_reset_s", 30.0)),
+        precision=canonical_precision(block.get("precision")),
     )
     return ServingConfig(
         enabled=env_strict_flag("HYDRAGNN_SERVE", base.enabled),
@@ -80,4 +91,6 @@ def resolve_serving(config: Optional[Dict[str, Any]]) -> ServingConfig:
                                          base.breaker_threshold),
         breaker_reset_s=env_strict_float("HYDRAGNN_SERVE_BREAKER_RESET_S",
                                          base.breaker_reset_s),
+        precision=env_strict_choice("HYDRAGNN_SERVE_PRECISION",
+                                    PRECISION_CHOICES, base.precision),
     )

@@ -79,10 +79,13 @@ def _cast_floats(tree, dtype):
 
 def _resolve_compute_dtype(cfg: ModelConfig, compute_dtype):
     """bf16 mixed precision: params/opt-state/losses stay f32, model compute
-    runs in bfloat16 (MXU-native). Selected by Architecture.dtype or the
-    explicit `compute_dtype` argument."""
-    name = compute_dtype or getattr(cfg, "dtype", None) or "float32"
-    return jnp.dtype(name)
+    runs in bfloat16 (MXU-native). Precedence (train/precision.py): the
+    explicit `compute_dtype` argument, then HYDRAGNN_PRECISION (strict
+    parsing), then Architecture.dtype, then float32 — resolved HERE at
+    construction time, never in trace."""
+    from .precision import resolve_precision
+    return jnp.dtype(resolve_precision(getattr(cfg, "dtype", None),
+                                       compute_dtype))
 
 
 def make_loss_fn(model, cfg: ModelConfig, loss_name: str = "mse",
@@ -95,8 +98,10 @@ def make_loss_fn(model, cfg: ModelConfig, loss_name: str = "mse",
     SPMD factories in parallel/spmd.py so the two paths cannot drift."""
     # pin env-dependent kernel choices NOW: the traced body must not read
     # os.environ (a post-compile toggle would silently no-op — r5 advisor)
+    from ..kernels.fused_mp_pallas import resolve_fused_mp_flag
     from ..kernels.nbr_pallas import resolve_nbr_pallas_flag
     resolve_nbr_pallas_flag(refresh=True)
+    resolve_fused_mp_flag(refresh=True)
     cdtype = _resolve_compute_dtype(cfg, compute_dtype)
     mixed = cdtype != jnp.float32
 
@@ -147,6 +152,19 @@ def make_loss_fn(model, cfg: ModelConfig, loss_name: str = "mse",
     return loss_fn
 
 
+def _nonfinite_watchdog(loss, grads):
+    """1.0 when this step's loss or ANY gradient leaf carries a
+    non-finite value, else 0.0 — the per-step brick of the bf16
+    overflow watchdog. The any-reduction tree is cheap (one isfinite
+    pass over the gradient pytree XLA fuses into the backward) and runs
+    at every precision: an fp32 divergence deserves the same counter."""
+    bad = ~jnp.isfinite(loss)
+    for leaf in jax.tree_util.tree_leaves(grads):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            bad = bad | ~jnp.all(jnp.isfinite(leaf))
+    return bad.astype(jnp.float32)
+
+
 def _make_step_body(model, cfg: ModelConfig, tx: optax.GradientTransformation,
                     loss_name: str = "mse", compute_grad_energy: bool = False,
                     energy_weight: float = 1.0, force_weight: float = 1.0,
@@ -158,8 +176,17 @@ def _make_step_body(model, cfg: ModelConfig, tx: optax.GradientTransformation,
 
     def step_body(state: TrainState, batch: GraphBatch):
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (_, (new_bs, metrics)), grads = grad_fn(
+        (total, (new_bs, metrics)), grads = grad_fn(
             state.params, state.batch_stats, batch)
+        # NaN/overflow watchdog (docs/kernels_mixed_precision.md): bf16's
+        # 8-bit significand and 8-bit exponent overflow/flush far earlier
+        # than f32, and a silently-NaN'd optimizer poisons every later
+        # step — count the bad steps where they happen. Computed BEFORE
+        # the conv freeze (a frozen layer's non-finite gradient is still
+        # a training bug worth surfacing); the trainer sums this per
+        # epoch into history/TB `nonfinite_steps`.
+        metrics = {**metrics,
+                   "nonfinite_steps": _nonfinite_watchdog(total, grads)}
         grads = freeze_conv_grads(grads, cfg)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         updates = freeze_conv_grads(updates, cfg)
@@ -216,8 +243,10 @@ def make_forward_fn(model, cfg: Optional[ModelConfig] = None,
     outputs out, model compute in Architecture.dtype (or `compute_dtype`).
     The ONE eval-side casting policy, shared by the single-device eval
     body here and the SPMD eval/predict factories in parallel/spmd.py."""
+    from ..kernels.fused_mp_pallas import resolve_fused_mp_flag
     from ..kernels.nbr_pallas import resolve_nbr_pallas_flag
     resolve_nbr_pallas_flag(refresh=True)  # pinned at construction time
+    resolve_fused_mp_flag(refresh=True)
     cdtype = _resolve_compute_dtype(cfg, compute_dtype)
     mixed = cdtype != jnp.float32
 

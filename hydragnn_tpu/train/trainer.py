@@ -405,6 +405,12 @@ def train_validate_test(
                 _preempt_save(epoch, epoch_start_state)
             break
         train_loss = acc_train.pop("loss", 0.0) / max(nb, 1)
+        # NaN/overflow watchdog (train_step._nonfinite_watchdog): COUNT of
+        # steps this epoch whose loss or gradients went non-finite — the
+        # bf16 mixed-precision canary (docs/kernels_mixed_precision.md),
+        # a sum not a mean, surfaced next to input_bound_frac
+        nonfinite_steps = acc_train.pop("nonfinite_steps", 0.0)
+        history.setdefault("nonfinite_steps", []).append(nonfinite_steps)
         task_tot = acc_train
         # host-stall report: fraction of the train pass the host (and so
         # the device) was blocked on the input pipeline rather than
@@ -483,6 +489,7 @@ def train_validate_test(
         if tb is not None:
             tb.add_scalar("train/loss", train_loss, epoch)
             tb.add_scalar("train/input_bound_frac", input_bound, epoch)
+            tb.add_scalar("train/nonfinite_steps", nonfinite_steps, epoch)
             if pad_stats is not None:
                 tb.add_scalar("train/padding_frac_nodes",
                               float(pad_stats["padding_frac_nodes"]), epoch)
@@ -503,6 +510,8 @@ def train_validate_test(
                       f" pad_e {pad_stats['padding_frac_edges']:.3f}")
         if recompiles is not None:
             extra += f" recompiles {recompiles}"
+        if nonfinite_steps:
+            extra += f" NONFINITE_STEPS {int(nonfinite_steps)}"
         log(f"epoch {epoch}: train {train_loss:.5f} val {val_loss:.5f} "
             f"test {test_loss:.5f} lr {lr:.2e} "
             f"input_bound {input_bound:.3f}" + extra)
@@ -581,7 +590,8 @@ def _accumulate_metrics(acc: Dict[str, float], metrics, summed=False):
     metrics dict, not one per key."""
     vals = jax.device_get(metrics)
     for k, v in vals.items():
-        if k == "loss" or k.startswith("task_") or k.endswith("_loss"):
+        if (k == "loss" or k == "nonfinite_steps" or k.startswith("task_")
+                or k.endswith("_loss")):
             acc[k] = acc.get(k, 0.0) + (float(np.sum(v)) if summed
                                         else float(v))
 

@@ -42,6 +42,27 @@ def env_strict_flag(name: str, default: bool = False) -> bool:
     return default
 
 
+def env_strict_choice(name: str, choices, default=None):
+    """String env knob restricted to a canonical choice set. `choices`
+    maps accepted (lowercased) spellings to canonical values (e.g.
+    {"bf16": "bfloat16", "bfloat16": "bfloat16"}). An unrecognized value
+    warns and returns `default` instead of taking effect — the
+    HYDRAGNN_PALLAS_NBR lesson, applied to the mixed-precision knobs
+    (HYDRAGNN_PRECISION / HYDRAGNN_SERVE_PRECISION) where a typo must
+    never silently change the compute dtype."""
+    val = os.getenv(name)
+    if val is None or not val.strip():
+        return default
+    v = val.strip().lower()
+    if v in choices:
+        return choices[v]
+    import logging
+    logging.getLogger("hydragnn_tpu").warning(
+        "%s=%r is not one of %s; treating as %r", name, val,
+        sorted(set(choices)), default)
+    return default
+
+
 def env_int(name: str, default=None):
     val = os.getenv(name)
     if val is None or not val.strip():

@@ -63,6 +63,12 @@ def test_lint_covers_the_known_offender_modules():
     assert os.path.join("hydragnn_tpu", "models", "convs.py") in paths
     assert os.path.join("hydragnn_tpu", "kernels", "nbr_pallas.py") in paths
     assert os.path.join("hydragnn_tpu", "train", "train_step.py") in paths
+    # PR 6 additions: the fused message-passing kernels and the
+    # mixed-precision policy module resolve their flags at construction
+    # (HYDRAGNN_FUSED_MP / HYDRAGNN_PRECISION) — keep them linted
+    assert os.path.join("hydragnn_tpu", "kernels",
+                        "fused_mp_pallas.py") in paths
+    assert os.path.join("hydragnn_tpu", "train", "precision.py") in paths
 
 
 def test_lint_cli_exit_code():

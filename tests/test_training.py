@@ -475,6 +475,9 @@ def test_per_task_val_test_history():
                 "test_task_0", "test_task_1"):
         assert key in history and len(history[key]) == 2, key
         assert all(np.isfinite(v) for v in history[key]), key
+    # the NaN/overflow watchdog reports per epoch next to input_bound_frac
+    # (train_step._nonfinite_watchdog); a healthy fp32 run counts zero
+    assert history["nonfinite_steps"] == [0.0, 0.0]
 
 
 def test_gradient_accumulation_matches_large_batch():

@@ -37,6 +37,11 @@ TRACED_DIRS = (
 TRACED_FILES = (
     os.path.join("hydragnn_tpu", "train", "train_step.py"),
     os.path.join("hydragnn_tpu", "train", "loss.py"),
+    # the mixed-precision policy module: resolve_precision is called by
+    # step/engine factories whose results are baked into compiled
+    # programs — an env read here would be the same trace-time-frozen
+    # bug class, so it must go through utils/envflags like the kernels
+    os.path.join("hydragnn_tpu", "train", "precision.py"),
 )
 
 
