@@ -112,6 +112,14 @@ def fetch_samples(dataset, indices, what: str = "dataset") -> list:
                     raise
                 import logging
                 import time as _time
+
+                # telemetry: retries are the flaky-I/O canary monitors
+                # watch (docs/observability.md); counted on the cold
+                # retry path only — a healthy fetch never touches it
+                from ..telemetry.registry import get_registry
+                get_registry().counter_inc(
+                    "loader_retries_total",
+                    help="transient dataset-fetch retries")
                 delay = min(backoff * (2 ** attempt), 1.0)
                 logging.getLogger("hydragnn_tpu").warning(
                     "transient fetch failure for %s[%s] (%s: %s); "
@@ -232,20 +240,39 @@ def iterate_async(loader, selections: Sequence[Tuple[int, ...]],
     ex = _loader_pool(loader, num_workers)
     pending: "collections.deque" = collections.deque()
 
+    # span tracing (docs/observability.md): with a telemetry session
+    # live, each worker-thread collation lands as a `loader.collate`
+    # span (and consumer-thread fetches as `loader.fetch`) so the Chrome
+    # trace shows the input pipeline overlapping the step timeline.
+    # spans.span checks the recorder AT EXECUTION TIME on the worker —
+    # one global read + None check per BATCH when disabled — so a
+    # session starting or ending while batches sit in the window cannot
+    # split-brain the already-queued work.
+    from ..telemetry import spans as _spans
+
+    def _build(sel):
+        with _spans.span("loader.collate", cat="loader"):
+            return loader._build_batch(sel)
+
+    def _build_from_samples(sel, samples):
+        with _spans.span("loader.collate", cat="loader"):
+            return loader._build_batch_from_samples(sel, samples)
+
     def submit(sel):
         hit = cache.get(sel) if cache is not None else None
         if hit is not None:
             pending.append((sel, None, hit))
             return
         if threadsafe:
-            fut = ex.submit(loader._build_batch, sel)
+            fut = ex.submit(_build, sel)
         else:
             # packed selections are nested per-shard tuples: flatten via
             # the loader so the fetch order matches _build_batch_from_samples
             flat = getattr(loader, "_flat_indices", None)
             idx = flat(sel) if flat is not None else sel
-            samples = fetch_samples(loader.dataset, idx)
-            fut = ex.submit(loader._build_batch_from_samples, sel, samples)
+            with _spans.span("loader.fetch", cat="loader"):
+                samples = fetch_samples(loader.dataset, idx)
+            fut = ex.submit(_build_from_samples, sel, samples)
         pending.append((sel, fut, None))
 
     try:

@@ -287,6 +287,14 @@ class PreprocessedCache:
         self.misses = 0
         self.invalid = 0
 
+    def _count(self, outcome: str) -> None:
+        # mirror into the process metrics registry (docs/observability.md)
+        # — cold path, once per cache probe per run
+        from ..telemetry.registry import get_registry
+        get_registry().counter_inc("preproc_cache_probes_total",
+                                   help="preprocessed-cache lookups",
+                                   outcome=outcome)
+
     def lookup(self, key: str):
         """(samples, extra_meta) on a verified hit, else None (miss or
         invalid — the caller rebuilds either way)."""
@@ -295,6 +303,7 @@ class PreprocessedCache:
                                         verify=self.verify)
         except FileNotFoundError:
             self.misses += 1
+            self._count("miss")
             return None
         except CacheInvalid as exc:
             import logging
@@ -302,8 +311,10 @@ class PreprocessedCache:
                 "preprocessed cache shard rejected, rebuilding: %s", exc)
             self.invalid += 1
             self.misses += 1
+            self._count("invalid")
             return None
         self.hits += 1
+        self._count("hit")
         return samples, extra
 
     def store(self, key: str, samples: Sequence[GraphSample],

@@ -223,6 +223,16 @@ def _predict_with_engine(model, state, mcfg, testset, serving, num_shards,
         # live traffic via the InferenceEngine API.
         breaker_threshold=0)
     try:
+        if serving.metrics_port:
+            # Serving.metrics_port / HYDRAGNN_SERVE_METRICS_PORT:
+            # /healthz + /metrics over HTTP for the run's duration
+            # (docs/observability.md); loopback-only here — fleet
+            # exposure is a deliberate InferenceEngine-API decision
+            server = engine.start_metrics_server(
+                port=serving.metrics_port)
+            import logging
+            logging.getLogger("hydragnn_tpu").info(
+                "serving metrics endpoint at %s/metrics", server.url)
         engine.warmup()
         results = engine.predict(testset)
     finally:

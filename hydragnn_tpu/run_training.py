@@ -196,6 +196,18 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     train_cfg = nn["Training"]
     batch_size = int(train_cfg["batch_size"])
 
+    # unified telemetry (docs/observability.md): HYDRAGNN_TELEMETRY /
+    # Training.Telemetry resolved ONCE here (strict parsing, outside any
+    # traced code). The session itself starts adjacent to the epoch-loop
+    # try below — start_session installs a process-wide registry/recorder
+    # whose uninstall lives in that try's finally, so an exception during
+    # the setup between here and there can never leak telemetry state
+    # into a later run in this process.
+    from .utils.envflags import resolve_telemetry
+    tel_cfg = resolve_telemetry(train_cfg)
+    tel_out = tel_cfg.resolve_out_dir(os.path.join("./logs", log_name))
+    telemetry = None
+
     # Architecture.graph_shards > 1: composed (data x graph) mesh — each
     # data shard's edge set is sharded over the graph axis
     # (parallel/composite.py). The graph axis claims its devices first;
@@ -598,12 +610,25 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         place_fn = lambda b: jax.tree_util.tree_map(
             lambda a: None if a is None else jax.device_put(a), b)
     # epoch-targeted device profiling (reference: `Profile` config section,
-    # run_training via train_validate_test.py:128-130; profile.py:32-42)
+    # run_training via train_validate_test.py:128-130; profile.py:32-42).
+    # One facility (telemetry.EpochDeviceTrace): the `Profile` block keeps
+    # its reference semantics, and a telemetry session's opt-in
+    # HYDRAGNN_DEVICE_TRACE bracket rides the same class targeting
+    # HYDRAGNN_DEVICE_TRACE_EPOCH.
     profiler = None
     if "Profile" in config:
-        from .utils.profiling import Profiler
-        profiler = Profiler(os.path.join("./logs", log_name))
+        from .telemetry import EpochDeviceTrace
+        profiler = EpochDeviceTrace(os.path.join("./logs", log_name))
         profiler.setup(config["Profile"])
+    elif tel_cfg.device_trace:
+        # honored STANDALONE: HYDRAGNN_DEVICE_TRACE=1 captures the
+        # target epoch even without the full telemetry session — the
+        # bracket needs no registry/recorder, and silently requiring
+        # HYDRAGNN_TELEMETRY too would be a footgun
+        from .telemetry import EpochDeviceTrace
+        profiler = EpochDeviceTrace(
+            tel_out, enable=True,
+            target_epoch=tel_cfg.device_trace_epoch)
 
     # walltime guard (reference: Training.CheckRemainingTime ->
     # check_remaining squeue poll, train_validate_test.py:255-262)
@@ -630,11 +655,26 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     # save at the next step boundary + clean exit. Installed HERE,
     # adjacent to the try whose finally restores it — installing earlier
     # would leave the flag-only handler live forever if anything between
-    # raised first.
-    if preempt_fn is not None:
-        from .train.trainer import install_sigterm_handler
-        install_sigterm_handler()
+    # raised first. The telemetry session starts here for the same
+    # reason: start_session installs process-global state that the
+    # finally below is responsible for unwinding.
+    from .telemetry import start_session
+    telemetry = start_session(tel_cfg, os.path.join("./logs", log_name))
     try:
+        # NOTHING may run between start_session and this try outside it:
+        # the session installs a process-global registry/recorder whose
+        # uninstall is this try's finally — even the setup below raising
+        # must not leak them into a later run in this process
+        if telemetry is not None:
+            # the MFU gauge halves the bf16 peak for f32 compute, so the
+            # session must know the step's resolved precision policy
+            from .train.precision import resolve_precision
+            telemetry.compute_dtype = resolve_precision(
+                getattr(mcfg, "dtype", None))
+            log(f"telemetry: on -> {telemetry.out_dir}")
+        if preempt_fn is not None:
+            from .train.trainer import install_sigterm_handler
+            install_sigterm_handler()
         state, history = train_validate_test(
             train_step, eval_step, state, train_loader, val_loader,
             test_loader, plateau=plateau,
@@ -651,7 +691,7 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
             checkpoint_every_n_epochs=ckpt_every,
             periodic_checkpoint_fn=periodic_fn, preempt_save_fn=preempt_fn,
             initial_best_state=best_state0, initial_best_val=best_val0,
-            resume_meta_out=final_resume)
+            resume_meta_out=final_resume, telemetry=telemetry)
     finally:
         # the flag-only SIGTERM handler must not outlive the epoch loop:
         # after training, the previous disposition (usually terminate) is
@@ -659,6 +699,15 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         if preempt_fn is not None:
             from .train.trainer import restore_sigterm_handler
             restore_sigterm_handler()
+        # telemetry artifacts are written on EVERY exit path — a
+        # preempted or crashed run's partial timeline is exactly the one
+        # worth reading (finalize is idempotent and restores the process
+        # registry/recorder)
+        if telemetry is not None:
+            paths = telemetry.finalize()
+            if paths:
+                log(f"telemetry artifacts: {paths['jsonl']} "
+                    f"{paths['chrome_trace']}")
 
     from .train.trainer import preemption_requested
     if preemption_requested():

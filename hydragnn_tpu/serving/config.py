@@ -19,7 +19,9 @@ Config schema (top-level block, alongside "Dataset"/"NeuralNetwork"):
         "deadline_ms": 0.0,        # default per-request deadline (0 = none)
         "breaker_threshold": 5,    # consecutive batch failures to trip
         "breaker_reset_s": 30.0,   # open -> half-open probe window
-        "precision": null          # serve-side compute dtype override
+        "precision": null,         # serve-side compute dtype override
+        "metrics_port": 0          # /healthz + /metrics HTTP port
+                                   # (0 = off; see docs/observability.md)
     }
 
 The queue/deadline/breaker knobs are the failure-semantics layer
@@ -51,6 +53,8 @@ class ServingConfig:
     breaker_threshold: int = 5    # 0 disables the circuit breaker
     breaker_reset_s: float = 30.0
     precision: Optional[str] = None  # None = inherit the train-side policy
+    metrics_port: int = 0         # 0 = no HTTP endpoint; > 0 = bind that
+    # port on loopback for /healthz + /metrics (telemetry/http.py)
 
 
 def resolve_serving(config: Optional[Dict[str, Any]]) -> ServingConfig:
@@ -72,6 +76,7 @@ def resolve_serving(config: Optional[Dict[str, Any]]) -> ServingConfig:
         breaker_threshold=int(block.get("breaker_threshold", 5)),
         breaker_reset_s=float(block.get("breaker_reset_s", 30.0)),
         precision=canonical_precision(block.get("precision")),
+        metrics_port=int(block.get("metrics_port", 0) or 0),
     )
     return ServingConfig(
         enabled=env_strict_flag("HYDRAGNN_SERVE", base.enabled),
@@ -93,4 +98,6 @@ def resolve_serving(config: Optional[Dict[str, Any]]) -> ServingConfig:
                                          base.breaker_reset_s),
         precision=env_strict_choice("HYDRAGNN_SERVE_PRECISION",
                                     PRECISION_CHOICES, base.precision),
+        metrics_port=env_strict_int("HYDRAGNN_SERVE_METRICS_PORT",
+                                    base.metrics_port),
     )

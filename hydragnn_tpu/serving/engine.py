@@ -69,6 +69,7 @@ import numpy as np
 
 from ..graphs.batch import GraphBatch, GraphSample, collate
 from ..graphs.packing import MAX_GRAPH_SLOTS, PackBudget, choose_budget
+from ..telemetry import spans as _spans
 from ..utils.faults import fault_point
 
 _SHUTDOWN = object()
@@ -308,6 +309,7 @@ class InferenceEngine:
         self.deadline_expired = 0
         self.queue_rejections = 0
         self.circuit_rejections = 0
+        self._metrics_server = None
         self._dispatcher = threading.Thread(target=self._loop,
                                             name="serve-dispatch",
                                             daemon=True)
@@ -425,11 +427,31 @@ class InferenceEngine:
                 proto = self._stack_shards([proto] + [None] *
                                            (self.num_shards - 1), bucket)
             self._get_compiled(bucket, proto)
-        return self.compile_count
+        with self._lock:  # counter is written under the lock; read likewise
+            return self.compile_count
+
+    def start_metrics_server(self, host: str = "127.0.0.1",
+                             port: int = 0):
+        """Expose this engine over HTTP (telemetry/http.py): GET /healthz
+        returns `health()` as JSON (200 while serving, 503 after
+        shutdown/dispatcher death), GET /metrics the Prometheus text
+        exposition of `stats()` + the process metrics registry. `port=0`
+        binds an ephemeral port; the server object (with `.port`/`.url`)
+        is returned and is also stopped automatically by `shutdown()`.
+        Loopback-only by default — pass host="0.0.0.0" deliberately."""
+        if self._metrics_server is not None:
+            return self._metrics_server
+        from ..telemetry.http import serve_engine_metrics
+        self._metrics_server = serve_engine_metrics(self, host=host,
+                                                    port=port)
+        return self._metrics_server
 
     def shutdown(self, wait: bool = True):
         """Stop accepting submissions; the dispatcher drains every queued
         request (no hung callers) and exits. Idempotent."""
+        server, self._metrics_server = self._metrics_server, None
+        if server is not None:
+            server.stop()
         with self._lock:
             if self._closed and not self._dispatcher.is_alive():
                 return
@@ -463,9 +485,18 @@ class InferenceEngine:
         """Service counters for bench/monitoring: batch occupancy is real
         graphs over graph-slot capacity of the chosen buckets; padding
         fractions are over the node/edge slots the compiled programs
-        actually executed."""
+        actually executed. Always includes the full latency-quantile key
+        set (zeroed with count 0 before any traffic —
+        utils/profiling.latency_percentiles).
+
+        Concurrency contract (PR 7 audit): every counter is snapshotted
+        atomically UNDER the engine lock, but the percentile math (numpy
+        over potentially thousands of latencies) runs on the copy outside
+        it — a monitoring scrape must never stall the dispatcher's next
+        batch."""
         from ..utils.profiling import latency_percentiles
         with self._lock:
+            latencies = list(self._latencies)
             out = {
                 "requests": self.requests_done,
                 "batches": self.batches_run,
@@ -488,7 +519,7 @@ class InferenceEngine:
                 "circuit_rejections": self.circuit_rejections,
                 "trip_count": self.trip_count,
             }
-            out.update(latency_percentiles(self._latencies))
+        out.update(latency_percentiles(latencies))
         return out
 
     # --------------------------------------------------------------- plumbing
@@ -676,8 +707,30 @@ class InferenceEngine:
             need_e = max(sum(r.e for r in sh) for sh in shards)
             bucket = select_bucket(self.buckets, count, need_n, need_e)
             assert bucket is not None, (count, need_n, need_e)
+            # request-lifecycle spans (docs/observability.md): queue-wait
+            # per request (submit -> dispatch), then the batch's forward
+            # and unpad stages, all carrying the bucket/parity
+            # breadcrumbs the futures advertise. One recorder check keeps
+            # the disabled path at a single branch per batch.
+            rec = _spans.current_recorder()
+            if rec is not None:
+                t_disp = _spans.now()
+                for r in reqs:
+                    rec.add("serve.queue_wait", r.t_submit,
+                            t_disp - r.t_submit, "serving")
+                t_fwd = _spans.now()
             outs = self._forward_requests(shards, bucket)
+            if rec is not None:
+                rec.add("serve.forward", t_fwd, _spans.now() - t_fwd,
+                        "serving",
+                        {"bucket": [bucket.n_node, bucket.n_edge,
+                                    bucket.n_graph],
+                         "requests": len(reqs), "parity": self.parity})
+                t_unpad = _spans.now()
             results = self._unpad(shards, bucket, outs)
+            if rec is not None:
+                rec.add("serve.unpad", t_unpad, _spans.now() - t_unpad,
+                        "serving")
             done = time.perf_counter()
             tot_n = sum(r.n for r in reqs)
             tot_e = sum(r.e for r in reqs)
@@ -800,7 +853,8 @@ class InferenceEngine:
                 if pending is _SHUTDOWN:
                     break
         except BaseException as e:  # noqa: BLE001
-            self._fatal = e
+            with self._lock:  # submit() reads _fatal under the lock
+                self._fatal = e
         finally:
             # drain everything still queued — a shutdown (or dispatcher
             # crash) must never leave a caller's future hanging

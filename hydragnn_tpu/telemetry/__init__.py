@@ -1,0 +1,37 @@
+"""Unified telemetry layer (docs/observability.md).
+
+One subsystem every layer reports into:
+
+* ``registry``  — process-wide metrics registry (counters/gauges/
+  histograms, typed schema) with JSONL event log + Prometheus text
+  exposition;
+* ``spans``     — Chrome trace-event span recording for the training-step
+  and serving-request timelines, plus the opt-in jax.profiler
+  device-trace bracket;
+* ``http``      — the /healthz + /metrics HTTP endpoint the serving
+  engine exposes;
+* ``mfu``       — per-backend peak-FLOPs table and the achieved-FLOPs/MFU
+  gauge (ROADMAP item 1);
+* ``session``   — the per-run TelemetrySession handle wiring the above
+  together (knobs resolved by utils/envflags.resolve_telemetry).
+
+Disabled by default with a near-zero hot-path cost: producers call
+``spans.record``/``spans.span`` (one global read + None check when off)
+and report registry metrics only from cold paths (per epoch, per retry,
+per cache probe, per scrape).
+"""
+from .mfu import PEAK_FLOPS, achieved_and_mfu, peak_flops
+from .registry import (COUNTER, GAUGE, HISTOGRAM, MetricsRegistry,
+                       MetricTypeError, get_registry, set_registry)
+from .session import TelemetryConfig, TelemetrySession, start_session
+from .spans import (EpochDeviceTrace, SpanRecorder, current_recorder,
+                    device_trace, install_recorder, record, span)
+
+__all__ = [
+    "COUNTER", "GAUGE", "HISTOGRAM",
+    "MetricsRegistry", "MetricTypeError", "get_registry", "set_registry",
+    "PEAK_FLOPS", "achieved_and_mfu", "peak_flops",
+    "TelemetryConfig", "TelemetrySession", "start_session",
+    "EpochDeviceTrace", "SpanRecorder", "current_recorder", "device_trace",
+    "install_recorder", "record", "span",
+]

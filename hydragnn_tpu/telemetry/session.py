@@ -1,0 +1,136 @@
+"""TelemetrySession: the per-run handle that turns the registry + span
+recorder on, collects run-scoped events, and writes the artifacts.
+
+Lifecycle (run_training / bench / tests):
+
+    cfg = utils.envflags.resolve_telemetry(train_cfg)   # strict knobs
+    session = start_session(cfg, run_dir)               # None when disabled
+    ...                                                 # layers report in
+    paths = session.finalize()                          # telemetry.jsonl +
+                                                        # trace.json written
+
+While a session is active, a FRESH MetricsRegistry is installed as the
+process registry (so the JSONL/exports are run-scoped, not polluted by a
+previous run in the same process) and a SpanRecorder is installed in
+telemetry/spans — which is what flips every producer call site from the
+near-zero disabled path to recording. `finalize()` restores both, so
+sessions cannot leak into later runs (tests rely on this).
+
+Knob resolution lives in utils/envflags.resolve_telemetry — NOT here —
+so the telemetry package itself stays inside the traced-env-read lint
+surface (tools/check_traced_env_reads.py covers telemetry/: no direct
+os.environ reads, the packing/precision lesson applied to observability).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional
+
+from .registry import MetricsRegistry, set_registry
+from .spans import SpanRecorder, install_recorder
+
+
+@dataclasses.dataclass(frozen=True)
+class TelemetryConfig:
+    """Resolved telemetry knobs (utils/envflags.resolve_telemetry):
+    env (strict parsing) over the Training.Telemetry config block over
+    defaults. Disabled by default — the hot-path overhead contract."""
+    enabled: bool = False
+    out_dir: Optional[str] = None      # None = <run_dir>/telemetry
+    device_trace: bool = False         # opt-in jax.profiler bracket
+    device_trace_epoch: int = 0        # epoch the bracket captures
+
+    def resolve_out_dir(self, run_dir: str) -> str:
+        """The ONE artifact-directory derivation — every consumer (the
+        session's JSONL/trace writes, run_training's device-trace
+        profiler) must route through here so the artifacts can never
+        split across directories."""
+        return self.out_dir or os.path.join(run_dir, "telemetry")
+
+
+class TelemetrySession:
+    """One run's telemetry: a run-scoped registry + span recorder plus
+    the MFU probe memo. Construct via `start_session`."""
+
+    def __init__(self, config: TelemetryConfig, run_dir: str):
+        self.config = config
+        self.out_dir = config.resolve_out_dir(run_dir)
+        self.registry = MetricsRegistry()
+        self.recorder = SpanRecorder()
+        self._prev_registry = set_registry(self.registry)
+        # cold-path counters reported BEFORE the session existed (preproc
+        # cache probes during dataset build, loader retries) carry into
+        # the run registry — without this they would vanish into the
+        # swapped-out process registry and the run's exports would show
+        # zero probes on their primary path
+        self.registry.seed_from(self._prev_registry)
+        self._prev_recorder = install_recorder(self.recorder)
+        self._flops_per_step: Optional[float] = None
+        self._flops_probed = False
+        self._finalized = False
+        self.registry.log_event("run", "start",
+                                data={"out_dir": self.out_dir})
+
+    # ------------------------------------------------------------- reporting
+
+    def epoch_event(self, epoch: int, data: Optional[Dict[str, Any]] = None,
+                    timing: Optional[Dict[str, Any]] = None) -> None:
+        """One structured row per epoch: `data` deterministic (losses,
+        counts), `timing` wall-clock (fractions, rates) — the JSONL
+        determinism contract (registry.log_event)."""
+        payload = {"epoch": int(epoch)}
+        payload.update(data or {})
+        self.registry.log_event("epoch", f"epoch_{int(epoch)}",
+                                data=payload, timing=timing)
+
+    def step_flops_once(self, step_fn, *args) -> Optional[float]:
+        """Memoized XLA cost-analysis probe of the train step (the MFU
+        numerator, train/train_step.step_cost_flops). Probed at most once
+        per session — the lower/compile probe is not free, so it runs
+        only for telemetry-enabled runs and only on the first epoch."""
+        if not self._flops_probed:
+            self._flops_probed = True
+            from ..train.train_step import step_cost_flops
+            self._flops_per_step = step_cost_flops(step_fn, *args)
+        return self._flops_per_step
+
+    @property
+    def flops_probed(self) -> bool:
+        """True once the probe ran — callers use this to stop holding
+        probe arguments (the trainer drops its pinned batch)."""
+        return self._flops_probed
+
+    # -------------------------------------------------------------- teardown
+
+    def finalize(self) -> Dict[str, str]:
+        """Write the run artifacts under `out_dir` — telemetry.jsonl
+        (event log), trace.json (Chrome trace), metrics.prom (the
+        registry's final Prometheus exposition, so training-run counters
+        and gauges are an inspectable artifact, not write-only state) —
+        then restore the previous process registry/recorder; idempotent.
+        Returns the artifact paths."""
+        if self._finalized:
+            return {}
+        self._finalized = True
+        self.registry.log_event("run", "end")
+        install_recorder(self._prev_recorder)
+        set_registry(self._prev_registry)
+        os.makedirs(self.out_dir, exist_ok=True)
+        jsonl = os.path.join(self.out_dir, "telemetry.jsonl")
+        trace = os.path.join(self.out_dir, "trace.json")
+        prom = os.path.join(self.out_dir, "metrics.prom")
+        self.registry.write_jsonl(jsonl)
+        self.recorder.write(trace)
+        with open(prom, "w") as f:
+            f.write(self.registry.to_prometheus())
+        return {"jsonl": jsonl, "chrome_trace": trace, "metrics": prom}
+
+
+def start_session(config: TelemetryConfig,
+                  run_dir: str) -> Optional[TelemetrySession]:
+    """A live session when `config.enabled`, else None — callers hold one
+    optional handle instead of re-checking knobs."""
+    if not config.enabled:
+        return None
+    return TelemetrySession(config, run_dir)

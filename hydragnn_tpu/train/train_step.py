@@ -198,6 +198,24 @@ def _make_step_body(model, cfg: ModelConfig, tx: optax.GradientTransformation,
     return step_body
 
 
+def step_cost_flops(step_fn, *args):
+    """Per-call FLOPs of a jitted step from XLA's compiled cost analysis;
+    None when the backend doesn't report it (or `step_fn` isn't
+    lowerable). The ONE probe shared by bench.py and the telemetry MFU
+    gauge (telemetry/mfu.py) so the numerator cannot drift between the
+    bench row and the per-epoch trainer metric. Not free — it re-lowers
+    and compiles the step for the probe shapes — so callers run it once
+    per (run, shape), never per epoch."""
+    try:
+        ca = step_fn.lower(*args).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        f = float(ca.get("flops", 0.0))
+        return f if f > 0 else None
+    except Exception:
+        return None
+
+
 def make_train_step(model, cfg: ModelConfig, tx: optax.GradientTransformation,
                     loss_name: str = "mse", compute_grad_energy: bool = False,
                     energy_weight: float = 1.0, force_weight: float = 1.0,

@@ -18,6 +18,7 @@ import jax
 import numpy as np
 
 from ..datasets.loader import prefetch_to_device
+from ..telemetry import spans as _spans
 from ..utils.faults import fault_point
 from ..utils.print_utils import iterate_tqdm, log, print_distributed
 from ..utils.profiling import Tracer
@@ -181,6 +182,7 @@ def train_validate_test(
     initial_best_state=None,
     initial_best_val: Optional[float] = None,
     resume_meta_out: Optional[Dict[str, Any]] = None,
+    telemetry=None,
 ):
     """Returns (final_state, history dict). With `keep_best` the returned
     state is the best-validation one (mirrors the reference's best-val
@@ -192,7 +194,16 @@ def train_validate_test(
     to the uninterrupted run; `periodic_checkpoint_fn(state, meta)` fires
     every `checkpoint_every_n_epochs` completed epochs with the resume
     metadata; `preempt_save_fn(state, meta)` fires EXACTLY ONCE when
-    SIGTERM (or request_preemption) arrives, then the loop exits cleanly."""
+    SIGTERM (or request_preemption) arrives, then the loop exits cleanly.
+
+    `telemetry` (a telemetry.TelemetrySession, or None) turns on the
+    unified observability layer (docs/observability.md): per-epoch
+    registry gauges + JSONL epoch events, span tracing of the step
+    timeline (dataload_wait / h2d / step_dispatch / device_wait per
+    batch, epoch/eval regions via the tracer), and the per-epoch MFU
+    gauge (achieved_flops_per_s against the per-backend peak table).
+    None — the default — keeps the hot path at its pre-telemetry cost:
+    the only additions are one global None-check per batch."""
     run_dir = os.path.join(log_dir, log_name)
     os.makedirs(run_dir, exist_ok=True)
     tb = _tensorboard_writer(run_dir)
@@ -281,13 +292,23 @@ def train_validate_test(
     # (load_data.py:249-254) onto prefetch depth
     prefetch_depth = max(env_int("HYDRAGNN_NUM_WORKERS", 2), 1)
 
-    from ..utils.profiling import HostStallMonitor, Profiler
-    profiler = profiler or Profiler(run_dir, enable=False)
+    from ..telemetry.spans import EpochDeviceTrace
+    from ..utils.profiling import HostStallMonitor
+    profiler = profiler or EpochDeviceTrace(run_dir, enable=False)
     # host-stall accounting: every epoch reports the fraction of host time
     # blocked on the input pipeline (collation + staging) vs dispatching
     # steps — the input-bound fraction the async loader is meant to erase
     stall = HostStallMonitor(tracer=tr)
     prev_compiled = 0  # jit-recompile counter baseline (utils/profiling)
+    # span taxonomy (docs/observability.md): the placement callables are
+    # wrapped so host->device staging shows up as `h2d` spans on the
+    # prefetch thread; no-op cost when no recorder is installed
+    place_fn = _traced_place(place_fn)
+    place_group_fn = _traced_place(place_group_fn)
+    # the MFU probe batch: one single-step batch reference (not a copy)
+    # kept for the end-of-epoch XLA cost-analysis probe; only taken when
+    # a telemetry session is live (telemetry.mfu / ROADMAP item 1)
+    flops_probe_batch = None
 
     import inspect
     ckpt_accepts_meta = False
@@ -358,6 +379,10 @@ def train_validate_test(
                 # deterministic crash injection (utils/faults.py): one
                 # forward-step index per train-loop dispatch
                 fault_point("forward-step")
+                if (telemetry is not None and not group
+                        and flops_probe_batch is None
+                        and not telemetry.flops_probed):
+                    flops_probe_batch = batch
                 full_group = (group
                               and batch.x.shape[0] == steps_per_call
                               and (max_num_batch is None
@@ -486,6 +511,96 @@ def train_validate_test(
         for prefix, tasks in (("val", val_tasks), ("test", test_tasks)):
             for k, v in tasks.items():
                 history.setdefault(f"{prefix}_{k}", []).append(v)
+        # ---- unified telemetry (docs/observability.md): per-epoch MFU
+        # gauge + registry metrics + one structured JSONL event ----
+        achieved = mfu_val = None
+        if telemetry is not None:
+            from ..telemetry.mfu import achieved_and_mfu
+            flops = None
+            if flops_probe_batch is not None:
+                flops = telemetry.step_flops_once(train_step, state,
+                                                  flops_probe_batch)
+                # the probe result is memoized in the session — release
+                # the pinned device batch for the rest of the run
+                flops_probe_batch = None
+            elif telemetry.flops_probed:
+                flops = telemetry.step_flops_once(train_step)
+            elif group and epoch == start_epoch:
+                # no silent caps: say WHY the gauge is absent rather
+                # than just omitting the rows
+                log("telemetry: steps_per_call > 1 — per-step MFU gauge "
+                    "unavailable (the scanned multi-step's cost analysis "
+                    "is not per-step comparable)")
+            # the epoch's dispatch+execute wall time (input wait excluded)
+            # is the denominator the bench's timed loop approximates
+            achieved, mfu_val = achieved_and_mfu(
+                flops, nb, stall.step_s, backend=jax.default_backend(),
+                device_kind=jax.devices()[0].device_kind,
+                compute_dtype=getattr(telemetry, "compute_dtype",
+                                      "float32"))
+            if achieved is not None:
+                history.setdefault("achieved_flops_per_s", []).append(
+                    achieved)
+            if mfu_val is not None:
+                history.setdefault("mfu", []).append(mfu_val)
+            reg = telemetry.registry
+            reg.gauge_set("train_loss", train_loss,
+                          help="mean train loss this epoch")
+            if val_loss == val_loss:
+                reg.gauge_set("val_loss", val_loss,
+                              help="mean validation loss this epoch")
+                reg.gauge_set("test_loss", test_loss,
+                              help="mean test loss this epoch")
+            reg.gauge_set("train_input_bound_frac", input_bound,
+                          help="fraction of the train pass blocked on "
+                               "the input pipeline")
+            reg.counter_inc("train_nonfinite_steps_total",
+                            float(nonfinite_steps),
+                            help="steps with non-finite loss/grads")
+            if pad_stats is not None:
+                reg.gauge_set("train_padding_frac_nodes",
+                              float(pad_stats["padding_frac_nodes"]),
+                              help="node-slot padding fraction")
+                reg.gauge_set("train_padding_frac_edges",
+                              float(pad_stats["padding_frac_edges"]),
+                              help="edge-slot padding fraction")
+            if recompiles is not None:
+                reg.counter_inc("train_jit_recompiles_total",
+                                float(max(recompiles, 0)),
+                                help="new compiled step programs")
+            if achieved is not None:
+                reg.gauge_set("train_achieved_flops_per_s", achieved,
+                              help="XLA-cost-analysis FLOPs x steps over "
+                                   "dispatch+execute wall time")
+            if mfu_val is not None:
+                reg.gauge_set("train_mfu", mfu_val,
+                              help="achieved over per-backend peak FLOPs")
+            # NaN-valued scalars (HYDRAGNN_VALTEST=0 val/test, schedulers
+            # without a readable lr) are OMITTED, not embedded: json.dumps
+            # would write a literal `NaN` and break the one-JSON-object-
+            # per-line contract for exactly the degraded runs worth
+            # inspecting
+            data = {"nonfinite_steps": nonfinite_steps, "batches": nb}
+            for k, v in (("train_loss", train_loss),
+                         ("val_loss", val_loss),
+                         ("test_loss", test_loss), ("lr", lr)):
+                if np.isfinite(v):
+                    data[k] = v
+            if pad_stats is not None:
+                data["padding_frac_nodes"] = float(
+                    pad_stats["padding_frac_nodes"])
+                data["padding_frac_edges"] = float(
+                    pad_stats["padding_frac_edges"])
+            if recompiles is not None:
+                data["jit_recompiles"] = recompiles
+            timing = {"input_bound_frac": input_bound,
+                      "epoch_wait_s": stall.wait_s,
+                      "epoch_step_s": stall.step_s}
+            if achieved is not None:
+                timing["achieved_flops_per_s"] = achieved
+            if mfu_val is not None:
+                timing["mfu"] = mfu_val
+            telemetry.epoch_event(epoch, data=data, timing=timing)
         if tb is not None:
             tb.add_scalar("train/loss", train_loss, epoch)
             tb.add_scalar("train/input_bound_frac", input_bound, epoch)
@@ -510,6 +625,10 @@ def train_validate_test(
                       f" pad_e {pad_stats['padding_frac_edges']:.3f}")
         if recompiles is not None:
             extra += f" recompiles {recompiles}"
+        if achieved is not None:
+            extra += f" flops/s {achieved:.3e}"
+        if mfu_val is not None:
+            extra += f" mfu {mfu_val:.4f}"
         if nonfinite_steps:
             extra += f" NONFINITE_STEPS {int(nonfinite_steps)}"
         log(f"epoch {epoch}: train {train_loss:.5f} val {val_loss:.5f} "
@@ -568,6 +687,25 @@ def train_validate_test(
     return state, history
 
 
+def _traced_place(place_fn):
+    """Wrap a batch-placement callable so host->device staging shows up
+    as `h2d` spans (telemetry/spans.py). With no recorder installed the
+    per-batch cost is one global read + None check."""
+    if place_fn is None:
+        return None
+
+    def placed(batch):
+        rec = _spans.current_recorder()
+        if rec is None:
+            return place_fn(batch)
+        t0 = _spans.now()
+        out = place_fn(batch)
+        rec.add("h2d", t0, _spans.now() - t0, "loader")
+        return out
+
+    return placed
+
+
 def _group_batches(loader, size):
     """Group fixed-shape batches into [S, ...]-stacked pytrees for the
     scanned multi-steps (datasets.loader._stack_batches handles Optional
@@ -587,8 +725,17 @@ def _group_batches(loader, size):
 def _accumulate_metrics(acc: Dict[str, float], metrics, summed=False):
     """Accumulate the loss/per-task scalars from one step (or one stacked
     multi-step, `summed=True`) into `acc` — one host transfer for the whole
-    metrics dict, not one per key."""
-    vals = jax.device_get(metrics)
+    metrics dict, not one per key. The device_get blocks until the step's
+    dependency chain is done, so under telemetry it is recorded as the
+    `device_wait` span — the dispatch-vs-execute split of the step
+    timeline (docs/observability.md)."""
+    rec = _spans.current_recorder()
+    if rec is not None:
+        t0 = _spans.now()
+        vals = jax.device_get(metrics)
+        rec.add("device_wait", t0, _spans.now() - t0, "device")
+    else:
+        vals = jax.device_get(metrics)
     for k, v in vals.items():
         if (k == "loss" or k == "nonfinite_steps" or k.startswith("task_")
                 or k.endswith("_loss")):

@@ -170,6 +170,45 @@ def resolve_preproc_cache_dir(ds_cfg=None) -> "str | None":
     return d or None
 
 
+def resolve_telemetry(train_cfg=None):
+    """Unified-telemetry knobs (docs/observability.md) -> TelemetryConfig.
+
+    Precedence per knob: HYDRAGNN_* env over the Training.Telemetry config
+    block over defaults (off). STRICT parsing throughout — telemetry must
+    never flip on (or point its artifacts somewhere surprising) from a
+    typo value. Resolved HERE, outside the telemetry package, so
+    telemetry/ itself stays clean under the traced-env-read lint
+    (tools/check_traced_env_reads.py covers it).
+
+    Knobs:
+      HYDRAGNN_TELEMETRY            enable the session (JSONL + Chrome
+                                    trace + registry exports)
+      HYDRAGNN_TELEMETRY_DIR        artifact directory (default:
+                                    <run_dir>/telemetry)
+      HYDRAGNN_DEVICE_TRACE         opt-in jax.profiler bracket around
+                                    one epoch (heavyweight)
+      HYDRAGNN_DEVICE_TRACE_EPOCH   which epoch the bracket captures
+                                    (default 0)
+    """
+    from ..telemetry.session import TelemetryConfig
+    block = (train_cfg or {}).get("Telemetry", {}) or {}
+    out_dir = os.getenv("HYDRAGNN_TELEMETRY_DIR")
+    if out_dir is None:
+        out_dir = block.get("dir")
+    out_dir = (out_dir or "").strip() or None
+    return TelemetryConfig(
+        enabled=env_strict_flag("HYDRAGNN_TELEMETRY",
+                                bool(block.get("enabled", False))),
+        out_dir=out_dir,
+        device_trace=env_strict_flag("HYDRAGNN_DEVICE_TRACE",
+                                     bool(block.get("device_trace",
+                                                    False))),
+        device_trace_epoch=int(env_strict_int(
+            "HYDRAGNN_DEVICE_TRACE_EPOCH",
+            int(block.get("device_trace_epoch", 0) or 0))),
+    )
+
+
 def resolve_steps_per_call(train_cfg) -> int:
     """Steps-per-call dispatch batching knob: HYDRAGNN_STEPS_PER_CALL env
     overrides Training.steps_per_call (default 1). Shared by run_training

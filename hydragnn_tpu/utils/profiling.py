@@ -19,10 +19,18 @@ from typing import Any, Callable, Dict, Optional
 
 import jax
 
+from ..telemetry import spans as _spans
+
 
 class Tracer:
     """Hierarchical region timer with optional device sync + jax profiler
-    annotations."""
+    annotations.
+
+    Telemetry integration (docs/observability.md): every closed region
+    also lands as a span in the process SpanRecorder when a
+    TelemetrySession is active — the Tracer is the ONE host timing
+    facility, and the Chrome trace is just another export of it. With no
+    recorder installed the extra cost is one global read per stop."""
 
     def __init__(self, sync: bool = False, use_jax_annotations: bool = True):
         self.sync = sync
@@ -53,9 +61,20 @@ class Tracer:
             return
         if self.sync and result is not None:
             jax.block_until_ready(result)
-        dt = time.perf_counter() - self._starts.pop(name)
+        t0 = self._starts.pop(name)
+        self.add_time(name, time.perf_counter() - t0, t_start=t0)
+
+    def add_time(self, name: str, dt: float,
+                 t_start: Optional[float] = None):
+        """Accumulate a measured region (external timers — the stall
+        monitor — report through here so aggregates and spans cannot
+        drift). `t_start` is the perf_counter start for span placement;
+        None means "ends now"."""
         self.times[name] = self.times.get(name, 0.0) + dt
         self.counts[name] = self.counts.get(name, 0) + 1
+        if t_start is None:
+            t_start = time.perf_counter() - dt
+        _spans.record(name, t_start, dt, cat="tracer")
 
     @contextlib.contextmanager
     def timer(self, name: str):
@@ -132,10 +151,7 @@ class HostStallMonitor:
                 dt = time.perf_counter() - t0
                 self.wait_s += dt
                 if self.tracer is not None:
-                    self.tracer.times["dataload_wait"] = \
-                        self.tracer.times.get("dataload_wait", 0.0) + dt
-                    self.tracer.counts["dataload_wait"] = \
-                        self.tracer.counts.get("dataload_wait", 0) + 1
+                    self.tracer.add_time("dataload_wait", dt, t_start=t0)
             self.batches += 1
             yield batch
 
@@ -148,10 +164,7 @@ class HostStallMonitor:
             dt = time.perf_counter() - t0
             self.step_s += dt
             if self.tracer is not None:
-                self.tracer.times["step_dispatch"] = \
-                    self.tracer.times.get("step_dispatch", 0.0) + dt
-                self.tracer.counts["step_dispatch"] = \
-                    self.tracer.counts.get("step_dispatch", 0) + 1
+                self.tracer.add_time("step_dispatch", dt, t_start=t0)
 
     def input_bound_frac(self) -> float:
         total = self.wait_s + self.step_s
@@ -159,18 +172,29 @@ class HostStallMonitor:
 
 
 def latency_percentiles(latencies_s, percentiles=(50, 95, 99)) -> Dict[str, float]:
-    """Tail-latency summary: {"p50_ms", "p95_ms", "p99_ms", "mean_ms"}
-    from per-request latencies in SECONDS (empty input -> {}). The one
-    percentile formatter shared by the serving engine
-    (serving/engine.stats) and BENCH_SERVE so the reported fields cannot
-    drift between the two."""
+    """Tail-latency summary: {"p50_ms", "p95_ms", "p99_ms", "mean_ms",
+    "count"} from per-request latencies in SECONDS. The one percentile
+    formatter shared by the serving engine (serving/engine.stats),
+    BENCH_SERVE, and the /metrics exposition so the reported fields
+    cannot drift between them.
+
+    Edge-case contract (PR 7): the FULL key set is always present —
+    empty input yields zeroed quantiles with ``count == 0`` instead of
+    the former ``{}``, so telemetry consumers (Prometheus exposition,
+    dashboards keyed on p99) never special-case a just-started or
+    just-reset engine. `count` disambiguates "no traffic yet" from
+    "genuinely sub-millisecond"."""
     import numpy as np
     lat = np.asarray(list(latencies_s), np.float64)
+    out: Dict[str, float] = {f"p{int(q)}_ms": 0.0 for q in percentiles}
+    out["mean_ms"] = 0.0
+    out["count"] = 0
     if lat.size == 0:
-        return {}
-    out = {f"p{int(q)}_ms": float(np.percentile(lat, q) * 1e3)
-           for q in percentiles}
+        return out
+    for q in percentiles:
+        out[f"p{int(q)}_ms"] = float(np.percentile(lat, q) * 1e3)
     out["mean_ms"] = float(lat.mean() * 1e3)
+    out["count"] = int(lat.size)
     return out
 
 
@@ -179,7 +203,13 @@ def jit_cache_size(fn) -> Optional[int]:
     (jax 0.4.x PjitFunction `_cache_size`); None when `fn` is not a
     jitted function (or the introspection API moved). The trainer/bench
     report this as the recompile counter — budget-packed batching must
-    keep it at ONE program per step function (docs/packing.md)."""
+    keep it at ONE program per step function (docs/packing.md).
+
+    Edge-case contract (PR 7): any probe misbehavior — a `_cache_size`
+    attribute that is not callable, raises, or returns something
+    non-integer (None included) — degrades to None, never an exception:
+    this runs inside the per-epoch telemetry path and an introspection
+    API drift must not kill training."""
     if fn is None:
         return None
     probe = getattr(fn, "_cache_size", None)
@@ -194,7 +224,9 @@ def jit_cache_size(fn) -> Optional[int]:
 def jit_cache_total(*fns) -> Optional[int]:
     """Sum of `jit_cache_size` over the given callables; None when none
     of them expose a cache (so callers can distinguish 'zero compiles'
-    from 'not measurable')."""
+    from 'not measurable'). Accepts any mix of None / non-jitted /
+    probe-raising entries — they are simply skipped (the same hardening
+    contract as `jit_cache_size`); an empty call returns None."""
     total, seen = 0, False
     for fn in fns:
         n = jit_cache_size(fn)
@@ -241,55 +273,23 @@ def print_timers(path: Optional[str] = None):
     return _GLOBAL.print_timers(path)
 
 
-@contextlib.contextmanager
-def device_profile(log_dir: str):
-    """Wrap a region in jax.profiler trace capture (TensorBoard-viewable) —
-    replaces the torch.profiler window (reference: profile.py:9-70)."""
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+# device-side trace brackets live in telemetry/spans.py now — ONE timing
+# facility; these names remain as the historical entry points
+device_profile = _spans.device_trace
 
 
-class Profiler:
-    """Epoch-targeted device profiler — the torch.profiler wrapper of the
-    reference (profile.py:9-70: `Profile` config section with `enable` 0/1
-    and `target_epoch`; entered around each epoch at
-    train_validate_test.py:128-130,160). Here the capture is a jax.profiler
-    trace of the target epoch, written under <prefix>/profile/ and viewable
-    in TensorBoard/XProf (includes XLA HLO + TPU device timelines)."""
+class Profiler(_spans.EpochDeviceTrace):
+    """DEPRECATED shim — the epoch-targeted device profiler merged into
+    the telemetry layer as `telemetry.EpochDeviceTrace` (PR 7: one timing
+    facility, not two half-wired ones). Same constructor/`setup`/
+    `set_current_epoch`/context-manager surface; new code should import
+    `hydragnn_tpu.telemetry.EpochDeviceTrace`."""
 
     def __init__(self, prefix: str = "", enable: bool = False,
                  target_epoch: int = 0):
-        self.prefix = prefix
-        self.enable = enable
-        self.target_epoch = target_epoch
-        self.current_epoch = -1
-        self.done = False
-        self._active = False
-
-    def setup(self, config):
-        """reference: Profiler.setup (profile.py:32-42)."""
-        self.enable = int(config.get("enable", 0)) == 1
-        self.target_epoch = int(config.get("target_epoch", 0))
-
-    def set_current_epoch(self, current_epoch: int):
-        self.current_epoch = current_epoch
-
-    def __enter__(self):
-        if self.enable and not self.done \
-                and self.current_epoch == self.target_epoch:
-            import os
-            out = os.path.join(self.prefix or ".", "profile")
-            os.makedirs(out, exist_ok=True)
-            jax.profiler.start_trace(out)
-            self._active = True
-        return self
-
-    def __exit__(self, exc_type, exc, tb):
-        if self._active:
-            jax.profiler.stop_trace()
-            self._active = False
-            self.done = True
-        return False
+        import warnings
+        warnings.warn(
+            "utils.profiling.Profiler is deprecated; use "
+            "hydragnn_tpu.telemetry.EpochDeviceTrace",
+            DeprecationWarning, stacklevel=2)
+        super().__init__(prefix, enable=enable, target_epoch=target_epoch)

@@ -69,6 +69,12 @@ def test_lint_covers_the_known_offender_modules():
     assert os.path.join("hydragnn_tpu", "kernels",
                         "fused_mp_pallas.py") in paths
     assert os.path.join("hydragnn_tpu", "train", "precision.py") in paths
+    # PR 7: the telemetry subsystem resolves every knob via
+    # utils/envflags.resolve_telemetry — no direct env reads inside
+    # telemetry/ (registry/spans/session/http/mfu all covered)
+    for mod in ("registry.py", "spans.py", "session.py", "http.py",
+                "mfu.py", "__init__.py"):
+        assert os.path.join("hydragnn_tpu", "telemetry", mod) in paths
 
 
 def test_lint_cli_exit_code():

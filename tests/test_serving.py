@@ -376,6 +376,69 @@ def test_env_strict_number_helpers(monkeypatch, caplog):
     assert env_strict_int("HYDRAGNN_TEST_UNSET_XYZ", None) is None
 
 
+# ----------------------------------------------------- stats concurrency (PR 7)
+
+def test_stats_concurrent_with_submit_and_reset(served, engine):
+    """The stats()/reset_stats()/health() surface must be safe against
+    the dispatcher and concurrent submitters (PR 7 audit: counters are
+    snapshotted atomically under the engine lock; percentile math runs
+    on the copy OUTSIDE it). Hammer all three from threads while
+    submitting; then quiesce, reset once, and account exactly.
+
+    Reuses the warm module engine (no extra bucket compiles); it runs
+    after the stats-reading tests and leaves the engine serviceable —
+    only the resettable counters are touched."""
+    import threading
+    samples, _, _, _, _ = served
+    eng = engine
+    stop = threading.Event()
+    errors = []
+
+    def scrape():
+        while not stop.is_set():
+            try:
+                st = eng.stats()
+                assert st["requests"] >= 0
+                assert st["count"] >= 0  # latency key always present
+                eng.health()
+                eng.reset_stats()
+            except Exception as exc:  # noqa: BLE001 — collected
+                errors.append(exc)
+                return
+
+    def submit_many(out):
+        try:
+            futs = [eng.submit(s) for s in samples]
+            out.extend(f.result(timeout=60) for f in futs)
+        except Exception as exc:  # noqa: BLE001 — collected
+            errors.append(exc)
+
+    scraper = threading.Thread(target=scrape)
+    results_a, results_b = [], []
+    sub_a = threading.Thread(target=submit_many, args=(results_a,))
+    sub_b = threading.Thread(target=submit_many, args=(results_b,))
+    scraper.start()
+    sub_a.start()
+    sub_b.start()
+    sub_a.join(timeout=120)
+    sub_b.join(timeout=120)
+    stop.set()
+    scraper.join(timeout=30)
+    assert not errors, errors
+    assert len(results_a) == len(samples)
+    assert len(results_b) == len(samples)
+    # quiesced accounting: one reset, then a known batch of submits
+    # must be counted exactly (no lost or double-counted requests)
+    eng.reset_stats()
+    futs = [eng.submit(s) for s in samples[:10]]
+    for f in futs:
+        f.result(timeout=60)
+    st = eng.stats()
+    assert st["requests"] == 10
+    assert st["count"] == 10  # one latency sample per request
+    assert st["batches"] >= 1
+
+
 # ------------------------------------------------------- slow-lane load smoke
 
 @pytest.mark.slow

@@ -33,6 +33,13 @@ TRACED_DIRS = (
     os.path.join("hydragnn_tpu", "models"),
     os.path.join("hydragnn_tpu", "ops"),
     os.path.join("hydragnn_tpu", "kernels"),
+    # the telemetry layer is host-side, but its knobs gate producer call
+    # sites that run adjacent to (and inside wrappers around) traced
+    # code — every telemetry knob must resolve through
+    # utils/envflags.resolve_telemetry at construction time, never via a
+    # direct env read inside the subsystem (PR 7; same rule that keeps
+    # the kernels/precision modules honest)
+    os.path.join("hydragnn_tpu", "telemetry"),
 )
 TRACED_FILES = (
     os.path.join("hydragnn_tpu", "train", "train_step.py"),

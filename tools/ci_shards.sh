@@ -41,11 +41,13 @@ case "$shard" in
   robust)
     # infrastructure robustness: input pipeline, packing, serving engine,
     # fault tolerance (kill/resume + serving failure semantics), env-read
-    # lint, reference shims — files that grew after the original shard
-    # split and were previously in no shard
+    # lint, telemetry (registry/spans//metrics endpoint), reference shims
+    # — files that grew after the original shard split and were
+    # previously in no shard
     python -m pytest -q tests/test_async_loader.py tests/test_packing.py \
       tests/test_serving.py tests/test_serving_faults.py \
-      tests/test_faults.py tests/test_env_lint.py tests/test_ref_shims.py
+      tests/test_faults.py tests/test_env_lint.py tests/test_ref_shims.py \
+      tests/test_telemetry.py
     ;;
   zoo)
     # the 13-model accuracy battery (per-model thresholds)
