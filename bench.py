@@ -123,6 +123,27 @@ Env knobs:
                        small; crank these up on-chip)
   BENCH_KERNELS_OUT    also write the kernel JSON to this path (the
                        nightly kernel-bench emits BENCH_KERNELS.json)
+  BENCH_MFU            =1: device-utilization mode (docs/pipeline.md,
+                       docs/MFU_ANALYSIS.md, ROADMAP item 1) — the
+                       deep-stack pipelined train step across
+                       {sequential, gpipe, gpipe+remat, 1f1b,
+                       1f1b+remat}: graphs/s, achieved_flops_per_s (XLA
+                       cost analysis; MFU vs the telemetry/mfu.py peak
+                       table on real accelerators), peak-live-activation
+                       bytes per stage (compiled memory analysis
+                       temp_size), and the measured pipeline bubble
+                       fraction (two-point microbatch sweep of the
+                       pipelined forward) adjudicated against the
+                       closed form (S-1)/(M+S-1)
+  BENCH_MFU_LAYERS / BENCH_MFU_STAGES / BENCH_MFU_MICRO /
+  BENCH_MFU_GRAPHS / BENCH_MFU_NODES / BENCH_MFU_HIDDEN /
+  BENCH_MFU_STEPS / BENCH_MFU_MODEL
+                       MFU-mode scale (default 32 layers / 4 stages /
+                       8 microbatches / 2 graphs x 24 nodes per
+                       microbatch / hidden 64 / 3 timed steps / SchNet
+                       invariant — the deep-stack demonstration shape)
+  BENCH_MFU_OUT        also write the MFU JSON to this path (the
+                       nightly mfu-bench emits BENCH_MFU.json)
 """
 import itertools
 import json
@@ -1339,6 +1360,311 @@ def run_bench_kernels(backend=None):
     return out
 
 
+def run_bench_mfu(backend=None):
+    """BENCH_MFU: end-to-end device-utilization accounting for the
+    pipelined deep-stack train step (docs/pipeline.md; ROADMAP item 1,
+    docs/MFU_ANALYSIS.md is the roofline anchor).
+
+    One deep homogeneous conv stack (default: 32-layer SchNet-invariant,
+    the configuration whose per-stage activations exceed a single
+    stage's budget without remat) is trained under five execution
+    strategies — sequential scan, GPipe, GPipe+remat, 1F1B, 1F1B+remat —
+    on IDENTICAL params and microbatches. Per variant: graphs/s,
+    achieved_flops_per_s (train_step.step_cost_flops x steps / wall —
+    the MFU numerator; `mfu` itself only on real accelerators, against
+    the telemetry/mfu.py peak table), and the compiled program's
+    temp_size_in_bytes (XLA memory analysis) as the peak-live-activation
+    proxy, reported per stage. The pipeline bubble is MEASURED with a
+    two-point microbatch sweep of the pipelined forward (wall time is
+    affine in M: slope = per-tick cost, so bubble = (S-1)*slope/T) and
+    adjudicated against the closed form (S-1)/(M+S-1).
+    """
+    import jax
+    if backend is None:
+        backend = _resolve_backend_and_cache()
+    from hydragnn_tpu.config import build_model_config, update_config
+    from hydragnn_tpu.datasets.loader import _stack_batches
+    from hydragnn_tpu.graphs.batch import collate
+    from hydragnn_tpu.parallel.mesh import make_mesh
+    from hydragnn_tpu.parallel.pipeline import (bubble_fraction,
+                                                forward_ticks,
+                                                train_bubble_fraction,
+                                                train_step_ticks)
+    from hydragnn_tpu.parallel.pipeline_trainer import (
+        init_pipeline_params, make_pipeline_forward,
+        make_pipeline_train_step)
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.train_step import (TrainState,
+                                               compiled_cost_flops,
+                                               step_cost_flops)
+    from tests.utils import make_config
+
+    layers = int(os.environ.get("BENCH_MFU_LAYERS", "32"))
+    stages = int(os.environ.get("BENCH_MFU_STAGES", "4"))
+    micro = int(os.environ.get("BENCH_MFU_MICRO", "8"))
+    graphs_per_micro = int(os.environ.get("BENCH_MFU_GRAPHS", "2"))
+    nodes = int(os.environ.get("BENCH_MFU_NODES", "24"))
+    hidden = int(os.environ.get("BENCH_MFU_HIDDEN", "64"))
+    steps = int(os.environ.get("BENCH_MFU_STEPS", "3"))
+    model_type = os.environ.get("BENCH_MFU_MODEL", "SchNet")
+    if jax.device_count() < stages:
+        raise RuntimeError(
+            f"BENCH_MFU needs >= {stages} devices (have "
+            f"{jax.device_count()}); main() forces the virtual CPU mesh "
+            "when the backend is CPU")
+
+    rng = np.random.RandomState(0)
+    global NODES_PER_GRAPH
+    prev_nodes = NODES_PER_GRAPH
+    NODES_PER_GRAPH = nodes
+    try:
+        samples = synth_samples(2 * micro * graphs_per_micro, rng)
+    finally:
+        NODES_PER_GRAPH = prev_nodes
+    # node head: the bench's synthetic samples carry node targets
+    # (y_node = x), matching the other modes' label layout
+    cfg = make_config(model_type, heads=("node",), num_conv_layers=layers,
+                      hidden_dim=hidden, radius=6.0)
+    cfg["NeuralNetwork"]["Training"]["pipeline_stages"] = stages
+    cfg["NeuralNetwork"]["Training"]["pipeline_norm"] = "layernorm"
+    cfg = update_config(cfg, samples)
+    mcfg = build_model_config(cfg)
+    tx = select_optimizer(cfg["NeuralNetwork"]["Training"])
+    mesh = make_mesh((("pipe", stages),),
+                     devices=jax.devices()[:stages])
+
+    n_node = graphs_per_micro * nodes + 8
+    n_edge = graphs_per_micro * nodes * DEG + 8
+
+    def stack_micro(m):
+        bats = [collate(samples[i * graphs_per_micro:
+                                (i + 1) * graphs_per_micro],
+                        n_node=n_node, n_edge=n_edge,
+                        n_graph=graphs_per_micro + 1)
+                for i in range(m)]
+        return _stack_batches(bats)
+
+    stacked = stack_micro(micro)
+    micro0 = jax.tree_util.tree_map(
+        lambda a: None if a is None else a[0], stacked)
+    params = init_pipeline_params(jax.random.PRNGKey(0), mcfg, micro0)
+
+    from hydragnn_tpu.train.precision import resolve_precision
+    compute_dtype = resolve_precision(None,
+                                      os.environ.get("BENCH_DTYPE") or None)
+
+    variants = {
+        "sequential": dict(schedule="gpipe", remat=False, pipelined=False),
+        "gpipe": dict(schedule="gpipe", remat=False),
+        "gpipe_remat": dict(schedule="gpipe", remat=True,
+                            remat_policy="full"),
+        "1f1b": dict(schedule="1f1b", remat=False),
+        "1f1b_remat": dict(schedule="1f1b", remat=True,
+                           remat_policy="full"),
+    }
+    graphs_per_step = micro * graphs_per_micro
+    # ONE useful-work FLOPs numerator for every variant: the SEQUENTIAL
+    # step's cost analysis. Per-variant cost analyses are NOT
+    # cross-comparable — the shard_map-partitioned pipelined program
+    # reports per-partition flops, and remat/bubble recompute is waste,
+    # not useful work — so they are recorded per variant as
+    # `xla_cost_flops_per_step` for diagnostics only, and
+    # achieved_flops_per_s/mfu for ALL variants divide the same useful
+    # work by each variant's wall clock (telemetry/mfu.achieved_and_mfu,
+    # the one shared helper).
+    from hydragnn_tpu.telemetry.mfu import achieved_and_mfu
+    device_kind = jax.devices()[0].device_kind
+    peak_override = float(os.environ.get("BENCH_PEAK_FLOPS", 0))
+    useful_flops = None
+    out_variants = {}
+    for name, kw in variants.items():
+        # compute_dtype threads the BENCH_DTYPE knob into the step the
+        # bench actually runs (and times) — the same dtype the MFU
+        # peak-table lookup below divides by
+        step = make_pipeline_train_step(mcfg, mesh, stages, tx,
+                                        loss_name="mse",
+                                        compute_dtype=compute_dtype, **kw)
+        state = TrainState.create({"params": params}, tx)
+        # ONE lower+compile per variant serves the cost analysis, the
+        # memory analysis, AND execution (the AOT executable — the jit
+        # dispatch cache shares no work with .lower().compile(), so
+        # calling `step` after probing would compile the 32-layer stack
+        # a second time). Steps are jitted without donation, so calling
+        # the executable repeatedly is safe.
+        try:
+            compiled = step.lower(state, stacked).compile()
+        except (AttributeError, NotImplementedError) as e:
+            # backend without AOT lowering — fall back to jit dispatch.
+            # Genuine compile failures (e.g. RESOURCE_EXHAUSTED on the
+            # gpipe-without-remat variant) must propagate here: the jit
+            # fallback would re-trace the identical failing program for
+            # minutes and then lose this traceback.
+            print(f"mfu: no AOT compile for {name} ({e!r}), "
+                  "falling back to jit dispatch", file=sys.stderr)
+            compiled = None
+        if compiled is not None:
+            run_step = compiled
+            flops = compiled_cost_flops(compiled)
+            try:
+                temp_bytes = int(
+                    compiled.memory_analysis().temp_size_in_bytes)
+            except Exception:  # noqa: BLE001 — no memory analysis
+                temp_bytes = None
+        else:
+            run_step = step
+            flops = step_cost_flops(step, state, stacked)
+            temp_bytes = None
+        if name == "sequential":
+            useful_flops = flops
+        state, metrics = run_step(state, stacked)  # warmup dispatch
+        loss0 = _sync_loss(metrics)
+
+        def timed():
+            nonlocal state, metrics
+            for _ in range(steps):
+                state, metrics = run_step(state, stacked)
+            _sync_loss(metrics)
+        best_dt = _best_of(3, timed)
+        gps = graphs_per_step * steps / best_dt
+        pipelined = kw.get("pipelined", True)
+        row = {
+            "graphs_per_s": round(gps, 2),
+            "loss_first_step": loss0,
+            "loss_after": _sync_loss(metrics),
+            "temp_bytes": temp_bytes,
+            # XLA's memory_analysis on an SPMD (shard_map-partitioned)
+            # program reports PER-DEVICE temp bytes — verified by a
+            # stage-count sweep (S=2 shows ~2x the S=4 number, not the
+            # same total) — so for the pipelined variants temp_bytes
+            # ALREADY IS the per-stage footprint; dividing by S again
+            # would understate it S-fold. The sequential baseline runs
+            # on one device and reports None here (its whole-program
+            # footprint is temp_bytes).
+            "temp_bytes_per_stage": (temp_bytes
+                                     if temp_bytes is not None and pipelined
+                                     else None),
+            "xla_cost_flops_per_step": flops,
+            "ticks_per_step": train_step_ticks(stages, micro,
+                                               kw["schedule"])
+            if pipelined else None,
+            "train_bubble_frac_closed_form": round(
+                train_bubble_fraction(stages, micro, kw["schedule"]), 6)
+            if pipelined else None,
+        }
+        achieved, mfu_val = achieved_and_mfu(
+            useful_flops, steps, best_dt, backend, device_kind,
+            compute_dtype, peak_override)
+        if achieved is not None:
+            row["flops_per_step_useful"] = useful_flops
+            row["achieved_flops_per_s"] = round(achieved, 1)
+        if mfu_val is not None:
+            row["mfu"] = round(mfu_val, 6)
+        out_variants[name] = row
+
+    # ---- measured bubble: two-point microbatch sweep of the pipelined
+    # forward. T(M) = overhead + (M + S - 1) * tick_cost, so the slope
+    # between two M points isolates tick_cost and the bubble fraction
+    # (S-1) * tick_cost / T(M) is measured, not assumed. Two opposing
+    # biases: dispatch overhead inflates T(M), biasing the measurement
+    # LOW; embed/precompute/decode run per-microbatch OUTSIDE the pipe
+    # ring, so their cost rides the slope and biases it HIGH (worst at
+    # small layer counts, where conv ticks don't dominate). The
+    # factor-of-two adjudication band below absorbs both.
+    fwd = make_pipeline_forward(mcfg, mesh, stages, pipelined=True,
+                                compute_dtype=compute_dtype)
+    fwd = jax.jit(fwd)
+    m2 = 2 * micro
+    stacked2 = stack_micro(m2)
+
+    def forward_once(batch):
+        outs, _ = fwd(params, batch)
+        jax.tree_util.tree_map(lambda a: np.asarray(a), outs)
+
+    # INTERLEAVED best-of-5 of the two microbatch points: timing them in
+    # separate all-reps phases lets one transient contention window (a
+    # shared-CPU neighbor) inflate only ONE point, which biases
+    # tick_cost = (t2 - t1) / dM arbitrarily; alternating reps exposes
+    # both points to the same noise so the min-latency pair stays
+    # comparable
+    forward_once(stacked)  # compile
+    forward_once(stacked2)
+    t1 = t2 = float("inf")
+    for _ in range(5):
+        t1 = min(t1, _best_of(1, lambda: forward_once(stacked)))
+        t2 = min(t2, _best_of(1, lambda: forward_once(stacked2)))
+    tick_cost = (t2 - t1) / (m2 - micro)
+    measured_bubble = ((stages - 1) * tick_cost / t1
+                       if t1 > 0 and tick_cost > 0 else None)
+    closed_form = bubble_fraction(stages, micro)
+    bubble = {
+        "microbatch_points": [micro, m2],
+        "wall_s": [round(t1, 6), round(t2, 6)],
+        "ticks": [forward_ticks(stages, micro), forward_ticks(stages, m2)],
+        "measured": (None if measured_bubble is None
+                     else round(measured_bubble, 4)),
+        "closed_form": round(closed_form, 4),
+        # CPU wall clocks are noisy and the two slope biases above pull
+        # in opposite directions; the nightly smoke adjudicates against
+        # this factor-of-two band rather than a tight tolerance
+        "within_tolerance": (measured_bubble is not None
+                             and 0.5 * closed_form <= measured_bubble
+                             <= 2.0 * closed_form),
+    }
+
+    # ---- deep-stack memory demonstration: the 32-layer stack's
+    # peak-live-activation bytes under GPipe-without-remat exceed a
+    # stage budget that 1F1B+remat trains under (acceptance: >= 2x)
+    t_gpipe = out_variants["gpipe"]["temp_bytes"]
+    t_1f1b_r = out_variants["1f1b_remat"]["temp_bytes"]
+    deep = {"layers": layers, "stages": stages, "microbatches": micro}
+    if t_gpipe and t_1f1b_r:
+        # the "stage memory budget" is DERIVED, not an independent
+        # measurement (CPU has no real per-stage HBM limit): it is sized
+        # at 2x the 1F1B+remat footprint, so gpipe_exceeds_budget is
+        # exactly the >= 2x acceptance claim, transparently labeled —
+        # on-chip, substitute the device's actual per-core budget.
+        # temp_bytes for the shard_map variants is already PER-DEVICE
+        # (see the variant-row comment), i.e. per-stage as-is.
+        budget = 2 * t_1f1b_r
+        deep.update({
+            "gpipe_temp_bytes_per_stage": t_gpipe,
+            "onef1b_remat_temp_bytes_per_stage": t_1f1b_r,
+            "activation_bytes_ratio": round(t_gpipe / t_1f1b_r, 3),
+            "stage_memory_budget_bytes": budget,
+            "stage_memory_budget_note":
+                "derived: 2x the 1f1b_remat per-stage footprint "
+                "(no independent HBM limit exists on CPU)",
+            "gpipe_exceeds_budget": t_gpipe > budget,
+            "onef1b_remat_fits_budget": t_1f1b_r <= budget,
+        })
+    deep["trains"] = {
+        "loss_first_step": out_variants["1f1b_remat"]["loss_first_step"],
+        "loss_after": out_variants["1f1b_remat"]["loss_after"],
+        "finite": bool(np.isfinite(
+            out_variants["1f1b_remat"]["loss_after"])),
+    }
+
+    out = {
+        "mode": "mfu",
+        "backend": backend,
+        "device_kind": device_kind,
+        "dtype": compute_dtype,
+        "model": model_type,
+        "shape": {"layers": layers, "stages": stages,
+                  "microbatches": micro,
+                  "graphs_per_micro": graphs_per_micro, "nodes": nodes,
+                  "hidden": hidden, "steps": steps},
+        "variants": out_variants,
+        "bubble": bubble,
+        "deep_stack": deep,
+    }
+    out_path = os.environ.get("BENCH_MFU_OUT", "").strip()
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1)
+    return out
+
+
 def sweep():
     """Run the (nbr-layout x pallas x steps-per-call) grid, each point in a
     fresh subprocess (the flags are read once per process), and report the
@@ -1387,6 +1713,18 @@ def main():
         out = run_bench_preproc()
     elif os.environ.get("BENCH_KERNELS") == "1":
         out = run_bench_kernels()
+    elif os.environ.get("BENCH_MFU") == "1":
+        # the pipelined step needs >= BENCH_MFU_STAGES devices; on a
+        # CPU-only run give XLA a virtual host mesh BEFORE jax
+        # initializes (no effect on a real accelerator backend — the
+        # flag only shapes the host platform)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            stages = int(os.environ.get("BENCH_MFU_STAGES", "4"))
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{max(stages, 4)}").strip()
+        out = run_bench_mfu()
     else:
         out = run_bench()
     print(json.dumps(out))

@@ -1,9 +1,43 @@
 """Config-reachable pipeline (layer) parallelism: `Training.pipeline_stages`.
 
-Wires parallel/pipeline.py's GPipe machinery into a trainable path
+Wires parallel/pipeline.py's schedule machinery into a trainable path
 (VERDICT r1: the pipeline module only counted once a JSON config could turn
 it on). The reference has no pipeline parallelism (SURVEY.md §2.6); the
 schedule follows the GNNPipe pattern (PAPERS.md).
+
+Two train-step schedules (docs/pipeline.md; Training.pipeline_schedule /
+HYDRAGNN_PIPE_SCHEDULE):
+
+* ``gpipe`` — one backward through the whole M-microbatch scan: all
+  forwards, then all backwards; residuals for O(M) microbatches are live
+  at the turnaround.
+* ``1f1b`` (default) — the loss/grad computation is windowed over
+  W = min(S, M) microbatches at a time with f32 gradient accumulation
+  across windows: each window's backward runs before the next window's
+  forward, so at most S microbatches are in flight and peak live
+  activations are O(S) — the 1F1B memory contract (Narayanan et al.;
+  GNNPipe applies it to GNN stacks). Identical math: the metric
+  reduction runs over the restacked flat axis with the same cotangent
+  seeds as gpipe, gradients reassociate only across window boundaries
+  (bitwise on exactly-representable data — pinned in
+  tests/test_pipeline.py), and per-microbatch losses match gpipe
+  bitwise on the tier-1 fixtures. In general XLA may fuse the W-wide
+  and M-wide vmapped forwards differently, so cross-SCHEDULE values on
+  arbitrary data are guaranteed to float tolerance only (the 32-layer
+  BENCH_MFU capture differs in the last ulp); within ONE schedule,
+  remat on/off stays bitwise on any data.
+
+``pipeline_remat`` additionally wraps each tick's stage compute in
+`jax.checkpoint` (pipeline.make_pipeline_apply) — a numeric no-op that
+trades backward recompute for not saving per-layer intermediates.
+
+``pipeline_data_shards`` composes the pipeline with data parallelism on a
+(pipe x data) mesh: the loader's stacked axis carries D x M microbatches
+([d * M + m] flat order), each data shard runs its own pipe ring on its
+own M, and gradients reduce across ``data`` via GSPMD. ZeRO
+optimizer-state sharding (`Training.Optimizer.use_zero_redundancy`,
+mesh.param_sharding_zero) shards the opt-state pytree over the data axis
+exactly as the plain SPMD path does (parallel/spmd.py).
 
 Design: a homogeneous pipelined model built from the zoo's conv modules —
 
@@ -52,8 +86,10 @@ from ..ops.activations import activation_function_selection
 from ..ops.segment import global_mean_pool
 from ..train.loss import multihead_loss
 from ..train.train_step import (TrainState, _cast_floats,
+                                _nonfinite_watchdog,
                                 _resolve_compute_dtype)
-from .pipeline import make_pipeline_apply, stack_stage_params
+from .pipeline import (PIPELINE_SCHEDULES, check_stage_divisibility,
+                       make_pipeline_apply, stack_stage_params)
 
 # factories take (hidden, cfg): PNA needs the degree histogram; SchNet's
 # CFConv additionally needs per-batch edge lengths, threaded through the
@@ -217,7 +253,10 @@ def _decode(params, cfg: ModelConfig, x, batch: GraphBatch, act):
 
 def make_pipeline_forward(cfg: ModelConfig, mesh: Mesh, num_stages: int,
                           pipelined: bool = True,
-                          compute_dtype=None):
+                          compute_dtype=None,
+                          remat: bool = False,
+                          remat_policy=None,
+                          data_shards: int = 1):
     """forward(params, stacked_batch [M, ...]) -> per-microbatch outputs
     (f32, whatever the compute dtype).
 
@@ -225,7 +264,15 @@ def make_pipeline_forward(cfg: ModelConfig, mesh: Mesh, num_stages: int,
     the stacked conv params — the eval path and the equivalence oracle.
     ``compute_dtype`` follows the main path's mixed-precision policy
     (train_step._resolve_compute_dtype): params/batch floats cast to the
-    compute dtype, outputs accumulated back in f32."""
+    compute dtype, outputs accumulated back in f32.
+
+    ``remat``/``remat_policy`` select activation rematerialization on the
+    per-tick stage compute (pipeline.make_pipeline_apply — bitwise
+    no-op). With ``data_shards`` D > 1 the stacked axis carries D x M
+    microbatches in [d * M + m] flat order; everything per-microbatch
+    (embed, decode, losses) stays on the flat axis, and only the
+    pipelined conv stack reshapes to [D, M, ...] so each data shard of
+    the (pipe x data) mesh rings its own microbatches."""
     from ..kernels.nbr_pallas import resolve_nbr_pallas_flag
     resolve_nbr_pallas_flag(refresh=True)  # pinned at construction time
     conv_fn = PIPELINE_CONV_TYPES[cfg.model_type]
@@ -237,16 +284,31 @@ def make_pipeline_forward(cfg: ModelConfig, mesh: Mesh, num_stages: int,
     embed = _embed(hidden)
     cdtype = _resolve_compute_dtype(cfg, compute_dtype)
     mixed = cdtype != jnp.float32
+    data_shards = int(data_shards)
 
     def layer_fn(layer_params, h, batch_t: GraphBatch):
-        return block.apply({"params": layer_params}, h, batch_t)
+        out = block.apply({"params": layer_params}, h, batch_t)
+        # flax LayerNorm promotes to f32, so under bf16 the block output
+        # would widen the carry and break the layer scan / pipeline tick
+        # carry (equal-type requirement); pin it to the carry dtype.
+        # f32 compute: astype is the identity — bitwise no-op.
+        return out.astype(h.dtype)
 
     pipe_apply = None
     if pipelined:
-        pipe_apply = make_pipeline_apply(mesh, layer_fn,
-                                         cfg.num_conv_layers, axis="pipe")
+        pipe_apply = make_pipeline_apply(
+            mesh, layer_fn, cfg.num_conv_layers, axis="pipe",
+            data_axis="data" if data_shards > 1 else None,
+            remat=remat, remat_policy=remat_policy)
 
     precompute = PIPELINE_PRECOMPUTE.get(cfg.model_type)
+
+    def _fold_data(tree):
+        # flat [D*M, ...] -> [D, M, ...] (loader order is d-major)
+        return jax.tree_util.tree_map(
+            lambda a: None if a is None else a.reshape(
+                (data_shards, a.shape[0] // data_shards) + a.shape[1:]),
+            tree)
 
     def forward(params, stacked: GraphBatch):
         if mixed:
@@ -265,7 +327,12 @@ def make_pipeline_forward(cfg: ModelConfig, mesh: Mesh, num_stages: int,
                                      cfg.num_conv_layers // num_stages)
                                     + a.shape[1:]),
                 params["convs"])
-            x = pipe_apply(stage_params, x, stacked)
+            if data_shards > 1:
+                y = pipe_apply(stage_params, _fold_data(x),
+                               _fold_data(stacked))
+                x = y.reshape((-1,) + y.shape[2:])
+            else:
+                x = pipe_apply(stage_params, x, stacked)
         else:
             def scan_layer(h, layer_params):
                 return jax.vmap(
@@ -284,37 +351,186 @@ def make_pipeline_forward(cfg: ModelConfig, mesh: Mesh, num_stages: int,
     return forward
 
 
+def pipeline_window_size(num_stages: int, microbatches: int) -> int:
+    """1F1B window: min(S, M) microbatches in flight at once."""
+    return min(int(num_stages), int(microbatches))
+
+
+def _window_batches(stacked: GraphBatch, data_shards: int, window: int):
+    """Flat [D*M, ...] batch -> [num_windows, D*W, ...] window stack.
+
+    Window w holds microbatches [w*W, (w+1)*W) of EVERY data replica
+    (replicas advance through the schedule in lockstep), flattened back
+    to the [d * W + j] order make_pipeline_forward expects."""
+    def fold(a):
+        if a is None:
+            return None
+        D = data_shards
+        M = a.shape[0] // D
+        nw = M // window
+        # [D, nw, W, ...] -> [nw, D, W, ...] -> [nw, D*W, ...]
+        b = a.reshape((D, nw, window) + a.shape[1:])
+        b = jnp.moveaxis(b, 1, 0)
+        return b.reshape((nw, D * window) + a.shape[1:])
+    return jax.tree_util.tree_map(fold, stacked)
+
+
+def _unwindow(values, data_shards: int):
+    """[nw, D*W, ...] per-window scan outputs -> flat [D*M, ...] in the
+    original [d * M + m] order, so 1f1b metrics are computed over the
+    EXACT array layout the gpipe schedule reduces (bitwise-equal means)."""
+    def unfold(a):
+        nw, dw = a.shape[:2]
+        b = a.reshape((nw, data_shards, dw // data_shards) + a.shape[2:])
+        b = jnp.moveaxis(b, 1, 0)
+        return b.reshape((data_shards * nw * (dw // data_shards),)
+                         + a.shape[2:])
+    return jax.tree_util.tree_map(unfold, values)
+
+
+def _tree_add(a, b):
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def _windowed_grads(params, stacked: GraphBatch, micro_fn, num_stages: int,
+                    data_shards: int):
+    """The 1F1B backward organization: scan windows of W = min(S, M)
+    microbatches, each window's forward+backward completing before the
+    next window's forward starts, f32 gradient accumulation across
+    windows. `micro_fn(params, window_batch)` returns a tuple of
+    per-micro scalar rows whose FIRST entry is the per-micro loss; each
+    window differentiates sum(first row) / (D*M) — the same per-tick
+    cotangent seeds the gpipe schedule's single backward uses, so the
+    two schedules' gradients differ only by window-boundary summation
+    order (exact on exactly-representable data).
+
+    Returns (grads_sum, per-micro value stack in flat [D*M] order)."""
+    DM = stacked.x.shape[0]
+    M = DM // data_shards
+    W = pipeline_window_size(num_stages, M)
+    if M % W:
+        # direct callers (bench knobs, tests) can reach here without
+        # run_training's config-time validation — raise the actionable
+        # message, not the opaque reshape error inside _window_batches
+        raise ValueError(
+            f"the 1f1b schedule windows {M} microbatches into groups of "
+            f"{W} (= min(stages, microbatches)): set microbatches to a "
+            f"multiple of the stage count (or at most the stage count), "
+            f"or use schedule=\"gpipe\"")
+    windows = _window_batches(stacked, data_shards, W)
+
+    def window_body(gsum, win: GraphBatch):
+        def wloss(p):
+            values = micro_fn(p, win)
+            # sum/DM (not sum * (1/DM)): the gpipe schedule's jnp.mean
+            # lowers to a divide, and matching it keeps the two
+            # schedules' cotangent seeds bitwise-identical
+            return jnp.sum(values[0]) / DM, values
+        (_, values), g = jax.value_and_grad(wloss, has_aux=True)(params)
+        return _tree_add(gsum, g), values
+
+    gsum0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+    grads, values = jax.lax.scan(window_body, gsum0, windows)
+    return grads, _unwindow(values, data_shards)
+
+
+def _apply_updates(state: TrainState, grads, tx, freeze, mesh,
+                   zero_opt: bool, zero_min_size: int):
+    """Shared optimizer tail of both pipeline train steps. With
+    ``zero_opt`` the optimizer-state pytree is sharding-constrained over
+    the ``data`` mesh axis (mesh.param_sharding_zero) and GSPMD
+    partitions the elementwise update — the same ZeRO composition the
+    plain SPMD path uses (parallel/spmd.py)."""
+    grads = freeze(grads)
+    opt_state = state.opt_state
+    opt_spec = None
+    if zero_opt:
+        from .mesh import param_sharding_zero
+        opt_spec = param_sharding_zero(mesh, opt_state, axis="data",
+                                       min_size=zero_min_size)
+        opt_state = jax.lax.with_sharding_constraint(opt_state, opt_spec)
+    updates, new_opt = tx.update(grads, opt_state, state.params)
+    updates = freeze(updates)
+    if opt_spec is not None:
+        new_opt = jax.lax.with_sharding_constraint(new_opt, opt_spec)
+    new_params = optax.apply_updates(state.params, updates)
+    return state.replace(params=new_params, opt_state=new_opt,
+                         step=state.step + 1)
+
+
 def make_pipeline_train_step(cfg: ModelConfig, mesh: Mesh, num_stages: int,
                              tx: optax.GradientTransformation,
-                             loss_name: str = "mse"):
+                             loss_name: str = "mse",
+                             schedule: str = "1f1b",
+                             remat: bool = False, remat_policy=None,
+                             data_shards: int = 1,
+                             zero_opt: bool = False,
+                             zero_min_size: int = 2 ** 14,
+                             pipelined: bool = True,
+                             compute_dtype=None):
     """train_step(state, stacked_batch) -> (state, metrics). The stacked
-    [M, ...] batch doubles as the microbatch axis."""
-    forward = make_pipeline_forward(cfg, mesh, num_stages, pipelined=True)
+    [D*M, ...] batch doubles as the microbatch axis (D = data_shards).
 
-    def loss_fn(params, stacked: GraphBatch):
+    ``schedule`` picks the backward organization (module docstring):
+    "gpipe" differentiates the whole M-microbatch scan at once, "1f1b"
+    windows it to min(S, M) in-flight microbatches; metrics reduce the
+    same flat array (cross-schedule equivalence contract: module
+    docstring). ``pipelined=False`` swaps in the sequential-scan
+    forward (the BENCH_MFU baseline) — identical math, no pipe
+    collective. ``compute_dtype`` threads straight into
+    make_pipeline_forward's mixed-precision policy (None keeps the
+    cfg/env-resolved default)."""
+    if schedule not in PIPELINE_SCHEDULES:
+        raise ValueError(f"unknown pipeline schedule {schedule!r} "
+                         f"(use one of {PIPELINE_SCHEDULES})")
+    forward = make_pipeline_forward(cfg, mesh, num_stages,
+                                    pipelined=pipelined,
+                                    remat=remat, remat_policy=remat_policy,
+                                    data_shards=data_shards,
+                                    compute_dtype=compute_dtype)
+
+    def micro_values(params, stacked: GraphBatch):
         outputs, outputs_var = forward(params, stacked)
 
         def per_micro(outs, ovar, b):
             total, tasks = multihead_loss(cfg, loss_name, outs, ovar, b)
             return total, jnp.stack(tasks)
-        losses, tasks = jax.vmap(per_micro)(outputs, outputs_var, stacked)
+        return jax.vmap(per_micro)(outputs, outputs_var, stacked)
+
+    def metrics_from(losses, tasks):
         metrics = {"loss": jnp.mean(losses)}
         for i in range(len(cfg.heads)):
             metrics[f"task_{i}"] = jnp.mean(tasks[:, i])
-        return jnp.mean(losses), metrics
+        return metrics
 
     freeze = _make_freeze(cfg)
 
+    def grads_and_metrics(params, stacked: GraphBatch):
+        if schedule == "1f1b":
+            grads, (losses, tasks) = _windowed_grads(
+                params, stacked, micro_values, num_stages, data_shards)
+            return grads, metrics_from(losses, tasks)
+        def loss_fn(p):
+            losses, tasks = micro_values(p, stacked)
+            # sum/DM == mean, spelled the way the 1f1b windows spell it
+            # so the two schedules' cotangent seeds are bitwise-identical
+            return jnp.sum(losses) / losses.shape[0], metrics_from(losses,
+                                                                   tasks)
+        (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params)
+        return grads, metrics
+
     @jax.jit
     def train_step(state: TrainState, stacked: GraphBatch):
-        (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, stacked)
-        grads = freeze(grads)
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        updates = freeze(updates)
-        new_params = optax.apply_updates(state.params, updates)
-        return state.replace(params=new_params, opt_state=new_opt,
-                             step=state.step + 1), metrics
+        grads, metrics = grads_and_metrics(state.params, stacked)
+        # bf16/overflow watchdog parity with the main trainer path
+        # (docs/kernels_mixed_precision.md): count this step if the loss
+        # or ANY gradient leaf went non-finite
+        metrics = {**metrics,
+                   "nonfinite_steps": _nonfinite_watchdog(metrics["loss"],
+                                                          grads)}
+        return _apply_updates(state, grads, tx, freeze, mesh,
+                              zero_opt, zero_min_size), metrics
 
     return train_step
 
@@ -332,13 +548,34 @@ def _make_freeze(cfg: ModelConfig):
     return freeze
 
 
+def _resolve_ef_force_weight(stacked: GraphBatch, energy_weight,
+                             force_weight):
+    """ONE whole-batch force weight for "auto" (reference semantics,
+    Base.py:400-404) — a per-microbatch (or per-1f1b-window) ratio would
+    make the pipelined loss diverge from the sequential path's on
+    identical data, so the weight is resolved from the FULL stacked
+    batch before any windowing. Pure label data — no forward involved."""
+    if force_weight != "auto":
+        return force_weight
+    from ..train.loss import auto_force_weight
+    flat = lambda a: a.reshape((-1,) + a.shape[2:])
+    return auto_force_weight(flat(stacked.energy), flat(stacked.forces),
+                             flat(stacked.graph_mask),
+                             flat(stacked.node_mask), energy_weight)
+
+
 def _ef_losses(cfg: ModelConfig, loss_name, forward, params,
                stacked: GraphBatch, energy_weight, force_weight):
     """Energy-force loss over the stacked microbatch axis, differentiating
     THROUGH the (pipelined or sequential) forward — graph energy = masked
     sum of node energies, forces = -dE/dpos (the pipelined analogue of
     train/loss.energy_force_loss; reference: Base.energy_force_loss,
-    Base.py:359-411). Returns per-microbatch (total, e_loss, f_loss)."""
+    Base.py:359-411). Returns per-microbatch (total, e_loss, f_loss).
+
+    ``force_weight`` may be "auto" (resolved over THIS stacked batch) or
+    an already-resolved scalar — the 1f1b step resolves it over the full
+    batch first and passes the scalar per window
+    (_resolve_ef_force_weight)."""
     from ..ops.segment import global_sum_pool
     from ..train.loss import masked_loss
 
@@ -358,16 +595,7 @@ def _ef_losses(cfg: ModelConfig, loss_name, forward, params,
         total_energy, has_aux=True)(stacked.pos)
     forces_pred = -neg_f
 
-    fw = force_weight
-    if fw == "auto":
-        # ONE whole-batch weight (reference semantics, Base.py:400-404)
-        # — a per-microbatch ratio would make the pipelined loss diverge
-        # from the sequential path's on identical data
-        from ..train.loss import auto_force_weight
-        flat = lambda a: a.reshape((-1,) + a.shape[2:])
-        fw = auto_force_weight(flat(stacked.energy), flat(stacked.forces),
-                               flat(stacked.graph_mask),
-                               flat(stacked.node_mask), energy_weight)
+    fw = _resolve_ef_force_weight(stacked, energy_weight, force_weight)
 
     def per_micro(ge, fp, b):
         e_loss = masked_loss(loss_name, ge, b.energy, b.graph_mask)
@@ -381,31 +609,66 @@ def make_pipeline_ef_train_step(cfg: ModelConfig, mesh: Mesh,
                                 tx: optax.GradientTransformation,
                                 loss_name: str = "mse",
                                 energy_weight: float = 1.0,
-                                force_weight: float = 1.0):
+                                force_weight: float = 1.0,
+                                schedule: str = "1f1b",
+                                remat: bool = False, remat_policy=None,
+                                data_shards: int = 1,
+                                zero_opt: bool = False,
+                                zero_min_size: int = 2 ** 14,
+                                compute_dtype=None):
     """Energy-force training on the pipelined stack: the params-grad is a
-    second derivative through the GPipe schedule (ppermute/psum transpose
-    cleanly), so compute_grad_energy composes with pipeline_stages."""
-    forward = make_pipeline_forward(cfg, mesh, num_stages, pipelined=True)
+    second derivative through the pipelined schedule (ppermute transposes
+    cleanly), so compute_grad_energy composes with pipeline_stages —
+    including the 1f1b windowing (each window's force grad + params grad
+    complete before the next window's forward) and remat (jax.checkpoint
+    recomputes identically under higher-order differentiation)."""
+    if schedule not in PIPELINE_SCHEDULES:
+        raise ValueError(f"unknown pipeline schedule {schedule!r} "
+                         f"(use one of {PIPELINE_SCHEDULES})")
+    forward = make_pipeline_forward(cfg, mesh, num_stages, pipelined=True,
+                                    remat=remat, remat_policy=remat_policy,
+                                    data_shards=data_shards,
+                                    compute_dtype=compute_dtype)
 
-    def loss_fn(params, stacked: GraphBatch):
-        totals, e_l, f_l = _ef_losses(cfg, loss_name, forward, params,
-                                      stacked, energy_weight, force_weight)
-        return jnp.mean(totals), {"loss": jnp.mean(totals),
-                                  "energy_loss": jnp.mean(e_l),
-                                  "force_loss": jnp.mean(f_l)}
+    def metrics_from(totals, e_l, f_l):
+        return {"loss": jnp.mean(totals), "energy_loss": jnp.mean(e_l),
+                "force_loss": jnp.mean(f_l)}
 
     freeze = _make_freeze(cfg)
 
+    def grads_and_metrics(params, stacked: GraphBatch):
+        if schedule == "1f1b":
+            # the "auto" force weight is a whole-batch statistic; resolve
+            # it BEFORE windowing or the loss would diverge from the
+            # sequential/gpipe paths on identical data
+            fw = _resolve_ef_force_weight(stacked, energy_weight,
+                                          force_weight)
+
+            def micro_fn(p, win: GraphBatch):
+                return _ef_losses(cfg, loss_name, forward, p, win,
+                                  energy_weight, fw)
+            grads, (totals, e_l, f_l) = _windowed_grads(
+                params, stacked, micro_fn, num_stages, data_shards)
+            return grads, metrics_from(totals, e_l, f_l)
+
+        def loss_fn(p):
+            totals, e_l, f_l = _ef_losses(cfg, loss_name, forward, p,
+                                          stacked, energy_weight,
+                                          force_weight)
+            return jnp.sum(totals) / totals.shape[0], metrics_from(
+                totals, e_l, f_l)
+        (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params)
+        return grads, metrics
+
     @jax.jit
     def train_step(state: TrainState, stacked: GraphBatch):
-        (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, stacked)
-        grads = freeze(grads)
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        updates = freeze(updates)
-        new_params = optax.apply_updates(state.params, updates)
-        return state.replace(params=new_params, opt_state=new_opt,
-                             step=state.step + 1), metrics
+        grads, metrics = grads_and_metrics(state.params, stacked)
+        metrics = {**metrics,
+                   "nonfinite_steps": _nonfinite_watchdog(metrics["loss"],
+                                                          grads)}
+        return _apply_updates(state, grads, tx, freeze, mesh,
+                              zero_opt, zero_min_size), metrics
 
     return train_step
 
@@ -456,37 +719,64 @@ def make_pipeline_eval_step(cfg: ModelConfig, mesh: Mesh, num_stages: int,
     return eval_step
 
 
-def place_pipeline_batch(batch: GraphBatch, mesh: Mesh) -> GraphBatch:
+def place_pipeline_batch(batch: GraphBatch, mesh: Mesh,
+                         data_shards: int = 1) -> GraphBatch:
     """Microbatches are replicated over the pipe axis (only activations
-    ride the ring; structure is broadcast — pipeline.py layout)."""
-    sh = NamedSharding(mesh, P())
+    ride the ring; structure is broadcast — pipeline.py layout). With
+    ``data_shards`` > 1 the flat [D*M, ...] stacked axis is sharded over
+    the ``data`` mesh axis — replica d's M microbatches are the
+    contiguous rows [d*M, (d+1)*M), which is exactly the slice its
+    devices need, so placement involves no resharding."""
+    spec = P("data") if data_shards > 1 else P()
+    sh = NamedSharding(mesh, spec)
     return jax.tree_util.tree_map(
         lambda a: None if a is None else jax.device_put(a, sh), batch)
 
 
 def validate_pipeline_config(cfg: ModelConfig, num_stages: int,
-                             batch_size: int, microbatches: int):
+                             batch_size: int, microbatches: int,
+                             schedule: str = "1f1b",
+                             data_shards: int = 1):
     if cfg.model_type not in PIPELINE_CONV_TYPES:
         raise ValueError(
             f"Training.pipeline_stages supports model_type in "
             f"{sorted(PIPELINE_CONV_TYPES)} (homogeneous conv stacks); "
             f"got {cfg.model_type}")
-    if cfg.num_conv_layers % num_stages:
+    # the ONE stage-divisibility check (pipeline.check_stage_divisibility)
+    # — a ValueError at config time, never a bare assert that vanishes
+    # under python -O and resurfaces as an opaque reshape error
+    check_stage_divisibility(cfg.num_conv_layers, num_stages)
+    data_shards = int(data_shards or 1)
+    if data_shards < 1:
         raise ValueError(
-            f"num_conv_layers={cfg.num_conv_layers} does not split into "
-            f"{num_stages} pipeline stages")
-    if jax.device_count() < num_stages:
+            f"pipeline_data_shards must be >= 1 (got {data_shards})")
+    if jax.device_count() < num_stages * data_shards:
         raise ValueError(
-            f"pipeline_stages={num_stages} exceeds device count "
-            f"{jax.device_count()}")
-    if batch_size % microbatches:
-        raise ValueError(
-            f"batch_size={batch_size} does not split into "
-            f"{microbatches} microbatches")
+            f"pipeline_stages={num_stages} x pipeline_data_shards="
+            f"{data_shards} exceeds device count {jax.device_count()}")
     if microbatches < 2:
         # the train step's microbatch vmap needs the loader's stacked
-        # [M, ...] layout (and a 1-deep pipeline is all bubble anyway)
-        raise ValueError("pipeline_microbatches must be >= 2")
+        # [M, ...] layout (and a 1-deep pipeline is all bubble anyway);
+        # checked before the divisibility modulo so microbatches=0 gets
+        # this message instead of a ZeroDivisionError
+        raise ValueError(
+            f"pipeline_microbatches must be >= 2 (got {microbatches})")
+    if batch_size % (microbatches * data_shards):
+        raise ValueError(
+            f"batch_size={batch_size} does not split into "
+            f"{microbatches} microbatches x {data_shards} data shards")
+    if schedule not in PIPELINE_SCHEDULES:
+        raise ValueError(
+            f"pipeline_schedule must be one of {PIPELINE_SCHEDULES} "
+            f"(got {schedule!r})")
+    if schedule == "1f1b" and microbatches > num_stages \
+            and microbatches % num_stages:
+        raise ValueError(
+            f"the 1f1b schedule windows {microbatches} microbatches into "
+            f"groups of pipeline_stages={num_stages}: set "
+            f"pipeline_microbatches to a multiple of pipeline_stages (or "
+            f"at most pipeline_stages), or use pipeline_schedule "
+            f"\"gpipe\"")
     for head in cfg.heads:
         if head.head_type != "graph" and head.node_arch not in ("mlp",):
             raise ValueError(

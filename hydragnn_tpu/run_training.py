@@ -219,12 +219,16 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
             f"Architecture.graph_shards={graph_shards} does not divide the "
             f"device count {ndev}")
 
-    # Training.pipeline_stages > 1: GPipe layer parallelism over a "pipe"
-    # mesh axis (parallel/pipeline_trainer.py). The loader's device-stacked
-    # output doubles as the microbatch axis.
+    # Training.pipeline_stages > 1: pipelined layer parallelism over a
+    # "pipe" mesh axis (parallel/pipeline_trainer.py, docs/pipeline.md).
+    # The loader's device-stacked output doubles as the microbatch axis.
+    # Schedule/remat/microbatch knobs resolve ONCE here, strictly, at
+    # step-construction time (utils/envflags.resolve_pipeline — typo env
+    # values warn and fall back, the HYDRAGNN_PALLAS_NBR lesson).
     pipeline_stages = int(train_cfg.get("pipeline_stages", 1) or 1)
-    microbatches = int(train_cfg.get("pipeline_microbatches",
-                                     pipeline_stages) or pipeline_stages)
+    from .utils.envflags import resolve_pipeline
+    (microbatches, pipe_schedule, pipe_remat,
+     pipe_data_shards) = resolve_pipeline(train_cfg, pipeline_stages)
     if pipeline_stages > 1 and graph_shards > 1:
         raise ValueError("pipeline_stages and graph_shards cannot be "
                          "combined yet")
@@ -234,13 +238,32 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     from .parallel.mesh import resolve_num_shards
     if pipeline_stages > 1:
         # validate before the loader asserts on batch/shard divisibility
-        # with a less actionable message
+        # with a less actionable message (ValueError here, never a bare
+        # assert — asserts vanish under python -O)
         from .parallel.pipeline_trainer import (
             require_pipeline_norm_optin, validate_pipeline_config)
         require_pipeline_norm_optin(train_cfg)
         validate_pipeline_config(mcfg, pipeline_stages, batch_size,
-                                 microbatches)
-        num_shards = microbatches  # loader stacking = microbatch axis
+                                 microbatches, schedule=pipe_schedule,
+                                 data_shards=pipe_data_shards)
+        # loader stacking = (data replica x microbatch) axis, d-major
+        num_shards = microbatches * pipe_data_shards
+        log(f"pipeline: stages={pipeline_stages} "
+            f"microbatches={microbatches} schedule={pipe_schedule} "
+            f"remat={pipe_remat or 'off'} "
+            f"data_shards={pipe_data_shards}")
+        if (pipe_data_shards == 1 and bool(
+                train_cfg.get("Optimizer", {}).get(
+                    "use_zero_redundancy", False))):
+            # ZeRO shards opt state over the data axis; with one data
+            # shard there is nothing to shard over and the knob would
+            # silently do nothing — say so (the strict-knob rule)
+            import logging
+            logging.getLogger("hydragnn_tpu").warning(
+                "Optimizer.use_zero_redundancy has no effect on a "
+                "pipeline run with pipeline_data_shards=1: opt state "
+                "shards over the data mesh axis. Set "
+                "Training.pipeline_data_shards > 1 to shard it.")
     else:
         num_shards = resolve_num_shards(
             num_shards, batch_size, use_spmd,
@@ -433,19 +456,32 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
                                                 make_pipeline_ef_train_step,
                                                 make_pipeline_eval_step,
                                                 make_pipeline_train_step)
-        mesh = make_mesh((("pipe", pipeline_stages),))
+        if pipe_data_shards > 1:
+            mesh = make_mesh((("pipe", pipeline_stages),
+                              ("data", pipe_data_shards)))
+        else:
+            mesh = make_mesh((("pipe", pipeline_stages),))
+        opt_cfg = train_cfg.get("Optimizer", {})
+        pipe_kwargs = dict(
+            schedule=pipe_schedule,
+            remat=pipe_remat is not None, remat_policy=pipe_remat,
+            data_shards=pipe_data_shards,
+            zero_opt=(pipe_data_shards > 1
+                      and bool(opt_cfg.get("use_zero_redundancy", False))),
+            zero_min_size=int(opt_cfg.get("zero_min_shard_size", 2 ** 14)))
         if cge:
             # energy-force through the pipeline: the force grad and the
-            # params grad both differentiate through the GPipe schedule
+            # params grad both differentiate through the schedule
+            # (1f1b windows included)
             train_step = make_pipeline_ef_train_step(
                 mcfg, mesh, pipeline_stages, tx, loss_name,
-                energy_weight=e_w, force_weight=f_w)
+                energy_weight=e_w, force_weight=f_w, **pipe_kwargs)
             eval_step = make_pipeline_ef_eval_step(
                 mcfg, mesh, pipeline_stages, loss_name,
                 energy_weight=e_w, force_weight=f_w)
         else:
             train_step = make_pipeline_train_step(
-                mcfg, mesh, pipeline_stages, tx, loss_name)
+                mcfg, mesh, pipeline_stages, tx, loss_name, **pipe_kwargs)
             eval_step = make_pipeline_eval_step(mcfg, mesh, pipeline_stages,
                                                 loss_name)
     elif graph_shards > 1:
@@ -581,7 +617,8 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
 
     if pipeline_stages > 1:
         from .parallel.pipeline_trainer import place_pipeline_batch
-        place_fn = lambda b: place_pipeline_batch(b, mesh)
+        place_fn = lambda b: place_pipeline_batch(
+            b, mesh, data_shards=pipe_data_shards)
     elif graph_shards > 1:
         from .parallel.composite import place_composed_batch
 
@@ -671,6 +708,26 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
             from .train.precision import resolve_precision
             telemetry.compute_dtype = resolve_precision(
                 getattr(mcfg, "dtype", None))
+            if pipeline_stages > 1:
+                # pipelined runs: the trainer reports the schedule's
+                # closed-form bubble fraction as a gauge + per-stage idle
+                # spans each epoch (docs/pipeline.md, docs/observability.md)
+                from .parallel.pipeline import (bubble_fraction,
+                                                train_bubble_fraction,
+                                                train_step_ticks)
+                telemetry.pipeline_info = {
+                    "stages": pipeline_stages,
+                    "microbatches": microbatches,
+                    "data_shards": pipe_data_shards,
+                    "schedule": pipe_schedule,
+                    "remat": pipe_remat or "off",
+                    "bubble_frac": bubble_fraction(pipeline_stages,
+                                                   microbatches),
+                    "train_bubble_frac": train_bubble_fraction(
+                        pipeline_stages, microbatches, pipe_schedule),
+                    "train_ticks": train_step_ticks(
+                        pipeline_stages, microbatches, pipe_schedule),
+                }
             log(f"telemetry: on -> {telemetry.out_dir}")
         if preempt_fn is not None:
             from .train.trainer import install_sigterm_handler

@@ -198,6 +198,22 @@ def _make_step_body(model, cfg: ModelConfig, tx: optax.GradientTransformation,
     return step_body
 
 
+def compiled_cost_flops(compiled):
+    """Per-call FLOPs from an already-compiled executable's XLA cost
+    analysis; None when the backend doesn't report it. Callers that
+    already hold a ``.lower(...).compile()`` result (bench.py reuses one
+    executable for cost analysis, memory analysis, and execution) use
+    this directly instead of paying ``step_cost_flops``'s compile."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        f = float(ca.get("flops", 0.0))
+        return f if f > 0 else None
+    except Exception:
+        return None
+
+
 def step_cost_flops(step_fn, *args):
     """Per-call FLOPs of a jitted step from XLA's compiled cost analysis;
     None when the backend doesn't report it (or `step_fn` isn't
@@ -207,11 +223,7 @@ def step_cost_flops(step_fn, *args):
     and compiles the step for the probe shapes — so callers run it once
     per (run, shape), never per epoch."""
     try:
-        ca = step_fn.lower(*args).compile().cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        f = float(ca.get("flops", 0.0))
-        return f if f > 0 else None
+        return compiled_cost_flops(step_fn.lower(*args).compile())
     except Exception:
         return None
 

@@ -516,8 +516,20 @@ def train_validate_test(
         achieved = mfu_val = None
         if telemetry is not None:
             from ..telemetry.mfu import achieved_and_mfu
+            pinfo = getattr(telemetry, "pipeline_info", None)
             flops = None
-            if flops_probe_batch is not None:
+            if pinfo:
+                # the shard_map-pipelined step's cost analysis is
+                # per-partition and counts remat recompute as work — not
+                # a useful-work numerator (BENCH_MFU probes the
+                # sequential step instead; bench.py run_bench_mfu)
+                flops_probe_batch = None
+                if epoch == start_epoch:
+                    log("telemetry: pipelined run — per-step MFU gauge "
+                        "unavailable (the shard_map step's cost analysis "
+                        "is per-partition; see BENCH_MFU for the "
+                        "sequential-probe numerator)")
+            elif flops_probe_batch is not None:
                 flops = telemetry.step_flops_once(train_step, state,
                                                   flops_probe_batch)
                 # the probe result is memoized in the session — release
@@ -580,12 +592,52 @@ def train_validate_test(
             # would write a literal `NaN` and break the one-JSON-object-
             # per-line contract for exactly the degraded runs worth
             # inspecting
+            # pipelined runs (run_training sets telemetry.pipeline_info):
+            # the schedule's closed-form bubble fraction as a gauge plus
+            # per-stage idle spans — a SCHEDULE-MODEL overlay (each
+            # stage's fill/drain ticks scaled to this epoch's measured
+            # step time), not a device measurement; cat "pipeline-model"
+            # marks it as such in the trace (docs/pipeline.md)
+            if pinfo:
+                reg.gauge_set("pipeline_bubble_frac",
+                              float(pinfo["bubble_frac"]),
+                              help="closed-form per-pass schedule bubble "
+                                   "(S-1)/(M+S-1)")
+                reg.gauge_set("pipeline_train_bubble_frac",
+                              float(pinfo["train_bubble_frac"]),
+                              help="closed-form fwd+bwd train-step bubble "
+                                   "for the active schedule")
+                rec = _spans.current_recorder()
+                if rec is not None and stall.step_s > 0:
+                    S_p = int(pinfo["stages"])
+                    ticks = float(pinfo["train_ticks"])
+                    t_end = _spans.now()
+                    # every stage does 2*M useful ticks per step (each
+                    # microbatch crosses it once forward, once backward);
+                    # the rest of the step's ticks are fill/drain idle
+                    idle_ticks = max(
+                        ticks - 2 * int(pinfo["microbatches"]), 0)
+                    dur = stall.step_s * idle_ticks / max(ticks, 1.0)
+                    for s in range(S_p):
+                        rec.add("pipe.stage_idle", t_end - dur, dur,
+                                "pipeline-model",
+                                {"stage": s, "epoch": epoch,
+                                 "idle_ticks": idle_ticks,
+                                 "ticks_per_step": ticks,
+                                 "schedule": pinfo["schedule"]})
             data = {"nonfinite_steps": nonfinite_steps, "batches": nb}
             for k, v in (("train_loss", train_loss),
                          ("val_loss", val_loss),
                          ("test_loss", test_loss), ("lr", lr)):
                 if np.isfinite(v):
                     data[k] = v
+            if pinfo:
+                data["pipeline_schedule"] = pinfo["schedule"]
+                data["pipeline_stages"] = int(pinfo["stages"])
+                data["pipeline_microbatches"] = int(pinfo["microbatches"])
+                data["pipeline_bubble_frac"] = float(pinfo["bubble_frac"])
+                data["pipeline_train_bubble_frac"] = float(
+                    pinfo["train_bubble_frac"])
             if pad_stats is not None:
                 data["padding_frac_nodes"] = float(
                     pad_stats["padding_frac_nodes"])

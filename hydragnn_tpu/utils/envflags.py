@@ -209,6 +209,86 @@ def resolve_telemetry(train_cfg=None):
     )
 
 
+def resolve_pipeline(train_cfg, num_stages: int):
+    """Pipeline-parallelism knobs (docs/pipeline.md) ->
+    (microbatches, schedule, remat_policy_or_None, data_shards).
+
+    Precedence per knob: HYDRAGNN_* env over the Training.* config keys
+    over defaults. STRICT parsing throughout — the schedule/remat knobs
+    switch the compiled program's structure, so a typo value must warn
+    and fall back, never silently take effect (the HYDRAGNN_PALLAS_NBR
+    lesson). Resolved ONCE here at step-construction time; the
+    parallel/ modules take plain values and never read the environment
+    (tools/check_traced_env_reads.py enforces it).
+
+    Knobs:
+      HYDRAGNN_PIPE_MICROBATCHES  microbatches per step
+                                  (Training.pipeline_microbatches;
+                                  default: pipeline_stages)
+      HYDRAGNN_PIPE_SCHEDULE      gpipe | 1f1b
+                                  (Training.pipeline_schedule; default
+                                  1f1b — O(S) live activations)
+      HYDRAGNN_PIPE_REMAT         0/off | 1/full | dots
+                                  (Training.pipeline_remat; default off)
+    Data-parallel composition (Training.pipeline_data_shards) is
+    config-only: it changes the device/loader layout, not a per-run
+    tuning choice.
+    """
+    train_cfg = train_cfg or {}
+    micro_default = int(train_cfg.get("pipeline_microbatches",
+                                      num_stages) or num_stages)
+    microbatches = env_strict_int("HYDRAGNN_PIPE_MICROBATCHES",
+                                  micro_default)
+    # "explicit" means a VALID explicit choice: a typo'd (or empty) env
+    # value falls back through env_strict_choice and must not also
+    # disable the backward-compat gpipe fallback below — that would turn
+    # warn-and-fall-back into a hard config error
+    sched_env = (os.getenv("HYDRAGNN_PIPE_SCHEDULE") or "").strip().lower()
+    sched_cfg = str(train_cfg.get("pipeline_schedule") or "").strip().lower()
+    sched_explicit = sched_env in ("gpipe", "1f1b") or bool(sched_cfg)
+    sched_default = sched_cfg or "1f1b"
+    schedule = env_strict_choice(
+        "HYDRAGNN_PIPE_SCHEDULE",
+        {"gpipe": "gpipe", "1f1b": "1f1b"}, sched_default)
+    if (schedule == "1f1b" and not sched_explicit and num_stages > 0
+            and microbatches > num_stages
+            and microbatches % num_stages):
+        # backward compat: 1f1b became the DEFAULT in PR 8, but it
+        # windows M into groups of S — a pre-existing config with, say,
+        # M=6 over S=4 was valid under gpipe and must not start failing
+        # from a changed default. Only an EXPLICIT 1f1b request turns
+        # this into the config-time ValueError
+        # (pipeline_trainer.validate_pipeline_config).
+        import logging
+        logging.getLogger("hydragnn_tpu").warning(
+            "pipeline_microbatches=%d is not a multiple of "
+            "pipeline_stages=%d, which the default 1f1b schedule cannot "
+            "window — falling back to gpipe (O(M) live activations). "
+            "Set Training.pipeline_schedule/HYDRAGNN_PIPE_SCHEDULE "
+            "explicitly to silence this.", microbatches, num_stages)
+        schedule = "gpipe"
+    # remat: a boolean-ish knob with a policy extension — 1/true/on and
+    # "full" mean full rematerialization, "dots" keeps matmul outputs
+    remat_map = {"0": None, "false": None, "off": None, "no": None,
+                 "1": "full", "true": "full", "on": "full",
+                 "full": "full", "dots": "dots"}
+    remat_default = train_cfg.get("pipeline_remat", False)
+    if isinstance(remat_default, bool):
+        default_policy = "full" if remat_default else None
+    else:
+        key = str(remat_default).strip().lower()
+        if key and key not in remat_map:
+            import logging
+            logging.getLogger("hydragnn_tpu").warning(
+                "Training.pipeline_remat=%r is not one of %s; treating "
+                "as off", remat_default, sorted(set(remat_map)))
+        default_policy = remat_map.get(key)
+    policy = env_strict_choice("HYDRAGNN_PIPE_REMAT", remat_map,
+                               default_policy)
+    data_shards = int(train_cfg.get("pipeline_data_shards", 1) or 1)
+    return int(microbatches), schedule, policy, data_shards
+
+
 def resolve_steps_per_call(train_cfg) -> int:
     """Steps-per-call dispatch batching knob: HYDRAGNN_STEPS_PER_CALL env
     overrides Training.steps_per_call (default 1). Shared by run_training

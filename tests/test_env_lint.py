@@ -75,6 +75,14 @@ def test_lint_covers_the_known_offender_modules():
     for mod in ("registry.py", "spans.py", "session.py", "http.py",
                 "mfu.py", "__init__.py"):
         assert os.path.join("hydragnn_tpu", "telemetry", mod) in paths
+    # PR 8: the parallel step/forward factories are traced surface —
+    # the pipeline schedule/remat knobs resolve via
+    # utils/envflags.resolve_pipeline at construction time. mesh.py is
+    # the ONE documented exclusion (host-side rendezvous/SLURM reads).
+    for mod in ("pipeline.py", "pipeline_trainer.py", "spmd.py",
+                "composite.py", "graph_parallel.py"):
+        assert os.path.join("hydragnn_tpu", "parallel", mod) in paths
+    assert os.path.join("hydragnn_tpu", "parallel", "mesh.py") not in paths
 
 
 def test_lint_cli_exit_code():

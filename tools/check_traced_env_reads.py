@@ -40,6 +40,21 @@ TRACED_DIRS = (
     # direct env read inside the subsystem (PR 7; same rule that keeps
     # the kernels/precision modules honest)
     os.path.join("hydragnn_tpu", "telemetry"),
+    # the parallel step/forward factories (pipeline, spmd, composite,
+    # graph_parallel) build traced bodies — the schedule/remat/shard
+    # knobs resolve via utils/envflags.resolve_pipeline at construction
+    # (PR 8); mesh.py is excluded below: its env reads are the multi-host
+    # rendezvous + SLURM walltime probes, host-side startup code that
+    # never runs under trace
+    os.path.join("hydragnn_tpu", "parallel"),
+)
+
+# host-side files inside an otherwise-traced directory; every entry must
+# carry a reason above/next to it
+EXCLUDED_FILES = (
+    os.path.join("hydragnn_tpu", "parallel", "mesh.py"),  # rendezvous/
+    # SLURM env parsing at process startup (init_distributed,
+    # walltime_deadline) — never traced
 )
 TRACED_FILES = (
     os.path.join("hydragnn_tpu", "train", "train_step.py"),
@@ -79,7 +94,8 @@ def traced_module_paths(root: str) -> List[str]:
             paths.extend(os.path.join(dirpath, n) for n in sorted(names)
                          if n.endswith(".py"))
     paths.extend(os.path.join(root, f) for f in TRACED_FILES)
-    return [p for p in paths if os.path.exists(p)]
+    excluded = {os.path.join(root, f) for f in EXCLUDED_FILES}
+    return [p for p in paths if os.path.exists(p) and p not in excluded]
 
 
 def check(root: str) -> List[Tuple[str, int, str]]:

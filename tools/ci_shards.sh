@@ -33,10 +33,15 @@ case "$shard" in
       tests/test_hpo.py tests/test_pod_launch.py
     ;;
   parallel)
-    # SPMD, composed mesh, pipeline, multi-process rendezvous
-    python -m pytest -q tests/test_multiprocess.py tests/test_composite.py \
-      tests/test_pipeline_config.py tests/test_graph_parallel.py \
-      tests/test_pipeline.py
+    # SPMD, composed mesh, pipeline (1f1b/gpipe schedule equivalence,
+    # remat, pipe x data + ZeRO, knob resolution — docs/pipeline.md),
+    # multi-process rendezvous. Slow lane deselected here: the pipeline
+    # slow tests (BENCH_MFU subprocess smoke, 32-layer deep-stack train,
+    # SchNet/EF config trains) run in the nightly mfu-bench job — left
+    # in this per-push shard they blow its <10-min budget
+    python -m pytest -q -m "not slow" tests/test_multiprocess.py \
+      tests/test_composite.py tests/test_pipeline_config.py \
+      tests/test_graph_parallel.py tests/test_pipeline.py
     ;;
   robust)
     # infrastructure robustness: input pipeline, packing, serving engine,
