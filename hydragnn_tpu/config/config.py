@@ -108,9 +108,12 @@ def update_config(config: Dict[str, Any], train_data, val_data=None,
 
     sample0 = train_data[0]
     graph_size_variable = _graph_size_variable(train_data, val_data, test_data)
-    env = os.getenv("HYDRAGNN_USE_VARIABLE_GRAPH_SIZE")
-    if env is not None:
-        graph_size_variable = bool(int(env))
+    from ..utils.envflags import env_str, env_strict_flag
+    # unset OR empty keeps the data-derived value; only a non-empty
+    # (strictly parsed) value overrides it
+    if env_str("HYDRAGNN_USE_VARIABLE_GRAPH_SIZE") is not None:
+        graph_size_variable = env_strict_flag(
+            "HYDRAGNN_USE_VARIABLE_GRAPH_SIZE", graph_size_variable)
 
     nn = _update_config_NN_outputs(config, nn, sample0, graph_size_variable)
     arch = nn["Architecture"]
@@ -215,9 +218,11 @@ def _graph_size_variable(*datasets) -> bool:
 
 def _update_config_equivariance(arch):
     if arch.get("equivariance"):
-        assert arch["model_type"] in EQUIVARIANT_MODELS, (
-            "E(3) equivariance can only be ensured for "
-            + ", ".join(EQUIVARIANT_MODELS))
+        if arch["model_type"] not in EQUIVARIANT_MODELS:
+            raise ValueError(
+                "E(3) equivariance can only be ensured for "
+                + ", ".join(EQUIVARIANT_MODELS)
+                + f"; got model_type={arch['model_type']!r}")
     elif "equivariance" not in arch:
         arch["equivariance"] = False
     return arch
@@ -226,8 +231,11 @@ def _update_config_equivariance(arch):
 def _update_config_edge_dim(arch):
     arch["edge_dim"] = None
     if arch.get("edge_features"):
-        assert arch["model_type"] in EDGE_MODELS, (
-            "Edge features can only be used with " + ", ".join(EDGE_MODELS))
+        if arch["model_type"] not in EDGE_MODELS:
+            raise ValueError(
+                "Edge features can only be used with "
+                + ", ".join(EDGE_MODELS)
+                + f"; got model_type={arch['model_type']!r}")
         arch["edge_dim"] = len(arch["edge_features"])
     elif arch["model_type"] == "CGCNN":
         arch["edge_dim"] = 0

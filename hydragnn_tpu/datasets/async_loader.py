@@ -150,14 +150,17 @@ class BatchCache:
     (transforms run before insertion; placement copies to device)."""
 
     def __init__(self, max_bytes: int):
+        # max_bytes is immutable config; everything else is shared
+        # between collation workers and the consumer, so it is
+        # lock-guarded — machine-checked by hydralint lock-discipline
         self.max_bytes = max_bytes
-        self._data: "collections.OrderedDict[Tuple, Any]" = \
-            collections.OrderedDict()
-        self._sizes: Dict[Tuple, int] = {}
-        self.nbytes = 0
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
+        # key -> batch, LRU order
+        self._data = collections.OrderedDict()  # guarded-by: _lock
+        self._sizes: Dict[Tuple, int] = {}  # guarded-by: _lock
+        self.nbytes = 0  # guarded-by: _lock
+        self.hits = 0  # guarded-by: _lock
+        self.misses = 0  # guarded-by: _lock
+        self.evictions = 0  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def get(self, key: Tuple):
@@ -192,12 +195,17 @@ class BatchCache:
             self.nbytes = 0
 
     def __len__(self) -> int:
-        return len(self._data)
+        with self._lock:
+            return len(self._data)
 
     def stats(self) -> Dict[str, int]:
-        return {"entries": len(self._data), "nbytes": self.nbytes,
-                "hits": self.hits, "misses": self.misses,
-                "evictions": self.evictions}
+        # one atomic snapshot: entries/nbytes read outside the lock could
+        # disagree mid-eviction (the lock-discipline audit this class's
+        # annotations now enforce statically)
+        with self._lock:
+            return {"entries": len(self._data), "nbytes": self.nbytes,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions}
 
 
 def _loader_pool(loader, num_workers: int) -> ThreadPoolExecutor:

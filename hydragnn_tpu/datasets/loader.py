@@ -52,8 +52,10 @@ class GraphDataLoader:
         pack_rank: int = 0,
         pack_nproc: int = 1,
     ):
-        assert batch_size % num_shards == 0 or num_shards == 1, (
-            f"batch_size {batch_size} must divide evenly over {num_shards} shards")
+        if batch_size % num_shards != 0 and num_shards != 1:
+            raise ValueError(
+                f"batch_size {batch_size} must divide evenly over "
+                f"{num_shards} shards")
         self.dataset = dataset
         self.batch_size = batch_size
         self.num_shards = num_shards

@@ -68,7 +68,8 @@ class AbstractRawDataset(AbstractBaseDataset):
                 name for name in os.listdir(raw_path)
                 if os.path.isfile(os.path.join(raw_path, name))
                 and name != ".DS_Store")
-            assert filelist, f"No data files provided in {raw_path}!"
+            if not filelist:
+                raise ValueError(f"No data files provided in {raw_path}!")
             if dist:
                 # deterministic shuffle then per-process shard
                 # (reference: :158-176 — seed 43, nsplit over world)

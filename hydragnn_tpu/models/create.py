@@ -20,8 +20,9 @@ from .stacks import (CGCNNStack, GATStack, GINStack, MFCStack, PNAPlusStack,
 
 def _require(cfg: ModelConfig, *fields: str):
     for f in fields:
-        assert getattr(cfg, f) is not None, (
-            f"{cfg.model_type} requires architecture key '{f}'")
+        if getattr(cfg, f) is None:
+            raise ValueError(
+                f"{cfg.model_type} requires architecture key '{f}'")
 
 
 def model_class(model_type: str):

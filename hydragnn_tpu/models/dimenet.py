@@ -141,9 +141,10 @@ class DIMEStack(BaseStack):
 
     def conv_args(self, batch):
         """Edge rbf + triplet angles/sbf (reference: DIMEStack.py:135-169)."""
-        assert batch.idx_kj is not None, (
-            "DimeNet needs triplet indices; build loaders with "
-            "graphs.triplets.make_triplet_transform")
+        if batch.idx_kj is None:
+            raise ValueError(
+                "DimeNet needs triplet indices; build loaders with "
+                "graphs.triplets.make_triplet_transform")
         cfg = self.cfg
         vec, dist = edge_vectors(batch.pos, batch.senders, batch.receivers,
                                  batch.edge_shifts)

@@ -88,7 +88,10 @@ class MLPNode(nn.Module):
         dims = list(self.hidden_dims) + [self.output_dim]
         if self.node_type == "mlp":
             return MLP(dims, activation=self.activation)(x)
-        assert node_index_in_graph is not None
+        if node_index_in_graph is None:
+            raise ValueError(
+                f"node_type={self.node_type!r} heads need "
+                "node_index_in_graph (per-node positional weights)")
         idx = jnp.clip(node_index_in_graph, 0, self.num_nodes - 1)
         h = x
         in_dim = x.shape[-1]

@@ -47,7 +47,10 @@ def real_spherical_harmonics(vec, lmax: int, normalize: bool = True,
       * N_lm = sqrt((2l+1) (l-|m|)!/(l+|m|)!) * (sqrt2 for m != 0).
     Exactness against the l<=3 closed forms and the component norm at
     higher l are asserted in tests/test_irreps.py."""
-    assert lmax <= LMAX_SUPPORTED, f"lmax {lmax} > {LMAX_SUPPORTED}"
+    if lmax > LMAX_SUPPORTED:
+        raise ValueError(
+            f"lmax {lmax} > {LMAX_SUPPORTED}: spherical harmonics are "
+            f"implemented up to l={LMAX_SUPPORTED}")
     if normalize:
         r = jnp.sqrt(jnp.sum(vec * vec, axis=-1, keepdims=True) + eps)
         vec = vec / r

@@ -28,8 +28,10 @@ def assign_shards_to_datasets(sizes: Sequence[int], num_shards: int) -> List[int
     (reference: group sizing ∝ dataset size, examples/multidataset/train.py:
     process-group construction)."""
     n = len(sizes)
-    assert num_shards >= n, (
-        f"need at least one device shard per dataset ({n}), got {num_shards}")
+    if num_shards < n:
+        raise ValueError(
+            f"need at least one device shard per dataset ({n}), "
+            f"got {num_shards}")
     total = float(sum(sizes))
     raw = [s / total * num_shards for s in sizes]
     counts = [max(1, int(math.floor(r))) for r in raw]
@@ -70,7 +72,10 @@ class MultiDatasetLoader:
                  bucket: Optional[BucketSpec] = None,
                  packing: bool = False,
                  pack_lookahead: Optional[int] = None):
-        assert batch_size % num_shards == 0
+        if batch_size % num_shards != 0:
+            raise ValueError(
+                f"batch_size {batch_size} must divide evenly over "
+                f"{num_shards} shards")
         self.gps = batch_size // num_shards
         self.assignment = assign_shards_to_datasets(
             [len(d) for d in datasets], num_shards)

@@ -34,9 +34,11 @@ def unscale_features_by_num_nodes(datasets_list, scaled_index_list,
         scaled = list(dataset)
         for idx in scaled_index_list:
             head = np.asarray(scaled[idx], np.float64)
-            assert head.shape[0] == nodes.shape[0], (
-                "num-nodes unscaling applies to per-structure (graph) heads: "
-                f"head has {head.shape[0]} rows, {nodes.shape[0]} structures")
+            if head.shape[0] != nodes.shape[0]:
+                raise ValueError(
+                    "num-nodes unscaling applies to per-structure (graph) "
+                    f"heads: head has {head.shape[0]} rows, "
+                    f"{nodes.shape[0]} structures")
             head = head * nodes.reshape((-1,) + (1,) * (head.ndim - 1))
             scaled[idx] = head
         out.append(scaled)
@@ -51,8 +53,10 @@ def unscale_features_by_num_nodes_config(config, datasets_list,
     names = voi["output_names"]
     scaled_idx = [i for i, n in enumerate(names) if "_scaled_num_nodes" in n]
     if scaled_idx:
-        assert voi.get("denormalize_output"), (
-            "Cannot unscale features without 'denormalize_output'")
+        if not voi.get("denormalize_output"):
+            raise ValueError(
+                "Cannot unscale features without 'denormalize_output' — "
+                "set Variables_of_interest.denormalize_output: true")
         datasets_list = unscale_features_by_num_nodes(
             datasets_list, scaled_idx, nodes_num_list)
     return datasets_list

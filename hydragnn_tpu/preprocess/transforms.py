@@ -107,7 +107,10 @@ def build_graph_sample(
     max_nb = arch.get("max_neighbours")
     shifts = None
     if arch.get("periodic_boundary_conditions", False):
-        assert cell is not None, "PBC requires a cell"
+        if cell is None:
+            raise ValueError(
+                "periodic_boundary_conditions=true requires a cell "
+                "(3x3 lattice) on every sample")
         send, recv, shifts = radius_graph_pbc(pos, cell, radius,
                                               max_neighbours=max_nb)
     else:

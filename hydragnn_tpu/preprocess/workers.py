@@ -94,7 +94,8 @@ def parallel_map(fn: Callable, items: Sequence, workers: int = 0,
         return out
     from concurrent.futures import ProcessPoolExecutor
     methods = multiprocessing.get_all_start_methods()
-    method = (os.getenv("HYDRAGNN_PREPROC_START_METHOD") or "").strip()
+    from ..utils.envflags import env_str
+    method = env_str("HYDRAGNN_PREPROC_START_METHOD", "")
     if method and method not in methods:
         import logging
         logging.getLogger("hydragnn_tpu").warning(

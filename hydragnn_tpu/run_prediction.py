@@ -81,7 +81,10 @@ def run_prediction(config_or_path, datasets: Optional[Tuple] = None,
         template = TrainState.create(variables, tx)
         log_name = get_log_name_config(config)
         state = load_existing_model(template, log_name)
-        assert state is not None, f"no checkpoint found for run '{log_name}'"
+        if state is None:
+            raise FileNotFoundError(
+                f"no checkpoint found for run '{log_name}' — train first "
+                "or point Training.log_name at an existing run")
 
     from .serving.config import resolve_serving
     serving = resolve_serving(config)

@@ -55,8 +55,9 @@ def _load_datasets_from_config(config):
         # HYDRAGNN_GS_SHARD_ROOT is the same, resolved per process — the
         # gcloud --worker=all launch runs ONE identical command on every
         # worker, so the shard index must come from the runtime.
-        shard = os.environ.get("HYDRAGNN_GS_SHARD_DIR")
-        root = os.environ.get("HYDRAGNN_GS_SHARD_ROOT")
+        from .utils.envflags import env_str
+        shard = env_str("HYDRAGNN_GS_SHARD_DIR")
+        root = env_str("HYDRAGNN_GS_SHARD_ROOT")
         if not shard and root:
             shard = os.path.join(root,
                                  f"shard_{jax.process_index()}")
@@ -163,11 +164,12 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
     if is_multiprocess():
         from .parallel.multiprocess import (slice_by_process,
                                             sync_config_stats)
-        mp_data = os.environ.get(
-            "HYDRAGNN_MP_DATA",
-            "local" if (os.environ.get("HYDRAGNN_GS_SHARD_DIR")
-                        or os.environ.get("HYDRAGNN_GS_SHARD_ROOT"))
-            else "replicated")
+        from .utils.envflags import env_str
+        mp_data = env_str("HYDRAGNN_MP_DATA")
+        if mp_data is None:
+            mp_data = ("local" if (env_str("HYDRAGNN_GS_SHARD_DIR")
+                                   or env_str("HYDRAGNN_GS_SHARD_ROOT"))
+                       else "replicated")
         if packing:
             # the pack plan must be computed from the GLOBAL order before
             # any per-process slicing: every process keeps the full

@@ -282,33 +282,37 @@ class InferenceEngine:
 
             self._jit_forward = jax.jit(head_forward)
 
-        # per-bucket compile cache: bucket -> AOT-compiled executable
-        self._compiled = {}
-        self.compile_count = 0
+        # per-bucket compile cache: bucket -> AOT-compiled executable.
+        # The `# guarded-by: _lock` annotations are machine-checked by
+        # hydralint's lock-discipline rule: every lexical access outside
+        # a `with self._lock:` block (or __init__) fails the lint.
+        self._compiled = {}  # guarded-by: _lock
+        self.compile_count = 0  # guarded-by: _lock
         self._lock = threading.Lock()
 
         # dispatcher state + service statistics
         self._queue: "queue.Queue" = queue.Queue()
-        self._closed = False
-        self._fatal: Optional[BaseException] = None
-        self.requests_done = 0
-        self.batches_run = 0
-        self._occupancy_sum = 0.0
-        self._real_node_slots = 0
-        self._total_node_slots = 0
-        self._real_edge_slots = 0
-        self._total_edge_slots = 0
-        self.max_queue_depth = 0
-        self._latencies: List[float] = []
+        self._closed = False  # guarded-by: _lock
+        self._fatal: Optional[BaseException] = None  # guarded-by: _lock
+        self.requests_done = 0  # guarded-by: _lock
+        self.batches_run = 0  # guarded-by: _lock
+        self._occupancy_sum = 0.0  # guarded-by: _lock
+        self._real_node_slots = 0  # guarded-by: _lock
+        self._total_node_slots = 0  # guarded-by: _lock
+        self._real_edge_slots = 0  # guarded-by: _lock
+        self._total_edge_slots = 0  # guarded-by: _lock
+        self.max_queue_depth = 0  # guarded-by: _lock
+        self._latencies: List[float] = []  # guarded-by: _lock
         # circuit-breaker + failure accounting (all under self._lock)
-        self._breaker_state = "closed"     # closed | open | half_open
-        self._consec_failures = 0
-        self._open_until = 0.0             # time.monotonic() probe point
-        self.trip_count = 0
-        self.batch_failures = 0
-        self.deadline_expired = 0
-        self.queue_rejections = 0
-        self.circuit_rejections = 0
+        self._breaker_state = "closed"  # guarded-by: _lock — closed |
+        #                                 open | half_open
+        self._consec_failures = 0  # guarded-by: _lock
+        self._open_until = 0.0  # guarded-by: _lock — monotonic probe point
+        self.trip_count = 0  # guarded-by: _lock
+        self.batch_failures = 0  # guarded-by: _lock
+        self.deadline_expired = 0  # guarded-by: _lock
+        self.queue_rejections = 0  # guarded-by: _lock
+        self.circuit_rejections = 0  # guarded-by: _lock
         self._metrics_server = None
         self._dispatcher = threading.Thread(target=self._loop,
                                             name="serve-dispatch",
@@ -367,7 +371,12 @@ class InferenceEngine:
             if breaker == "open":
                 # all admission checks passed: this request IS the probe
                 self._breaker_state = "half_open"
-            self._queue.put(_Request(sample, fut, deadline_ms=deadline_ms))
+            # the queue is unbounded (admission bounding is the qsize
+            # check above), so this put never blocks — and it must stay
+            # under the lock so a request can never land behind the
+            # shutdown sentinel
+            self._queue.put(  # hydralint: disable=lock-discipline -- unbounded queue, put cannot block; ordering vs the shutdown sentinel needs the lock
+                _Request(sample, fut, deadline_ms=deadline_ms))
             depth = self._queue.qsize()
             if depth > self.max_queue_depth:
                 self.max_queue_depth = depth
@@ -456,7 +465,10 @@ class InferenceEngine:
             if self._closed and not self._dispatcher.is_alive():
                 return
             self._closed = True
-            self._queue.put(_SHUTDOWN)
+            # unbounded queue: never blocks; the sentinel must be
+            # enqueued under the same lock that flipped _closed so no
+            # submit can slip a request in behind it
+            self._queue.put(_SHUTDOWN)  # hydralint: disable=lock-discipline -- unbounded queue, put cannot block; sentinel order vs _closed needs the lock
         if wait:
             self._dispatcher.join()
 
@@ -706,7 +718,12 @@ class InferenceEngine:
             need_n = max(sum(r.n for r in sh) for sh in shards)
             need_e = max(sum(r.e for r in sh) for sh in shards)
             bucket = select_bucket(self.buckets, count, need_n, need_e)
-            assert bucket is not None, (count, need_n, need_e)
+            if bucket is None:
+                raise RuntimeError(
+                    "internal error: coalesced batch "
+                    f"({count} graphs, {need_n} nodes, {need_e} edges) "
+                    "fits no bucket — the coalescer's fill caps must "
+                    "bound every batch by the largest bucket")
             # request-lifecycle spans (docs/observability.md): queue-wait
             # per request (submit -> dispatch), then the batch's forward
             # and unpad stages, all carrying the bucket/parity
@@ -857,7 +874,11 @@ class InferenceEngine:
                 self._fatal = e
         finally:
             # drain everything still queued — a shutdown (or dispatcher
-            # crash) must never leave a caller's future hanging
+            # crash) must never leave a caller's future hanging. _fatal
+            # is snapshotted under the lock once: only this thread ever
+            # writes it, and the write (if any) happened above
+            with self._lock:
+                fatal = self._fatal
             while True:
                 try:
                     req = self._queue.get_nowait()
@@ -865,9 +886,9 @@ class InferenceEngine:
                     break
                 if req is _SHUTDOWN:
                     continue
-                if self._fatal is not None:
+                if fatal is not None:
                     if not req.future.done():
-                        req.future.set_exception(self._fatal)
+                        req.future.set_exception(fatal)
                 else:
                     shards, leftover = self._coalesce(req, wait=False)
                     self._execute(shards)

@@ -97,11 +97,15 @@ class MetricsRegistry:
     """Thread-safe metric store. Keys are (name, sorted label tuple)."""
 
     def __init__(self):
+        # all four stores are lock-guarded (hydralint lock-discipline
+        # checks the annotations: access only under `with self._lock:`
+        # or in a `# holds-lock:` helper)
         self._lock = threading.Lock()
-        self._kinds: Dict[str, str] = {}
-        self._help: Dict[str, str] = {}
-        self._values: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Any] = {}
-        self._events: List[Dict[str, Any]] = []
+        self._kinds: Dict[str, str] = {}  # guarded-by: _lock
+        self._help: Dict[str, str] = {}  # guarded-by: _lock
+        self._values: Dict[  # guarded-by: _lock
+            Tuple[str, Tuple[Tuple[str, str], ...]], Any] = {}
+        self._events: List[Dict[str, Any]] = []  # guarded-by: _lock
 
     # ------------------------------------------------------------ reporting
 
@@ -110,6 +114,9 @@ class MetricsRegistry:
         return (name, tuple(sorted((str(k), str(v))
                                    for k, v in labels.items())))
 
+    # only called from the report methods' critical sections; the
+    # annotation below is the machine-checked (hydralint) form of that
+    # holds-lock: _lock
     def _register(self, name: str, kind: str, help_text: str) -> None:
         have = self._kinds.get(name)
         if have is None:

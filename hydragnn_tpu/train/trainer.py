@@ -844,7 +844,8 @@ def _tensorboard_writer(run_dir: str):
     """TensorBoard scalars via torch (CPU build is baked in) — parity with
     reference SummaryWriter use (utils/model/model.py:82-88; rank-0 only,
     like the reference's get_summary_writer)."""
-    if os.getenv("HYDRAGNN_DISABLE_TB") or jax.process_index() != 0:
+    from ..utils.envflags import env_flag
+    if env_flag("HYDRAGNN_DISABLE_TB") or jax.process_index() != 0:
         return None
     try:
         from torch.utils.tensorboard import SummaryWriter

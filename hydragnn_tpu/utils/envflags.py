@@ -17,6 +17,29 @@ def env_flag(name: str, default: bool = False) -> bool:
     return val.strip().lower() not in _FALSY
 
 
+def env_is_set(name: str) -> bool:
+    """True when the variable is present in the environment at all —
+    even empty. For knobs where set-but-empty means "explicitly off"
+    (masking a config-level default) rather than "unset"
+    (HYDRAGNN_FAULT_PLAN= must disable a Training.fault_plan, not fall
+    back to it)."""
+    return os.getenv(name) is not None
+
+
+def env_str(name: str, default=None):
+    """String env knob: unset or whitespace-only -> `default`, otherwise
+    the stripped value. The sanctioned spelling for free-form string
+    knobs (paths, host:port addresses, plan specs) — hydralint's
+    loose-env-read rule requires every env read outside this module to go
+    through an envflags helper, and a free-form knob has no stricter
+    grammar to enforce than "non-empty"."""
+    val = os.getenv(name)
+    if val is None:
+        return default
+    val = val.strip()
+    return val if val else default
+
+
 _TRUTHY_STRICT = ("1", "true", "on")
 
 

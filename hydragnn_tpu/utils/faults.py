@@ -136,10 +136,12 @@ def resolve_fault_plan(train_cfg=None) -> Optional[FaultPlan]:
     """HYDRAGNN_FAULT_PLAN env over Training.fault_plan; None when neither
     is set. Strict: a malformed spec warns and yields None — a typo plan
     must degrade to no injection, never a surprise one."""
-    import os
-    spec = os.getenv("HYDRAGNN_FAULT_PLAN")
+    from .envflags import env_is_set, env_str
+    spec = env_str("HYDRAGNN_FAULT_PLAN")
     origin = "HYDRAGNN_FAULT_PLAN"
-    if spec is None and train_cfg:
+    # a SET-but-empty env is "explicitly no plan" and must mask a
+    # config-level plan, not fall back to it
+    if spec is None and not env_is_set("HYDRAGNN_FAULT_PLAN") and train_cfg:
         spec = train_cfg.get("fault_plan")
         origin = "Training.fault_plan"
     if spec is None or not str(spec).strip():

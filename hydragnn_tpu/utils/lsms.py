@@ -39,7 +39,10 @@ def compute_formation_enthalpy(total_energy: float, types: np.ndarray,
     Returns (composition, linear_mixing_energy, formation_enthalpy, entropy).
     """
     elements = sorted(elements)
-    assert len(elements) == 2, "binary alloys only (as in the reference)"
+    if len(elements) != 2:
+        raise ValueError(
+            f"binary alloys only (as in the reference); got "
+            f"{len(elements)} elements: {elements}")
     n = len(types)
     n0 = int(np.sum(types == elements[0]))
     composition = n0 / n

@@ -207,5 +207,6 @@ def test_unscale_features_by_num_nodes():
     np.testing.assert_array_equal(np.asarray(t2[0])[:, 0], [2.0, 4.0, 8.0])
 
     cfg["NeuralNetwork"]["Variables_of_interest"]["denormalize_output"] = False
-    with pytest.raises(AssertionError):
+    # assert-in-library (hydralint): the guard raises ValueError now
+    with pytest.raises(ValueError):
         unscale_features_by_num_nodes_config(cfg, [[np.ones((3, 1))]], nodes)

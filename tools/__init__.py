@@ -1,0 +1,3 @@
+# tools/ as a package so `python -m tools.hydralint` (the static-analysis
+# suite) resolves from a repo-root checkout. The standalone scripts in this
+# directory still run as plain scripts (`python tools/<name>.py`).

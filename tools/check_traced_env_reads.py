@@ -1,116 +1,28 @@
 #!/usr/bin/env python
-"""Lint: traced model/step/ops modules must not read os.environ directly.
-
-An env read inside code that jax traces (model forward, loss/step bodies,
-ops/kernels) is resolved once at trace time and frozen into the compiled
-program — toggling the variable afterwards silently does nothing, and a
-loosely-parsed value can flip an experimental kernel on from a typo. This
-class of bug has now shipped twice (HYDRAGNN_PALLAS_NBR read at trace time
-in convs.py, r5 advisor; HYDRAGNN_USE_PALLAS loose-truthy in ops/segment.py,
-PR 3), so the rule is structural: env reads belong in utils/envflags.py
-helpers, resolved at construction time and passed in as plain values.
-
-Checked (AST, so comments/strings never trip it):
-* any `os.environ` attribute use (covers .get, [], `in`),
-* any `os.getenv(...)` call,
-* `from os import environ` / `from os import getenv`.
-
-Run: `python tools/check_traced_env_reads.py [repo_root]` — exits 1 and
-prints `file:line` for each violation. tests/test_env_lint.py runs the
-same check in tier-1, so a regression fails CI, not a code review.
-"""
+"""Delegating shim: the traced-env-read lint now lives in the hydralint
+engine (tools/hydralint/rules/traced_env.py, run repo-wide by
+`python -m tools.hydralint`). This entry point — and its
+find_env_reads / traced_module_paths / check unit API — is kept so the
+historical call sites (tests/test_env_lint.py, CI scripts, habit) keep
+working unchanged. See docs/static_analysis.md for the full rule
+catalog."""
 from __future__ import annotations
 
-import ast
 import os
 import sys
-from typing import List, Tuple
 
-# the traced surface: modules whose function bodies run under jax.jit /
-# grad tracing. Host-side drivers (trainer, loaders, run_*) legitimately
-# read env at startup and are NOT covered.
-TRACED_DIRS = (
-    os.path.join("hydragnn_tpu", "models"),
-    os.path.join("hydragnn_tpu", "ops"),
-    os.path.join("hydragnn_tpu", "kernels"),
-    # the telemetry layer is host-side, but its knobs gate producer call
-    # sites that run adjacent to (and inside wrappers around) traced
-    # code — every telemetry knob must resolve through
-    # utils/envflags.resolve_telemetry at construction time, never via a
-    # direct env read inside the subsystem (PR 7; same rule that keeps
-    # the kernels/precision modules honest)
-    os.path.join("hydragnn_tpu", "telemetry"),
-    # the parallel step/forward factories (pipeline, spmd, composite,
-    # graph_parallel) build traced bodies — the schedule/remat/shard
-    # knobs resolve via utils/envflags.resolve_pipeline at construction
-    # (PR 8); mesh.py is excluded below: its env reads are the multi-host
-    # rendezvous + SLURM walltime probes, host-side startup code that
-    # never runs under trace
-    os.path.join("hydragnn_tpu", "parallel"),
-)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-# host-side files inside an otherwise-traced directory; every entry must
-# carry a reason above/next to it
-EXCLUDED_FILES = (
-    os.path.join("hydragnn_tpu", "parallel", "mesh.py"),  # rendezvous/
-    # SLURM env parsing at process startup (init_distributed,
-    # walltime_deadline) — never traced
-)
-TRACED_FILES = (
-    os.path.join("hydragnn_tpu", "train", "train_step.py"),
-    os.path.join("hydragnn_tpu", "train", "loss.py"),
-    # the mixed-precision policy module: resolve_precision is called by
-    # step/engine factories whose results are baked into compiled
-    # programs — an env read here would be the same trace-time-frozen
-    # bug class, so it must go through utils/envflags like the kernels
-    os.path.join("hydragnn_tpu", "train", "precision.py"),
-)
-
-
-def find_env_reads(source: str, filename: str = "<str>"
-                   ) -> List[Tuple[str, int, str]]:
-    """(file, lineno, what) for every direct env read in `source`."""
-    out: List[Tuple[str, int, str]] = []
-    tree = ast.parse(source, filename=filename)
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.Attribute)
-                and isinstance(node.value, ast.Name)
-                and node.value.id == "os"
-                and node.attr in ("environ", "getenv")):
-            out.append((filename, node.lineno, f"os.{node.attr}"))
-        elif isinstance(node, ast.ImportFrom) and node.module == "os":
-            for alias in node.names:
-                if alias.name in ("environ", "getenv"):
-                    out.append((filename, node.lineno,
-                                f"from os import {alias.name}"))
-    return out
-
-
-def traced_module_paths(root: str) -> List[str]:
-    paths: List[str] = []
-    for d in TRACED_DIRS:
-        full = os.path.join(root, d)
-        for dirpath, _, names in os.walk(full):
-            paths.extend(os.path.join(dirpath, n) for n in sorted(names)
-                         if n.endswith(".py"))
-    paths.extend(os.path.join(root, f) for f in TRACED_FILES)
-    excluded = {os.path.join(root, f) for f in EXCLUDED_FILES}
-    return [p for p in paths if os.path.exists(p) and p not in excluded]
-
-
-def check(root: str) -> List[Tuple[str, int, str]]:
-    violations: List[Tuple[str, int, str]] = []
-    for path in traced_module_paths(root):
-        with open(path) as f:
-            rel = os.path.relpath(path, root)
-            violations.extend(find_env_reads(f.read(), rel))
-    return violations
+from tools.hydralint.rules.traced_env import (  # noqa: E402,F401
+    EXCLUDED_FILES, TRACED_DIRS, TRACED_FILES, check, find_env_reads,
+    traced_module_paths)
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    root = argv[0] if argv else os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))
+    root = argv[0] if argv else _REPO
     violations = check(root)
     for fname, line, what in violations:
         print(f"{fname}:{line}: {what} read inside a traced module — "

@@ -7,6 +7,13 @@ cd "$(dirname "$0")/.."
 
 shard="${1:?usage: ci_shards.sh core|data|train|parallel|robust|zoo|sweep}"
 
+# fail-fast contract lint before any shard spends minutes on tests:
+# hydralint is stdlib-only AST analysis (sub-second), so a traced env
+# read / bare assert / lock-discipline violation stops CI here with a
+# file:line instead of surfacing as a flaky behavioral failure later
+# (docs/static_analysis.md)
+python -m tools.hydralint
+
 case "$shard" in
   core)
     # ops, model zoo construction, kernels, symmetry, neighbor
@@ -45,14 +52,14 @@ case "$shard" in
     ;;
   robust)
     # infrastructure robustness: input pipeline, packing, serving engine,
-    # fault tolerance (kill/resume + serving failure semantics), env-read
-    # lint, telemetry (registry/spans//metrics endpoint), reference shims
-    # — files that grew after the original shard split and were
-    # previously in no shard
+    # fault tolerance (kill/resume + serving failure semantics), the
+    # hydralint suite + env-read shim, telemetry (registry/spans/
+    # /metrics endpoint), reference shims — files that grew after the
+    # original shard split and were previously in no shard
     python -m pytest -q tests/test_async_loader.py tests/test_packing.py \
       tests/test_serving.py tests/test_serving_faults.py \
-      tests/test_faults.py tests/test_env_lint.py tests/test_ref_shims.py \
-      tests/test_telemetry.py
+      tests/test_faults.py tests/test_env_lint.py tests/test_lint.py \
+      tests/test_ref_shims.py tests/test_telemetry.py
     ;;
   zoo)
     # the 13-model accuracy battery (per-model thresholds)

@@ -1,0 +1,12 @@
+"""hydralint — the repo's contract-enforcing static analysis suite.
+
+`python -m tools.hydralint` runs every rule over hydragnn_tpu/ and exits
+nonzero on findings; see docs/static_analysis.md for the rule catalog,
+suppression grammar, and baseline workflow."""
+from .engine import (Finding, Rule, all_rules, iter_python_files,
+                     load_baseline, new_findings, parse_suppressions,
+                     run_lint, write_baseline)
+
+__all__ = ["Finding", "Rule", "all_rules", "iter_python_files",
+           "load_baseline", "new_findings", "parse_suppressions",
+           "run_lint", "write_baseline"]

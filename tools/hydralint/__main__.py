@@ -1,0 +1,89 @@
+"""CLI: `python -m tools.hydralint [root] [options]`.
+
+Exit 0 when the tree is clean (or, with --baseline, when every finding
+is already recorded in the snapshot); exit 1 otherwise. `--json` emits
+the findings document CI uploads as an artifact."""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .engine import (all_rules, load_baseline, new_findings, run_lint,
+                     write_baseline)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.hydralint",
+        description="contract-enforcing static analysis "
+                    "(docs/static_analysis.md)")
+    parser.add_argument("root", nargs="?", default=None,
+                        help="repo root (default: the checkout this "
+                             "module lives in)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated subset of rules to run")
+    parser.add_argument("--json", action="store_true",
+                        help="emit a JSON findings document on stdout")
+    parser.add_argument("--baseline", default=None, metavar="PATH",
+                        help="only findings NOT in this snapshot fail")
+    parser.add_argument("--write-baseline", default=None, metavar="PATH",
+                        help="snapshot current findings as known debt "
+                             "and exit 0")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the active rule names and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(rule.name)
+        return 0
+
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    rule_names = ([r.strip() for r in args.rules.split(",") if r.strip()]
+                  if args.rules else None)
+    # OSError/ValueError covers every input-error path — bad root or
+    # empty walk, unknown rule, missing/unwritable baseline path, and
+    # corrupt or version-mismatched baseline JSON (JSONDecodeError is a
+    # ValueError) — so they all get the `error: ... exit 2` contract
+    # instead of a traceback
+    try:
+        findings = run_lint(root, rule_names=rule_names)
+        if args.write_baseline:
+            n = write_baseline(findings, args.write_baseline)
+            print(f"wrote baseline with {n} finding(s) to "
+                  f"{args.write_baseline}")
+            return 0
+        failing = findings
+        if args.baseline:
+            failing = new_findings(findings, load_baseline(args.baseline))
+    except (OSError, ValueError) as exc:
+        print(f"hydralint: error: {exc}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        doc = {"root": os.path.abspath(root),
+               "rules": rule_names or [r.name for r in all_rules()],
+               "findings": [f.to_json() for f in findings],
+               "baseline": args.baseline,
+               "new_findings": [f.to_json() for f in failing]}
+        print(json.dumps(doc, indent=1, sort_keys=True))
+    else:
+        for f in failing:
+            print(f.render())
+        if failing:
+            known = len(findings) - len(failing)
+            extra = f" ({known} baselined)" if args.baseline else ""
+            print(f"hydralint: {len(failing)} finding(s){extra}")
+        else:
+            nrules = len(rule_names or all_rules())
+            suffix = (f" ({len(findings)} baselined)"
+                      if args.baseline and findings else "")
+            print(f"ok: hydralint clean under {nrules} rule(s){suffix}")
+    return 1 if failing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

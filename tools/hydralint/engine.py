@@ -1,0 +1,192 @@
+"""hydralint core: shared file walker, suppression grammar, baseline mode.
+
+The engine owns everything rule-independent (docs/static_analysis.md):
+
+* the **walk** — every ``.py`` file under ``hydragnn_tpu/`` in sorted
+  order (the determinism discipline the lint itself enforces), parsed
+  once per file; each rule sees only the files its ``applies()`` scope
+  admits;
+* **suppressions** — ``# hydralint: disable=<rule>[,<rule>] -- <reason>``
+  on the finding's line silences exactly those rules there. The reason is
+  part of the grammar: a bare disable (no ``-- reason``) is itself
+  reported as a ``bad-suppression`` finding, so debt can never be hidden
+  without leaving a written justification in the diff;
+* **output** — ``file:line: rule: message`` lines for humans, a JSON
+  findings document (``--json``) for CI artifacts;
+* **baseline mode** — a findings snapshot keyed by (file, rule, message)
+  as a multiset, so a new rule can land with its known debt recorded
+  (``--write-baseline``) while any NEW finding against that snapshot
+  still fails (``--baseline``). Line numbers are deliberately not part
+  of the key — unrelated edits shift lines.
+"""
+from __future__ import annotations
+
+import ast
+import collections
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+BAD_SUPPRESSION = "bad-suppression"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violation. `file` is repo-relative with '/' separators."""
+    file: str
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: {self.rule}: {self.message}"
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: line numbers excluded (edits shift them)."""
+        return (self.file, self.rule, self.message)
+
+    def to_json(self) -> Dict[str, object]:
+        return {"file": self.file, "line": self.line, "rule": self.rule,
+                "message": self.message}
+
+
+class Rule:
+    """A lint rule: a name, a file scope, and a per-file check."""
+
+    name: str = ""
+
+    def applies(self, relpath: str) -> bool:
+        raise NotImplementedError
+
+    def check(self, tree: ast.AST, source: str,
+              relpath: str) -> List[Finding]:
+        raise NotImplementedError
+
+
+# `-- reason` is required; group(2) empty/absent marks a bare disable
+_SUPPRESS_RE = re.compile(
+    r"#\s*hydralint:\s*disable=([A-Za-z0-9_,\-]+)"
+    r"(?:\s*--\s*(\S.*?))?\s*$")
+
+
+def parse_suppressions(source: str, relpath: str
+                       ) -> Tuple[Dict[int, Set[str]], List[Finding]]:
+    """(line -> suppressed rule names, bad-suppression findings).
+
+    A suppression silences findings anchored to ITS OWN line (for a
+    multi-line statement that is the statement's first line). A disable
+    without a reason suppresses nothing and is itself a finding."""
+    suppressed: Dict[int, Set[str]] = {}
+    bad: List[Finding] = []
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m is None:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        reason = (m.group(2) or "").strip()
+        if not reason:
+            bad.append(Finding(
+                relpath, lineno, BAD_SUPPRESSION,
+                "suppression without a reason — write "
+                "`# hydralint: disable=<rule> -- <why this is safe>`"))
+            continue
+        suppressed.setdefault(lineno, set()).update(rules)
+    return suppressed, bad
+
+
+def iter_python_files(root: str) -> List[str]:
+    """Every library .py under hydragnn_tpu/, sorted — the lint surface."""
+    out: List[str] = []
+    pkg = os.path.join(root, "hydragnn_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        out.extend(os.path.join(dirpath, n) for n in sorted(filenames)
+                   if n.endswith(".py"))
+    return out
+
+
+def _relpath(path: str, root: str) -> str:
+    return os.path.relpath(path, root).replace(os.sep, "/")
+
+
+def all_rules() -> List[Rule]:
+    from .rules import ALL_RULES
+    return [cls() for cls in ALL_RULES]
+
+
+def run_lint(root: str,
+             rule_names: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the (selected) rules over the tree; returns sorted findings
+    with reasoned suppressions applied and bad suppressions reported."""
+    rules = all_rules()
+    if rule_names is not None:
+        known = {r.name for r in rules}
+        unknown = sorted(set(rule_names) - known)
+        if unknown:
+            raise ValueError(
+                f"unknown rule(s) {unknown}; available: {sorted(known)}")
+        rules = [r for r in rules if r.name in set(rule_names)]
+    files = iter_python_files(root)
+    if not files:
+        raise FileNotFoundError(
+            f"no Python files under {os.path.join(root, 'hydragnn_tpu')} "
+            "— wrong root? hydralint lints the hydragnn_tpu/ package, "
+            "and an empty walk must never pass as a clean tree")
+    findings: List[Finding] = []
+    for path in files:
+        rel = _relpath(path, root)
+        active = [r for r in rules if r.applies(rel)]
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        suppressed, bad = parse_suppressions(source, rel)
+        findings.extend(bad)
+        if not active:
+            continue
+        tree = ast.parse(source, filename=rel)
+        for rule in active:
+            for fd in rule.check(tree, source, rel):
+                if rule.name not in suppressed.get(fd.line, set()):
+                    findings.append(fd)
+    findings.sort(key=lambda f: (f.file, f.line, f.rule, f.message))
+    return findings
+
+
+# ----------------------------------------------------------------- baseline --
+
+BASELINE_VERSION = 1
+
+
+def write_baseline(findings: Sequence[Finding], path: str) -> int:
+    """Snapshot current findings as known debt; returns the count."""
+    doc = {"version": BASELINE_VERSION,
+           "findings": [f.to_json() for f in findings]}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return len(findings)
+
+
+def load_baseline(path: str) -> "collections.Counter":
+    """Multiset of baseline keys (file, rule, message)."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if doc.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path}: unsupported version {doc.get('version')!r}")
+    return collections.Counter(
+        (e["file"], e["rule"], e["message"]) for e in doc["findings"])
+
+
+def new_findings(findings: Sequence[Finding],
+                 baseline: "collections.Counter") -> List[Finding]:
+    """Findings beyond the baseline multiset — the i-th duplicate of a
+    key is new once the baseline recorded fewer than i of it."""
+    seen: collections.Counter = collections.Counter()
+    out: List[Finding] = []
+    for f in findings:
+        seen[f.key()] += 1
+        if seen[f.key()] > baseline.get(f.key(), 0):
+            out.append(f)
+    return out

@@ -1,0 +1,17 @@
+"""Rule registry: one module per rule, each grounded in a shipped bug
+class (docs/static_analysis.md carries the provenance table). Adding a
+rule = a module with a `find_*` unit API + a Rule subclass, an entry
+here, a fixture test in tests/test_lint.py, and a catalog row."""
+from .asserts import AssertInLibraryRule
+from .determinism import NondeterministicOrderRule
+from .locks import LockDisciplineRule
+from .loose_env import LooseEnvReadRule
+from .traced_env import TracedEnvReadRule
+
+ALL_RULES = (
+    TracedEnvReadRule,
+    LooseEnvReadRule,
+    AssertInLibraryRule,
+    NondeterministicOrderRule,
+    LockDisciplineRule,
+)

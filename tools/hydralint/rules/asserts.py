@@ -1,0 +1,52 @@
+"""assert-in-library: no bare `assert` statements in library code.
+
+`assert` vanishes under ``python -O`` (a serving deployment running
+optimized bytecode loses the check entirely) and raises a bare
+AssertionError that tells an operator nothing actionable. The PR 8
+review converted the pipeline modules' asserts to ValueError with real
+messages; this rule finishes the job repo-wide and keeps it finished:
+user-input/config validation raises ValueError, internal invariants
+raise RuntimeError, both with messages that say what to fix.
+
+Scope: every module under ``hydragnn_tpu/`` (tests live outside the
+package and keep their pytest asserts).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Tuple
+
+from ..engine import Finding, Rule
+
+MESSAGE = ("bare `assert` in library code — it vanishes under `python -O`"
+           "; raise ValueError (bad input/config) or RuntimeError "
+           "(broken internal invariant) with an actionable message")
+
+
+def find_asserts(source: str, filename: str = "<str>", tree=None
+                 ) -> List[Tuple[str, int, str]]:
+    """(file, lineno, condition-source) for every assert statement."""
+    out: List[Tuple[str, int, str]] = []
+    if tree is None:
+        tree = ast.parse(source, filename=filename)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assert):
+            try:
+                cond = ast.unparse(node.test)
+            except Exception:  # pragma: no cover - unparse is total in 3.9+
+                cond = "<condition>"
+            out.append((filename, node.lineno, cond))
+    return out
+
+
+class AssertInLibraryRule(Rule):
+    name = "assert-in-library"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("hydragnn_tpu/")
+
+    def check(self, tree: ast.AST, source: str,
+              relpath: str) -> List[Finding]:
+        return [Finding(relpath, line, self.name, MESSAGE)
+                for _, line, _cond in find_asserts(source, relpath,
+                                                   tree=tree)]

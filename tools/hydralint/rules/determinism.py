@@ -1,0 +1,135 @@
+"""nondeterministic-order: no order-sensitive iteration over unordered
+sources in the bitwise-contract surface.
+
+The pack-plan (PR 2), edge-order (PR 5), and resume (PR 4/8) contracts
+all promise bitwise-identical results for identical inputs — promises a
+single `for x in some_set:` or an unsorted `os.listdir` quietly breaks:
+set iteration order follows the per-process hash seed, and directory
+order follows the filesystem. Both are exactly the hazards the PR 5
+neighbor total-order and PR 2 global pack plan were built to shut out.
+
+Checked, in ``graphs/``, ``preprocess/``, ``datasets/``, ``parallel/``:
+
+* a set expression (literal ``{...}``, ``set(...)``/``frozenset(...)``,
+  set comprehension) used as the iterable of a ``for`` loop or a
+  comprehension, or materialized via ``list()``/``tuple()``/
+  ``enumerate()`` — membership tests stay free;
+* ``os.listdir``/``os.scandir``/``glob.glob``/``glob.iglob``/
+  ``Path.iterdir``/``Path.glob``/``Path.rglob`` results not wrapped
+  (anywhere up the expression) in ``sorted(...)``.
+
+``sorted(set(...))`` and ``sorted(glob.glob(...))`` are the sanctioned
+spellings and pass.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from ..engine import Finding, Rule
+
+SCOPE_DIRS = ("hydragnn_tpu/graphs/", "hydragnn_tpu/preprocess/",
+              "hydragnn_tpu/datasets/", "hydragnn_tpu/parallel/")
+
+_FS_OS = ("listdir", "scandir")
+_FS_GLOB = ("glob", "iglob")
+_ORDERING_CALLS = ("list", "tuple", "enumerate")
+
+SET_MESSAGE = ("iteration over a set — order follows the per-process "
+               "hash seed and breaks the bitwise pack/resume contracts; "
+               "iterate `sorted(...)` or keep a list/dict")
+FS_MESSAGE = ("result used without sorted() — filesystem order is "
+              "platform/fs-state dependent and breaks the bitwise "
+              "pack/resume contracts")
+
+
+def _parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    return (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id in ("set", "frozenset"))
+
+
+def _fs_call_name(node: ast.AST) -> str:
+    """'os.listdir' / 'glob.glob' / '.iterdir' / '.glob' when `node` is
+    an order-unstable filesystem enumeration call, else ''."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)):
+        return ""
+    func = node.func
+    recv = func.value
+    if isinstance(recv, ast.Name):
+        if recv.id == "os" and func.attr in _FS_OS:
+            return f"os.{func.attr}"
+        if recv.id == "glob" and func.attr in _FS_GLOB:
+            return f"glob.{func.attr}"
+    # pathlib spellings on any receiver — Path.glob/rglob promise NO
+    # particular order (and Path.iterdir follows the fs), so the common
+    # `for f in Path(d).glob("*.pkl")` is the same hazard as os.listdir
+    if func.attr in ("iterdir", "rglob", "glob"):
+        return f".{func.attr}"
+    return ""
+
+
+def _wrapped_in_sorted(node: ast.AST,
+                       parents: Dict[ast.AST, ast.AST]) -> bool:
+    """True when some ancestor expression (up to the enclosing statement)
+    is a sorted(...) call — covers sorted(glob.glob(...)) and
+    sorted(n for n in os.listdir(...))."""
+    cur = parents.get(node)
+    while cur is not None and not isinstance(cur, ast.stmt):
+        if (isinstance(cur, ast.Call) and isinstance(cur.func, ast.Name)
+                and cur.func.id == "sorted"):
+            return True
+        cur = parents.get(cur)
+    return False
+
+
+def find_unsorted_iteration(source: str, filename: str = "<str>", tree=None
+                            ) -> List[Tuple[str, int, str]]:
+    """(file, lineno, message) for each ordering hazard in `source`."""
+    if tree is None:
+        tree = ast.parse(source, filename=filename)
+    parents = _parent_map(tree)
+    out: List[Tuple[str, int, str]] = []
+
+    def flag_set(expr: ast.AST) -> None:
+        if _is_set_expr(expr) and not _wrapped_in_sorted(expr, parents):
+            out.append((filename, expr.lineno, SET_MESSAGE))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            flag_set(node.iter)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in node.generators:
+                flag_set(gen.iter)
+        elif (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id in _ORDERING_CALLS and node.args):
+            flag_set(node.args[0])
+        fs = _fs_call_name(node)
+        if fs and not _wrapped_in_sorted(node, parents):
+            out.append((filename, node.lineno, f"{fs}() {FS_MESSAGE}"))
+    out.sort(key=lambda t: t[1])
+    return out
+
+
+class NondeterministicOrderRule(Rule):
+    name = "nondeterministic-order"
+
+    def applies(self, relpath: str) -> bool:
+        return any(relpath.startswith(d) for d in SCOPE_DIRS)
+
+    def check(self, tree: ast.AST, source: str,
+              relpath: str) -> List[Finding]:
+        return [Finding(relpath, line, self.name, msg)
+                for _, line, msg in find_unsorted_iteration(source, relpath,
+                                                            tree=tree)]

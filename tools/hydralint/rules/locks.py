@@ -1,0 +1,221 @@
+"""lock-discipline: annotated lock-guarded state, statically checked.
+
+The PR-4 serving failure semantics and the PR-7 stats/scrape contract
+were each hand-audited across multiple review rounds for the same two
+defect shapes: (1) a counter documented as "under self._lock" read or
+written outside it, and (2) a blocking call (queue wait, Future.result,
+sleep, socket I/O) sitting inside a critical section where it stalls
+every other thread — the exact stall `InferenceEngine.stats()` was
+restructured to avoid (percentile math moved outside the lock). This
+rule turns both audits into structure:
+
+* an ``__init__`` assignment carrying ``# guarded-by: _lock`` declares
+  that attribute lock-guarded: every other lexical ``self.<attr>``
+  read/write in the class must sit inside a ``with self._lock:`` block,
+  in ``__init__`` itself (construction precedes sharing), or in a method
+  annotated ``# holds-lock: _lock`` (a private helper documented+checked
+  as only called with the lock held);
+* inside ANY ``with <lock>:`` body (context manager whose name contains
+  "lock"), known-blocking calls are violations: blocking
+  ``queue.Queue.get/put`` (``block=False`` and the ``*_nowait`` forms
+  pass), ``Future.result``, ``time.sleep``, ``join`` on thread-named
+  receivers (dispatcher/worker/pool/... — str.join and os.path.join
+  must not false-positive a CI gate), and socket/HTTP sends.
+
+The check is lexical by design — it cannot see a lock held by a caller,
+which is what the ``holds-lock`` annotation documents. Scope:
+serving/engine.py, datasets/async_loader.py, telemetry/registry.py (the
+three concurrent subsystems with audited locking contracts).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from ..engine import Finding, Rule
+
+SCOPE_FILES = (
+    "hydragnn_tpu/serving/engine.py",
+    "hydragnn_tpu/datasets/async_loader.py",
+    "hydragnn_tpu/telemetry/registry.py",
+)
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_HOLDS_RE = re.compile(r"#\s*holds-lock:\s*([A-Za-z_][A-Za-z0-9_]*)")
+# `.join()` receivers that mean a thread/worker wait, not str.join
+_THREADISH_RE = re.compile(
+    r"thread|proc|worker|dispatch|producer|consumer|pool", re.IGNORECASE)
+
+
+def _lockish_name(expr: ast.AST) -> Optional[str]:
+    """Name of a lock being entered: `self._lock` -> '_lock',
+    `_GLOBAL_LOCK` -> '_GLOBAL_LOCK'; None for non-lock contexts."""
+    if (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self" and "lock" in expr.attr.lower()):
+        return expr.attr
+    if isinstance(expr, ast.Name) and "lock" in expr.id.lower():
+        return expr.id
+    return None
+
+
+def _receiver_name(expr: ast.AST) -> str:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return ""
+
+
+def _blocking_call(node: ast.Call) -> Optional[str]:
+    """Short description when `node` is a known-blocking call."""
+    func = node.func
+    if isinstance(func, ast.Name):
+        return (f"{func.id}()" if func.id in ("sleep", "urlopen") else None)
+    if not isinstance(func, ast.Attribute):
+        return None
+    name = func.attr
+    recv = func.value
+    recv_name = _receiver_name(recv)
+    if name == "sleep":
+        return "sleep()"
+    if name == "result":
+        return ".result() (Future wait)"
+    if name in ("get", "put") and (
+            "queue" in recv_name.lower() or recv_name == "q"):
+        if (node.args and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value is False):
+            return None  # q.get(False) is the non-blocking form
+        for kw in node.keywords:
+            if (kw.arg == "block" and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is False):
+                return None
+        return f"{recv_name}.{name}() (blocking queue op)"
+    if name == "join" and _THREADISH_RE.search(recv_name):
+        # receiver named like a thread/worker — str.join (separator
+        # literals, sep variables, os.path.join) must not false-positive
+        # a CI gate, so only thread-suggestive receivers count
+        return ".join() (thread wait)"
+    if name in ("sendall", "recv", "urlopen", "getresponse"):
+        return f".{name}() (socket/HTTP I/O)"
+    return None
+
+
+def _guarded_attrs(cls: ast.ClassDef, lines: List[str]) -> Dict[str, str]:
+    """{attr: lock} from `# guarded-by:` comments on __init__ lines."""
+    guarded: Dict[str, str] = {}
+    init = next((n for n in cls.body if isinstance(n, ast.FunctionDef)
+                 and n.name == "__init__"), None)
+    if init is None:
+        return guarded
+    for node in ast.walk(init):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        if node.lineno > len(lines):
+            continue
+        m = _GUARDED_RE.search(lines[node.lineno - 1])
+        if m is None:
+            continue
+        for t in targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                guarded[t.attr] = m.group(1)
+    return guarded
+
+
+def _holds_locks(func: ast.FunctionDef, lines: List[str]
+                 ) -> FrozenSet[str]:
+    """Locks a `# holds-lock:` annotation (def line or the line above)
+    declares held for the whole method body."""
+    held = set()
+    for idx in (func.lineno - 1, func.lineno - 2):
+        if 0 <= idx < len(lines):
+            m = _HOLDS_RE.search(lines[idx])
+            if m:
+                held.add(m.group(1))
+    return frozenset(held)
+
+
+def find_lock_violations(source: str, filename: str = "<str>", tree=None
+                         ) -> List[Tuple[str, int, str]]:
+    """(file, lineno, message) for every lock-discipline violation."""
+    lines = source.splitlines()
+    if tree is None:
+        tree = ast.parse(source, filename=filename)
+    out: List[Tuple[str, int, str]] = []
+
+    def scan(node: ast.AST, guarded: Dict[str, str],
+             held: FrozenSet[str], exempt: bool) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = set()
+            for item in node.items:
+                scan(item.context_expr, guarded, held, exempt)
+                lock = _lockish_name(item.context_expr)
+                if lock is not None:
+                    acquired.add(lock)
+            inner = frozenset(held | acquired)
+            for child in node.body:
+                scan(child, guarded, inner, exempt)
+            return
+        if isinstance(node, ast.Attribute):
+            if (isinstance(node.value, ast.Name) and node.value.id == "self"
+                    and node.attr in guarded and not exempt
+                    and guarded[node.attr] not in held):
+                out.append((filename, node.lineno,
+                            f"self.{node.attr} (guarded-by "
+                            f"{guarded[node.attr]}) accessed outside `with "
+                            f"self.{guarded[node.attr]}:` — take the lock, "
+                            "or annotate the only-called-locked helper "
+                            "with `# holds-lock:`"))
+        elif isinstance(node, ast.Call) and held:
+            desc = _blocking_call(node)
+            if desc is not None:
+                out.append((filename, node.lineno,
+                            f"{desc} inside a `with "
+                            f"{'/'.join(sorted(held))}:` body — a blocking "
+                            "call under a lock stalls every other thread; "
+                            "move it outside the critical section"))
+        for child in ast.iter_child_nodes(node):
+            scan(child, guarded, held, exempt)
+
+    def scan_function(func: ast.FunctionDef,
+                      guarded: Dict[str, str]) -> None:
+        held = _holds_locks(func, lines)
+        exempt = func.name == "__init__"
+        for child in func.body:
+            scan(child, guarded, held, exempt)
+
+    for stmt in tree.body:
+        if isinstance(stmt, ast.ClassDef):
+            guarded = _guarded_attrs(stmt, lines)
+            for item in stmt.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    scan_function(item, guarded)
+                else:
+                    scan(item, guarded, frozenset(), False)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan_function(stmt, {})
+        else:
+            scan(stmt, {}, frozenset(), False)
+    out.sort(key=lambda t: t[1])
+    return out
+
+
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath in SCOPE_FILES
+
+    def check(self, tree: ast.AST, source: str,
+              relpath: str) -> List[Finding]:
+        return [Finding(relpath, line, self.name, msg)
+                for _, line, msg in find_lock_violations(source, relpath,
+                                                         tree=tree)]

@@ -1,0 +1,65 @@
+"""loose-env-read: every env read goes through utils/envflags helpers.
+
+The HYDRAGNN_PALLAS_NBR lesson, generalized from the traced surface to
+the whole library: a raw ``os.environ``/``os.getenv`` read means ad-hoc
+parsing, and ad-hoc parsing is how a typo value silently enables an
+experimental path (`bool(int(env))` crashing on "true", any-non-empty
+truthiness enabling a kernel). utils/envflags.py is the one place that
+knows the strict grammar (env_strict_flag / env_strict_choice /
+env_strict_int / env_str), warns on unrecognized values, and falls back
+to the default instead of letting the typo take effect.
+
+Scope: all of ``hydragnn_tpu/`` except envflags itself and a short,
+reason-documented host-side allowlist — modules whose env access is
+process-bootstrap plumbing (rendezvous addresses, SLURM probes, XLA_FLAGS
+read-modify-write, child-process env construction), not flag parsing.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..engine import Finding, Rule
+from .traced_env import find_env_reads
+
+# relpath -> why raw env access is legitimate there. Additions need the
+# same kind of reason — "it was easier" is not one.
+ALLOWLIST = {
+    # the strict-parsing layer itself: the helpers this rule points at
+    "hydragnn_tpu/utils/envflags.py":
+        "the envflags helpers are the one sanctioned env-read site",
+    # multi-host rendezvous (HYDRAGNN_MASTER_ADDR/PORT, SLURM_NPROCS/
+    # PROCID) + walltime probes at process startup — addresses and
+    # scheduler facts, not feature flags
+    "hydragnn_tpu/parallel/mesh.py":
+        "host-side rendezvous/SLURM bootstrap reads",
+    # XLA_FLAGS read-modify-write + device env probes BEFORE jax
+    # initializes — must happen at import/startup, and the writes are the
+    # point
+    "hydragnn_tpu/utils/devices.py":
+        "XLA_FLAGS read-modify-write before jax init",
+    # SLURM nodelist probe + `dict(os.environ, **overrides)` when
+    # building child-trial environments — constructing an env, not
+    # parsing flags
+    "hydragnn_tpu/utils/hpo.py":
+        "SLURM probe + child-process env construction",
+}
+
+MESSAGE = ("env read outside utils/envflags.py — parse via an envflags "
+           "strict helper (env_str / env_strict_flag / env_strict_choice "
+           "/ env_strict_int) so a typo value warns instead of taking "
+           "effect")
+
+
+class LooseEnvReadRule(Rule):
+    name = "loose-env-read"
+
+    def applies(self, relpath: str) -> bool:
+        return (relpath.startswith("hydragnn_tpu/")
+                and relpath not in ALLOWLIST)
+
+    def check(self, tree: ast.AST, source: str,
+              relpath: str) -> List[Finding]:
+        return [Finding(relpath, line, self.name, f"{what}: {MESSAGE}")
+                for _, line, what in find_env_reads(source, relpath,
+                                                    tree=tree)]
