@@ -144,6 +144,36 @@ Env knobs:
                        invariant — the deep-stack demonstration shape)
   BENCH_MFU_OUT        also write the MFU JSON to this path (the
                        nightly mfu-bench emits BENCH_MFU.json)
+  BENCH_MD             =1: MD-in-the-loop serving mode (docs/serving.md
+                       raw-structure section, ROADMAP item 3) — a
+                       closed-loop velocity-Verlet LJ trajectory with
+                       energy+forces served by the EF engine, run three
+                       times from identical initial conditions with the
+                       three neighbor strategies (incremental
+                       Verlet-skin session / rebuild-every-step /
+                       offline prebuilt submit): steps/s, rebuild
+                       fraction, graph-build vs forward time split, the
+                       trajectories adjudicated bitwise-identical, the
+                       incremental edges adjudicated bitwise against
+                       fresh radius_graph_pbc builds at every recorded
+                       step, and the prebuilt-graph submit() bitwise
+                       same-bucket parity re-checked. All BENCH_MD_*
+                       values parse via the utils/envflags strict
+                       helpers — a typo warns and keeps the default.
+  BENCH_MD_ATOMS / BENCH_MD_STEPS / BENCH_MD_HIDDEN
+                       MD-mode scale (default 1728 atoms — rounded to a
+                       cube — / 120 steps / hidden 4); atom count and
+                       cutoff size the neighbor-build load, hidden the
+                       forward
+  BENCH_MD_SKIN / BENCH_MD_DT / BENCH_MD_TEMP /
+  BENCH_MD_RADIUS / BENCH_MD_LATTICE / BENCH_MD_CAP
+                       trajectory physics (default skin 0.3 / dt 0.004 /
+                       T 0.3 / cutoff 5.0 / lattice 1.0 / neighbor cap
+                       12, <=0 = uncapped — the MLIP shape: enumeration
+                       at full density, forward on cap*N edges): skin
+                       vs per-step drift sets the rebuild fraction
+  BENCH_MD_OUT         also write the MD JSON to this path (the nightly
+                       md-bench emits BENCH_MD.json)
 """
 import itertools
 import json
@@ -755,6 +785,170 @@ def run_bench_serve(backend=None):
         },
     }
     out_path = os.environ.get("BENCH_SERVE_OUT", "").strip()
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1)
+    return out
+
+
+def run_bench_md(backend=None):
+    """BENCH_MD: closed-loop MD through the raw-structure serving path
+    (docs/serving.md), the three neighbor strategies on IDENTICAL
+    trajectories.
+
+    The engine forward is deterministic and the incremental neighbor
+    list is bitwise the fresh build (graphs/neighborlist.py), so all
+    three modes must traverse the same trajectory bit for bit — the
+    final-state equality check at the bottom adjudicates the whole loop
+    end to end, and the recorded incremental positions are additionally
+    replayed against fresh radius_graph_pbc builds edge for edge. The
+    headline metric is incremental steps/s; the speedup vs
+    rebuild-every-step is what the Verlet skin buys once the forward is
+    already batched/compiled (FlashSchNet's point)."""
+    from examples.md_loop.md_loop import (init_lattice, lj_md_config,
+                                          maxwell_velocities, md_buckets,
+                                          run_md)
+    from hydragnn_tpu.config import build_model_config, update_config
+    from hydragnn_tpu.graphs.batch import collate
+    from hydragnn_tpu.graphs.neighborlist import NeighborList
+    from hydragnn_tpu.graphs.radius import radius_graph_pbc
+    from hydragnn_tpu.models.create import create_model, init_params
+    from hydragnn_tpu.preprocess.transforms import build_graph_sample
+    from hydragnn_tpu.serving.engine import InferenceEngine
+    from hydragnn_tpu.utils.envflags import (env_str, env_strict_float,
+                                             env_strict_int)
+
+    if backend is None:
+        backend = _resolve_backend_and_cache()
+    atoms = env_strict_int("BENCH_MD_ATOMS", 1728)
+    apd = max(int(round(float(atoms) ** (1.0 / 3.0))), 2)
+    steps = env_strict_int("BENCH_MD_STEPS", 120)
+    hidden = env_strict_int("BENCH_MD_HIDDEN", 4)
+    skin = env_strict_float("BENCH_MD_SKIN", 0.3)
+    dt = env_strict_float("BENCH_MD_DT", 0.004)
+    temp = env_strict_float("BENCH_MD_TEMP", 0.3)
+    # MLIP-style receptive field: a 5 sigma cutoff with a neighbor cap
+    # (the OC20 configuration shape) is exactly the regime FlashSchNet
+    # calls neighbor-bound — enumeration sees the full density, the
+    # forward only cap*N edges
+    radius = env_strict_float("BENCH_MD_RADIUS", 5.0)
+    lattice = env_strict_float("BENCH_MD_LATTICE", 1.0)
+    cap = env_strict_int("BENCH_MD_CAP", 12)  # 0/unset-able: <=0 = uncapped
+    cap = cap if cap and cap > 0 else None
+
+    cfg = lj_md_config(radius=radius, max_neighbours=cap,
+                       hidden_dim=hidden, num_conv_layers=1,
+                       num_gaussians=8)
+    pos0, cell = init_lattice(apd, lattice, jitter=0.03, seed=1)
+    n = pos0.shape[0]
+    vel0 = maxwell_velocities(n, temp, seed=2)
+    node_features = np.ones((n, 1), np.float32)
+    frame0 = build_graph_sample(node_features, pos0, cfg, cell=cell,
+                                with_targets=False)
+    ucfg = update_config(cfg, [frame0])
+    mcfg = build_model_config(ucfg)
+    model = create_model(mcfg)
+    variables = init_params(model, collate([frame0]))
+    engine = InferenceEngine(
+        model, variables, mcfg, buckets=md_buckets(n, frame0.num_edges),
+        proto_sample=frame0, max_batch_size=1, max_wait_ms=0.0,
+        structure_config=ucfg, md_skin=skin, ef_forward=True)
+    engine.warmup()
+    compiles_after_warmup = engine.compile_count
+
+    results = {}
+    try:
+        for mode, key in (("incremental", "incremental"),
+                          ("rebuild", "rebuild_every_step"),
+                          ("offline", "offline_preproc")):
+            engine.reset_stats()
+            r = run_md(engine, ucfg, pos0, vel0, cell, node_features,
+                       steps=steps, dt=dt, mode=mode,
+                       record_positions=(mode == "incremental"))
+            stats = engine.stats()
+            r["serve_ms_mean"] = round(stats.get("mean_ms", 0.0), 3)
+            results[key] = r
+    finally:
+        engine.shutdown()
+
+    inc = results["incremental"]
+    reb = results["rebuild_every_step"]
+    off = results["offline_preproc"]
+
+    # end-to-end adjudication 1: all three closed loops traversed the
+    # SAME trajectory bit for bit (identical edges -> identical forces
+    # -> identical integration)
+    final_equal = all(
+        np.array_equal(inc[k], other[k])
+        for other in (reb, off) for k in ("final_pos", "final_vel"))
+
+    # adjudication 2: replay the benched incremental trajectory through
+    # a fresh NeighborList and compare every step against a fresh
+    # radius_graph_pbc build — the PR 5 total-order bitwise contract
+    nl = NeighborList(radius, skin, max_neighbours=cap,
+                      pbc=(True, True, True))
+    edge_mismatch = 0
+    reuse_updates = 0
+    for p in [pos0] + inc.pop("positions"):
+        s, r_, sh, rebuilt = nl.update(p, cell=cell)
+        reuse_updates += int(not rebuilt)
+        fs, fr, fsh = radius_graph_pbc(p, cell, radius,
+                                       max_neighbours=cap)
+        if not (np.array_equal(s, fs) and np.array_equal(r_, fr)
+                and np.array_equal(sh, fsh)):
+            edge_mismatch += 1
+    edges_equal = edge_mismatch == 0 and reuse_updates > 0
+
+    # adjudication 3: the prebuilt-graph submit() contract is unchanged —
+    # batched output bitwise-equal to forward_single on the same bucket
+    sample = build_graph_sample(node_features, inc["final_pos"], ucfg,
+                                cell=cell, with_targets=False)
+    engine2 = InferenceEngine(
+        model, variables, mcfg, buckets=md_buckets(n, frame0.num_edges),
+        proto_sample=frame0, max_batch_size=1, max_wait_ms=0.0,
+        structure_config=ucfg, md_skin=skin, ef_forward=True)
+    try:
+        fut = engine2.submit(sample)
+        res = fut.result(timeout=300)
+        ref = engine2.forward_single(sample, bucket=fut.bucket)
+        prebuilt_parity = all(np.array_equal(a, b)
+                              for a, b in zip(res, ref))
+    finally:
+        engine2.shutdown()
+
+    for r in (inc, reb, off):  # arrays don't belong in the JSON
+        r.pop("final_pos", None)
+        r.pop("final_vel", None)
+        r["graph_build_frac"] = (
+            round(r["graph_build_ms_mean"] / r["step_ms_mean"], 4)
+            if r["step_ms_mean"] else None)
+
+    speed_vs_rebuild = (round(inc["steps_per_s"] / reb["steps_per_s"], 2)
+                        if reb["steps_per_s"] else None)
+    speed_vs_offline = (round(inc["steps_per_s"] / off["steps_per_s"], 2)
+                        if off["steps_per_s"] else None)
+    out = {
+        "metric": "md_steps_per_sec_incremental",
+        "value": inc["steps_per_s"],
+        "unit": "steps/s",
+        "vs_baseline": None,
+        "backend": backend,
+        "shape": {"atoms": n, "edges_first_frame": int(frame0.num_edges),
+                  "radius": radius, "skin": skin, "dt": dt,
+                  "temperature": temp, "lattice": lattice, "steps": steps,
+                  "hidden": hidden, "max_neighbours": cap,
+                  "model": "SchNet", "pbc": True, "ef_forward": True},
+        "modes": results,
+        "speedup_incremental_vs_rebuild": speed_vs_rebuild,
+        "speedup_incremental_vs_offline": speed_vs_offline,
+        "rebuild_fraction": inc["rebuild_fraction"],
+        "trajectories_bitwise_equal_across_modes": final_equal,
+        "incremental_edges_bitwise_equal_vs_fresh": edges_equal,
+        "incremental_edge_mismatch_steps": edge_mismatch,
+        "prebuilt_submit_bitwise_parity": prebuilt_parity,
+        "compile_count_after_warmup": compiles_after_warmup,
+    }
+    out_path = (env_str("BENCH_MD_OUT") or "").strip()
     if out_path:
         with open(out_path, "w") as f:
             json.dump(out, f, indent=1)
@@ -1709,6 +1903,19 @@ def main():
         out = run_bench_serve()
     elif os.environ.get("BENCH_FAULTS") == "1":
         out = run_bench_faults()
+    elif os.environ.get("BENCH_MD") == "1":
+        # on CPU the closed loop ping-pongs between single-threaded host
+        # numpy (neighbor lists) and the XLA forward; XLA's spinning
+        # Eigen pool steals the cores from the host stages in between,
+        # so pin it to one thread BEFORE jax initializes (no effect on a
+        # real accelerator backend — the loop's forward runs on-chip)
+        if "cpu" in (os.environ.get("JAX_PLATFORMS") or ""):
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_cpu_multi_thread_eigen" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_cpu_multi_thread_eigen=false"
+                    " intra_op_parallelism_threads=1").strip()
+        out = run_bench_md()
     elif os.environ.get("BENCH_PREPROC") == "1":
         out = run_bench_preproc()
     elif os.environ.get("BENCH_KERNELS") == "1":

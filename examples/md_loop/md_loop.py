@@ -1,0 +1,320 @@
+"""MD-in-the-loop example: velocity-Verlet with forces served by the
+batched inference engine's raw-structure path (docs/serving.md).
+
+The closed loop this driver runs is ROADMAP item 3 end to end:
+
+    positions --submit_structure--> radius graph -> bucketed EF forward
+        ^                                                   |
+        +--- velocity-Verlet step <--- energy, forces ------+
+
+Forces come from an EF head through the engine (``ef_forward=True``:
+head 0 is a node-level energy head, forces are -dE/dpos — the same
+``energy_force_loss`` convention the LennardJones training example
+uses), and the per-session Verlet-skin neighbor list
+(graphs/neighborlist.py) makes step t+1 re-filter step t's candidate
+cache instead of rebuilding the cell list — the FlashSchNet observation
+that neighbor construction dominates fast atomistic inference, applied
+to serving.
+
+Usage (trains a small SchNet EF model on LJ data first, then runs MD):
+
+    python examples/md_loop/md_loop.py --num_epoch 10 --steps 200 \
+        [--atoms_per_dim 6] [--skin 0.3] [--cpu]
+
+The reusable pieces (`lj_md_config`, `md_buckets`, `run_md`,
+`init_lattice`, `maxwell_velocities`) are what bench.py's BENCH_MD mode
+drives with its three neighbor-handling strategies (incremental /
+rebuild-every-step / offline-preproc).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+
+def lj_md_config(radius: float = 2.0, max_neighbours: int = 64,
+                 hidden_dim: int = 32, num_conv_layers: int = 2,
+                 num_gaussians: int = 16, num_epoch: int = 10,
+                 batch_size: int = 16) -> Dict:
+    """SchNet EF config for the single-species LJ system: node-level
+    energy head (``compute_grad_energy`` trains it with the energy-force
+    loss), PBC radius graphs, species-only node features — the same
+    shape as examples/LennardJones/LJ.json, sized for an MD demo."""
+    return {
+        "Verbosity": {"level": 0},
+        "Dataset": {
+            "name": "lj_md",
+            "format": "memory",
+            "node_features": {"name": ["species"], "dim": [1],
+                              "column_index": [0]},
+            "graph_features": {"name": [], "dim": [], "column_index": []},
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "model_type": "SchNet",
+                "radius": radius,
+                "max_neighbours": max_neighbours,
+                "num_gaussians": num_gaussians,
+                "num_filters": hidden_dim,
+                "num_radial": 8,
+                "envelope_exponent": 5,
+                "num_spherical": 4,
+                "int_emb_size": 16,
+                "basis_emb_size": 8,
+                "out_emb_size": hidden_dim,
+                "num_before_skip": 1,
+                "num_after_skip": 1,
+                "max_ell": 1,
+                "node_max_ell": 1,
+                "hidden_dim": hidden_dim,
+                "num_conv_layers": num_conv_layers,
+                "periodic_boundary_conditions": True,
+                "output_heads": {
+                    "node": {"num_headlayers": 2,
+                             "dim_headlayers": [hidden_dim, hidden_dim],
+                             "type": "mlp"},
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_index": [0],
+                "type": ["node"],
+                "output_dim": [1],
+                "output_names": ["node_energy"],
+            },
+            "Training": {
+                "num_epoch": num_epoch,
+                "batch_size": batch_size,
+                "perc_train": 0.8,
+                "loss_function_type": "mae",
+                "compute_grad_energy": True,
+                "EarlyStopping": False,
+                "Optimizer": {"type": "AdamW", "learning_rate": 0.005},
+            },
+        },
+    }
+
+
+def md_buckets(num_atoms: int, max_edges: int, headroom: float = 0.3,
+               multiple: int = 64):
+    """One-request bucket ladder for a fixed-size trajectory system. The
+    edge count fluctuates step to step as atoms cross the cutoff, so the
+    bucket is sized with `headroom` over the observed count — a request
+    that outgrew the bucket would be rejected mid-trajectory."""
+    from hydragnn_tpu.graphs.packing import choose_budget
+    return (choose_budget(
+        np.asarray([num_atoms]),
+        np.asarray([int(max_edges * (1.0 + headroom))]),
+        1, multiple=multiple),)
+
+
+def init_lattice(atoms_per_dim: int, lattice: float, jitter: float,
+                 seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(positions, cell): perturbed simple-cubic lattice under PBC — the
+    same construction examples/LennardJones/lj_data.py uses."""
+    rng = np.random.RandomState(seed)
+    n = atoms_per_dim ** 3
+    box = atoms_per_dim * lattice
+    grid = np.stack(np.meshgrid(*[np.arange(atoms_per_dim)] * 3,
+                                indexing="ij"), axis=-1).reshape(-1, 3)
+    pos = (grid + 0.5) * lattice + rng.randn(n, 3) * jitter
+    return pos.astype(np.float64), np.eye(3) * box
+
+
+def maxwell_velocities(num_atoms: int, temperature: float, seed: int,
+                       mass: float = 1.0) -> np.ndarray:
+    """Zero-momentum Maxwell-Boltzmann velocities (reduced units)."""
+    rng = np.random.RandomState(seed)
+    vel = rng.randn(num_atoms, 3) * np.sqrt(temperature / mass)
+    return vel - vel.mean(axis=0, keepdims=True)
+
+
+def run_md(engine, config: Dict, pos0: np.ndarray, vel0: np.ndarray,
+           cell: Optional[np.ndarray], node_features: np.ndarray, *,
+           steps: int, dt: float, mass: float = 1.0,
+           mode: str = "incremental", skin: Optional[float] = None,
+           force_scale: float = 1.0,
+           record_positions: bool = False) -> Dict:
+    """Closed-loop velocity-Verlet through the serving engine.
+
+    One engine round-trip per step (the step-t+1 forces double as the
+    step-t+2 half-kick input). `mode` selects the neighbor handling:
+
+    * ``incremental`` — a trajectory session whose Verlet-skin
+      NeighborList re-filters cached candidates (skin = `skin` or the
+      engine's md_skin);
+    * ``rebuild`` — the same session machinery at skin 0: a full
+      cell-list rebuild every step (the no-reuse baseline);
+    * ``offline`` — the client builds the GraphSample itself through the
+      PR 5 offline preprocess path (`build_graph_sample`) and submits
+      the prebuilt graph.
+
+    All three emit bitwise-identical edges (the PR 5 total order) and so
+    — the engine forward being deterministic — traverse bitwise-identical
+    trajectories; BENCH_MD adjudicates exactly that. Positions are kept
+    unwrapped (continuous), the NeighborList displacement-tracking
+    contract; excursions stay tiny over a bench-length run.
+
+    Returns steps/s, rebuild fraction, the graph-build/serve time split,
+    energies, and the final (pos, vel) state.
+    """
+    from hydragnn_tpu.preprocess.transforms import build_graph_sample
+    pbc = bool(config["NeuralNetwork"]["Architecture"].get(
+        "periodic_boundary_conditions", False))
+    ccell = cell if pbc else None
+    session = None
+    if mode == "incremental":
+        session = engine.structure_session(skin=skin)
+    elif mode == "rebuild":
+        session = engine.structure_session(skin=0.0)
+    elif mode != "offline":
+        raise ValueError(
+            f"mode must be incremental | rebuild | offline, got {mode!r}")
+
+    def serve(pos):
+        if mode == "offline":
+            t0 = time.perf_counter()
+            sample = build_graph_sample(node_features, pos, config,
+                                        cell=ccell, with_targets=False)
+            build_ms = (time.perf_counter() - t0) * 1e3
+            fut = engine.submit(sample)
+            fut.rebuilt = True
+            fut.graph_build_ms = build_ms
+            return fut
+        return engine.submit_structure(pos, node_features, cell=ccell,
+                                       session=session)
+
+    pos = np.asarray(pos0, np.float64).copy()
+    vel = np.asarray(vel0, np.float64).copy()
+    res = serve(pos).result()
+    acc = np.asarray(res[1], np.float64) * (force_scale / mass)
+    energies = [float(np.asarray(res[0]).ravel()[0])]
+    rebuilds = 0
+    build_ms_sum = 0.0
+    positions = []
+    t_start = time.perf_counter()
+    for _ in range(steps):
+        pos = pos + vel * dt + (0.5 * dt * dt) * acc
+        fut = serve(pos)
+        res = fut.result()
+        rebuilds += int(fut.rebuilt)
+        build_ms_sum += fut.graph_build_ms
+        acc_new = np.asarray(res[1], np.float64) * (force_scale / mass)
+        vel = vel + (0.5 * dt) * (acc + acc_new)
+        acc = acc_new
+        energies.append(float(np.asarray(res[0]).ravel()[0]))
+        if record_positions:
+            positions.append(pos.copy())
+    wall = time.perf_counter() - t_start
+    out = {
+        "mode": mode,
+        "steps": steps,
+        "wall_s": round(wall, 4),
+        "steps_per_s": round(steps / wall, 3) if wall > 0 else None,
+        "step_ms_mean": round(1e3 * wall / steps, 3),
+        "rebuild_fraction": round(rebuilds / steps, 4),
+        "graph_build_ms_mean": round(build_ms_sum / steps, 3),
+        "energy_first": energies[0],
+        "energy_last": energies[-1],
+        "final_pos": pos,
+        "final_vel": vel,
+    }
+    if record_positions:
+        out["positions"] = positions
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--atoms_per_dim", type=int, default=6,
+                   help="MD system size (atoms_per_dim^3 atoms)")
+    p.add_argument("--train_atoms_per_dim", type=int, default=3,
+                   help="training-configuration size")
+    p.add_argument("--num_configs", type=int, default=120)
+    p.add_argument("--num_epoch", type=int, default=10)
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--dt", type=float, default=0.005)
+    p.add_argument("--temperature", type=float, default=0.3)
+    p.add_argument("--skin", type=float, default=0.3)
+    p.add_argument("--lattice", type=float, default=1.2)
+    p.add_argument("--radius", type=float, default=2.0)
+    p.add_argument("--hidden_dim", type=int, default=32)
+    p.add_argument("--num_conv_layers", type=int, default=2)
+    p.add_argument("--cpu", action="store_true",
+                   help="force CPU backend with 8 virtual devices")
+    args = p.parse_args()
+
+    if args.cpu:
+        from examples.cli_utils import setup_cpu_devices
+        setup_cpu_devices()
+
+    from examples.LennardJones.lj_data import generate_lj_dataset
+    from hydragnn_tpu.config import build_model_config
+    from hydragnn_tpu.models.create import create_model
+    from hydragnn_tpu.preprocess.load_data import split_dataset
+    from hydragnn_tpu.run_training import run_training
+    from hydragnn_tpu.serving.engine import InferenceEngine
+
+    # 1) train the EF model on LJ configurations (energy-force loss,
+    # forces = -dE/dpos through the node-energy head)
+    cfg = lj_md_config(radius=args.radius, hidden_dim=args.hidden_dim,
+                       num_conv_layers=args.num_conv_layers,
+                       num_epoch=args.num_epoch)
+    samples = generate_lj_dataset(
+        num_configs=args.num_configs,
+        atoms_per_dim=args.train_atoms_per_dim, lattice=args.lattice,
+        cutoff=args.radius, normalize=False)
+    state, history, _, completed = run_training(
+        cfg, datasets=split_dataset(samples, 0.8), num_shards=1)
+    print(f"trained: final train_loss="
+          f"{history['train_loss'][-1] if history['train_loss'] else None}")
+
+    # 2) serve it: raw-structure engine with a Verlet-skin session
+    pos0, cell = init_lattice(args.atoms_per_dim, args.lattice,
+                              jitter=0.05, seed=1)
+    n = pos0.shape[0]
+    vel0 = maxwell_velocities(n, args.temperature, seed=2)
+    node_features = np.ones((n, 1), np.float32)
+    mcfg = build_model_config(completed)
+    model = create_model(mcfg)
+    from hydragnn_tpu.preprocess.transforms import build_graph_sample
+    frame0 = build_graph_sample(node_features, pos0, completed, cell=cell,
+                                with_targets=False)
+    engine = InferenceEngine(
+        model, {"params": state.params, "batch_stats": state.batch_stats},
+        mcfg, buckets=md_buckets(n, frame0.num_edges),
+        proto_sample=frame0, max_batch_size=1, max_wait_ms=0.0,
+        structure_config=completed, md_skin=args.skin, ef_forward=True)
+    engine.warmup()
+
+    # 3) the MD loop
+    try:
+        stats = run_md(engine, completed, pos0, vel0, cell, node_features,
+                       steps=args.steps, dt=args.dt)
+        health = engine.health()
+    finally:
+        engine.shutdown()
+    print(json.dumps({
+        "atoms": n,
+        "steps_per_s": stats["steps_per_s"],
+        "rebuild_fraction": stats["rebuild_fraction"],
+        "graph_build_ms_mean": stats["graph_build_ms_mean"],
+        "step_ms_mean": stats["step_ms_mean"],
+        "energy_first": stats["energy_first"],
+        "energy_last": stats["energy_last"],
+        "nbr_updates": health["nbr_updates"],
+        "nbr_rebuilds": health["nbr_rebuilds"],
+    }, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,3 +1,4 @@
 from .batch import BucketSpec, GraphBatch, GraphSample, batch_shape_for_dataset, collate
+from .neighborlist import NeighborList
 from .packing import PackBudget, choose_budget, pack_order, plan_steps
 from .radius import radius_graph, radius_graph_pbc

@@ -1,0 +1,283 @@
+"""Verlet-skin incremental neighbor lists for trajectory workloads.
+
+An MD/relaxation/screening client calls the model once per step on
+positions that barely move between steps, yet a fresh ``radius_graph`` /
+``radius_graph_pbc`` build re-pays the whole cell-list construction —
+ghost-image materialization, cell hashing, candidate sorting — every
+time. FlashSchNet (PAPERS.md) measures exactly this: once the forward is
+fast, neighbor-list construction dominates atomistic inference. The
+classic fix is the Verlet skin:
+
+* **build** a cell list at the inflated cutoff ``r + skin`` and cache
+  the candidate pairs plus the reference positions (and, under PBC, the
+  cell and its integer-shift table);
+* **each step** re-filter the cached candidates to the true cutoff
+  ``r`` at the current positions — a handful of whole-array numpy ops,
+  no cell construction;
+* **rebuild** only when ``max_atom_displacement > skin / 2`` since the
+  reference positions (two atoms approaching each other at skin/2 apiece
+  close at most ``skin`` — any pair inside ``r`` now was inside
+  ``r + skin`` at reference time, so it is in the candidate cache), or
+  when the cell changes at all (a lattice change — volume included —
+  invalidates the image enumeration and the cached cartesian shifts).
+
+Determinism contract (docs/preprocessing.md, the PR 5 total order): the
+edges an update emits are BITWISE-identical to a fresh
+``radius_graph``/``radius_graph_pbc`` build at the same positions —
+receiver-major/sender-ascending (PBC: then shift-id ascending) emission,
+and the same ``max_neighbours`` truncation under the (d², sender
+[, shift-id]) total order. This holds because the candidate cache is the
+``_open_pairs``/``_pbc_pairs`` enumeration at ``r + skin`` (a superset
+of the fresh pair set, in the same canonical order — filtering preserves
+it), the re-filter computes d² with the same float64 expressions the
+fresh path uses, and PBC shift ids keep their relative (sx, sy, sz)
+lexicographic order under any cutoff's enumeration. Adjudicated against
+fresh builds and a brute-force oracle in tests/test_neighborlist.py.
+
+Positions must be CONTINUOUS across steps (unwrapped): a client that
+wraps coordinates back into the box makes the crossing atom jump by a
+lattice vector, which the displacement check reads as ``> skin / 2`` and
+answers with a (correct, conservative) rebuild. Keep trajectories
+unwrapped between rebuilds and re-center only occasionally — modest
+excursions outside the cell are fine, the PBC ghost enumeration
+materializes images around the actual coordinates.
+
+Host-side numpy, never inside jit — the same placement rule as
+graphs/radius.py. One NeighborList per sequential trajectory client; the
+object is not thread-safe.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .radius import (_CAP_DENSE_MAX_DEG, _CAP_DENSE_WASTE,
+                     _cap_neighbours, _dense_select, _open_pairs,
+                     _pbc_pairs)
+
+_EMPTY_EDGES = (np.empty(0, np.int32), np.empty(0, np.int32))
+
+
+class _CandidateCap:
+    """``max_neighbours`` truncation evaluated directly on the candidate
+    layout: the candidates' per-receiver segment structure is FIXED
+    between rebuilds, so the segment bookkeeping (ids, in-segment
+    offsets, the dense [segments, max_degree] matrix) is built once per
+    rebuild and every step only scatters the current d² (out-of-cutoff
+    candidates as +inf) and runs the O(width) per-row introselect.
+
+    Selection is EXACTLY the documented (d², sender[, shift-id]) total
+    order (`radius._cap_neighbours`): candidates are in canonical order,
+    so among entries tied on (receiver, d²) the input order IS ascending
+    tie-key order, and +inf entries can never be selected — they are
+    masked back out even when a short row's k-th value is +inf.
+    Degree-skewed candidate sets (one huge segment next to many tiny
+    ones — the dense matrix stops paying for itself, same guards as
+    `radius._cap_canonical`) run the canonical lexsort on the compressed
+    within-cutoff edges instead, identical selection. Adjudicated
+    edge-for-edge against fresh capped builds in
+    tests/test_neighborlist.py."""
+
+    __slots__ = ("k", "recv", "seg_id", "idx", "starts", "width", "mat",
+                 "keep_all")
+
+    def __init__(self, recv: np.ndarray, k: int):
+        self.k = int(k)
+        n = len(recv)
+        change = np.empty(n, bool)
+        change[0] = True
+        np.not_equal(recv[1:], recv[:-1], out=change[1:])
+        self.seg_id = np.cumsum(change, dtype=np.int64) - 1
+        self.starts = np.flatnonzero(change)
+        self.idx = np.arange(n, dtype=np.int64) - self.starts[self.seg_id]
+        self.width = int(self.idx.max()) + 1 if n else 0
+        self.keep_all = self.width <= self.k
+        dense = (not self.keep_all and self.width <= _CAP_DENSE_MAX_DEG
+                 and (len(self.starts) * self.width
+                      <= _CAP_DENSE_WASTE * n + 4096))
+        self.mat = (np.empty((len(self.starts), self.width)) if dense
+                    else None)
+        self.recv = None if (self.keep_all or dense) else recv
+
+    def keep(self, d2: np.ndarray, ok: np.ndarray) -> np.ndarray:
+        """Keep mask over ALL candidates: the per-receiver k smallest
+        (d², input order) among the ``ok`` (within-cutoff) ones."""
+        if self.k <= 0:
+            return np.zeros(len(ok), bool)  # the legacy rank < 0 result
+        if self.keep_all:
+            return ok
+        if self.mat is None:  # skew fallback: lexsort the within-r edges
+            sel = np.flatnonzero(ok)
+            out = np.zeros(len(ok), bool)
+            if sel.size:
+                kept = _cap_neighbours(d2[sel], self.recv[sel], self.k,
+                                       canonical_order=True)
+                out[sel[kept]] = True
+            return out
+        keep = _dense_select(np.where(ok, d2, np.inf), self.seg_id,
+                             self.idx, self.starts, self.k, self.mat)
+        keep &= ok
+        return keep
+
+
+class NeighborList:
+    """Incremental radius-graph builder over a trajectory.
+
+    ``update(pos[, cell])`` returns ``(senders, receivers, shifts,
+    rebuilt)`` — ``shifts`` is the [E, 3] float32 cartesian image
+    displacement array under PBC and ``None`` for open boundaries,
+    exactly as ``radius_graph_pbc`` / ``radius_graph`` emit them.
+
+    ``pbc=None`` selects open boundaries; a 3-tuple of bools selects the
+    periodic path (``cell`` then becomes a required ``update`` argument).
+    ``skin <= 0`` degenerates to rebuild-every-step — the
+    BENCH_MD baseline mode, same outputs, no reuse.
+    """
+
+    def __init__(self, r: float, skin: float, *,
+                 max_neighbours: Optional[int] = None,
+                 pbc: Optional[Tuple[bool, bool, bool]] = None):
+        self.r = float(r)
+        self.skin = float(skin)
+        if self.r <= 0.0:
+            raise ValueError(f"NeighborList cutoff must be > 0, got {r}")
+        if not np.isfinite(self.skin) or self.skin < 0.0:
+            raise ValueError(
+                f"NeighborList skin must be a finite value >= 0, got {skin}")
+        self.max_neighbours = (None if max_neighbours is None
+                               else int(max_neighbours))
+        self.pbc = None if pbc is None else tuple(bool(p) for p in pbc)
+        # reuse accounting: `updates` counts update() calls, `rebuilds`
+        # the ones that re-ran the full cell-list construction
+        self.updates = 0
+        self.rebuilds = 0
+        self._ref_pos: Optional[np.ndarray] = None
+        self._ref_cell: Optional[np.ndarray] = None
+        self._cand: Optional[Tuple[np.ndarray, ...]] = None
+        self._shifts_int: Optional[np.ndarray] = None
+        self._cand_off: Optional[np.ndarray] = None
+        self._cand_d2: Optional[np.ndarray] = None
+        self._cap: Optional[_CandidateCap] = None
+        self._scratch: Optional[Tuple[np.ndarray, ...]] = None
+
+    @property
+    def rebuild_fraction(self) -> float:
+        """Rebuilds over updates so far (1.0 until the first reuse)."""
+        return self.rebuilds / self.updates if self.updates else 0.0
+
+    # ------------------------------------------------------------------ core
+
+    def update(self, pos: np.ndarray, cell: Optional[np.ndarray] = None):
+        """Edges at the true cutoff for the current positions:
+        ``(senders, receivers, shifts_or_None, rebuilt)``."""
+        pos = np.asarray(pos, dtype=np.float64)
+        if self.pbc is not None:
+            if cell is None:
+                raise ValueError(
+                    "periodic NeighborList needs the cell on every "
+                    "update (it detects lattice changes and rebuilds)")
+            cell = np.asarray(cell, dtype=np.float64).reshape(3, 3)
+        elif cell is not None:
+            raise ValueError(
+                "open-boundary NeighborList got a cell — construct with "
+                "pbc=(True, True, True) for periodic systems")
+        self.updates += 1
+        if pos.shape[0] == 0:
+            self.rebuilds += 1
+            self._ref_pos = pos.copy()
+            shifts = (np.empty((0, 3), np.float32)
+                      if self.pbc is not None else None)
+            return (*_EMPTY_EDGES, shifts, True)
+        rebuilt = self._needs_rebuild(pos, cell)
+        if rebuilt:
+            self.rebuilds += 1
+            self._build(pos, cell)
+        return (*self._emit(pos, cell, fresh=rebuilt), rebuilt)
+
+    def _needs_rebuild(self, pos: np.ndarray,
+                       cell: Optional[np.ndarray]) -> bool:
+        if self._ref_pos is None or pos.shape != self._ref_pos.shape:
+            return True
+        if self.pbc is not None and not np.array_equal(cell,
+                                                       self._ref_cell):
+            # ANY lattice change (volume change included) invalidates the
+            # image/shift enumeration and the cached cartesian shifts
+            return True
+        if self.skin <= 0.0:
+            return True  # rebuild-every-step mode
+        disp2 = np.sum((pos - self._ref_pos) ** 2, axis=-1)
+        # strictly > skin/2: at exactly skin/2 apiece a pair closes at
+        # most `skin`, which the r + skin candidate cache still covers
+        return bool(disp2.max() > (0.5 * self.skin) ** 2)
+
+    def _build(self, pos: np.ndarray, cell: Optional[np.ndarray]) -> None:
+        rc = self.r + self.skin
+        if self.pbc is None:
+            send, recv, d2 = _open_pairs(pos, rc)
+            self._cand = (send, recv)
+        else:
+            send, recv, sid, shifts_int, d2 = _pbc_pairs(pos, cell, rc,
+                                                         self.pbc)
+            self._cand = (send, recv, sid)
+            self._shifts_int = shifts_int
+            # the ghost-position construction of the fresh path, cached
+            # PER CANDIDATE: candidate e sits at pos[send] + offset[e],
+            # where offset[e] = (shifts_int @ cell)[sid[e]] — the same
+            # float64 values _pbc_pairs added when it materialized
+            # ghosts, gathered once at build time so the per-step
+            # re-filter pays no indexed gather for them
+            self._cand_off = (shifts_int @ cell)[sid]
+            self._ref_cell = cell.copy()
+        # the enumeration's own d² at rc, valid for the emit that runs
+        # at the UNMOVED build positions (the rebuild step itself) —
+        # saves the whole distance pass there
+        self._cand_d2 = d2
+        self._cap = (None if self.max_neighbours is None or not len(recv)
+                     else _CandidateCap(recv, self.max_neighbours))
+        self._scratch = None
+        self._ref_pos = pos.copy()
+
+    def _cand_distances(self, pos: np.ndarray, fresh: bool) -> np.ndarray:
+        """Per-candidate d² at the current positions. On the rebuild step
+        itself (`fresh`) the positions ARE the build positions, so the
+        enumeration's own d² is returned as-is. Otherwise the distance
+        pass runs in preallocated scratch (in-place ops in the same
+        left-to-right order as the fresh expression — bitwise-identical
+        values, no multi-MB allocation churn per trajectory step)."""
+        if fresh:
+            return self._cand_d2
+        if self.pbc is None:
+            cs, cr = self._cand
+        else:
+            cs, cr, _ = self._cand
+        if self._scratch is None or self._scratch[0].shape[0] != len(cs):
+            self._scratch = (np.empty((len(cs), 3), np.float64),
+                             np.empty((len(cs), 3), np.float64),
+                             np.empty(len(cs), np.float64))
+        g, h, d2 = self._scratch
+        np.take(pos, cs, axis=0, out=g)
+        if self.pbc is not None:
+            g += self._cand_off
+        g -= np.take(pos, cr, axis=0, out=h)
+        np.multiply(g, g, out=g)
+        return np.sum(g, axis=1, out=d2)
+
+    def _emit(self, pos: np.ndarray, cell: Optional[np.ndarray],
+              fresh: bool = False):
+        """Re-filter the candidate cache to the true cutoff at the
+        current positions. Mirrors the fresh-build expressions verbatim
+        (same float64 ops, same `_cap_neighbours` keys) so the emitted
+        edges are bitwise those of a fresh build at `pos`."""
+        d2 = self._cand_distances(pos, fresh)
+        keep = d2 <= self.r * self.r
+        if self._cap is not None:
+            keep = self._cap.keep(d2, keep)
+        if self.pbc is None:
+            cs, cr = self._cand
+            return (cs[keep].astype(np.int32), cr[keep].astype(np.int32),
+                    None)
+        cs, cr, csid = self._cand
+        send, recv, sid = cs[keep], cr[keep], csid[keep]
+        cart_shift = (self._shifts_int[sid] @ cell).astype(np.float32)
+        return send.astype(np.int32), recv.astype(np.int32), cart_shift

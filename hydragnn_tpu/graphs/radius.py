@@ -62,19 +62,38 @@ def radius_graph(
     n = pos.shape[0]
     if n == 0:
         return np.empty(0, np.int32), np.empty(0, np.int32)
+    send, recv, d2 = _open_pairs(pos, r, loop)
+    if max_neighbours is not None and len(recv):
+        keep = _cap_neighbours(d2, recv, max_neighbours, send,
+                               canonical_order=True)
+        send, recv = send[keep], recv[keep]
+    return send.astype(np.int32), recv.astype(np.int32)
+
+
+def _open_pairs(pos: np.ndarray, r: float, loop: bool = False
+                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """All uncapped (send, recv, d²) pairs within ``r``, indices int64,
+    in the canonical order (receiver-major, sender ascending); the d²
+    values are the enumeration's own, returned so the ``max_neighbours``
+    cap never recomputes them (one d² definition per edge end to end).
+
+    The shared candidate enumeration behind ``radius_graph`` and the
+    Verlet-skin ``graphs.neighborlist.NeighborList`` (which calls it at
+    ``r + skin`` and re-filters to ``r`` each trajectory step): both
+    consumers see the SAME pair set in the SAME total order, so the
+    incremental path can be adjudicated bitwise against a fresh build.
+    ``pos`` must already be float64 — the n=512↔513 dense/cell-list
+    straddle is bitwise-invisible only when both paths square identical
+    coordinates."""
+    n = pos.shape[0]
     if n <= _DENSE_MAX:
         d2 = np.sum((pos[:, None, :] - pos[None, :, :]) ** 2, axis=-1)
         adj = d2 <= r * r
         if not loop:
             np.fill_diagonal(adj, False)
         recv, send = np.nonzero(adj)  # row i = center, col j = neighbor
-    else:
-        send, recv = _cell_list_pairs(pos, r, loop)
-    if max_neighbours is not None and len(recv):
-        d2 = np.sum((pos[send] - pos[recv]) ** 2, axis=-1)
-        keep = _cap_neighbours(d2, recv, max_neighbours, send)
-        send, recv = send[keep], recv[keep]
-    return send.astype(np.int32), recv.astype(np.int32)
+        return send, recv, d2[recv, send]
+    return _cell_list_pairs(pos, r, loop)
 
 
 def _compress_cells(coords: np.ndarray) -> np.ndarray:
@@ -146,10 +165,10 @@ def _cell_candidate_blocks(grid_pos: np.ndarray, query_pos: np.ndarray,
 
 
 def _cell_list_pairs(pos, r, loop):
-    """Vectorized open-boundary pair search. Emits edges in the dense
-    reference order (receiver-major, sender ascending)."""
+    """Vectorized open-boundary pair search. Emits (send, recv, d²) in
+    the dense reference order (receiver-major, sender ascending)."""
     r2 = r * r
-    send_l, recv_l = [], []
+    send_l, recv_l, d2_l = [], [], []
     for cand, center in _cell_candidate_blocks(pos, pos, r):
         d2 = np.sum((pos[cand] - pos[center]) ** 2, axis=-1)
         ok = d2 <= r2
@@ -157,27 +176,114 @@ def _cell_list_pairs(pos, r, loop):
             ok &= cand != center
         send_l.append(cand[ok])
         recv_l.append(center[ok])
+        d2_l.append(d2[ok])
     send = np.concatenate(send_l) if send_l else _EMPTY_I64
     recv = np.concatenate(recv_l) if recv_l else _EMPTY_I64
+    d2 = np.concatenate(d2_l) if d2_l else np.empty(0, np.float64)
     order = np.lexsort((send, recv))
-    return send[order], recv[order]
+    return send[order], recv[order], d2[order]
 
 
 def _cap_neighbours(d2: np.ndarray, recv: np.ndarray, max_neighbours: int,
-                    *tie_keys: np.ndarray) -> np.ndarray:
+                    *tie_keys: np.ndarray,
+                    canonical_order: bool = False) -> np.ndarray:
     """Keep mask selecting, per receiver, the ``max_neighbours`` edges
     smallest under the total order (d², *tie_keys) — lexsort keyed
     (recv, d², tie_keys...), so truncation is bitwise-reproducible across
     runs and platforms independent of the input edge order
     (docs/preprocessing.md; the pack-plan/resume contracts need
     deterministic edge counts). Returns a boolean mask in input order.
+
+    ``canonical_order=True`` asserts the input is ALREADY sorted by
+    (recv, tie_keys...) — true for every radius/neighborlist call site,
+    whose emission order is exactly that. Stability then makes the tie
+    keys implicit: entries tied on (recv, d²) keep their input relative
+    order, which IS ascending tie-key order. That admits two cheaper
+    EXACT implementations (the cap is the hot host op of the MD serving
+    loop, BENCH_MD): per-receiver segments are contiguous, so ranks come
+    from cache-friendly ROW-WISE stable argsorts over a dense
+    [segments, max_degree] matrix padded with +inf — identical selection
+    to the global lexsort at a fraction of its cost; degree-skewed
+    inputs (padding waste) fall back to a 2-key lexsort whose stability
+    gives the same permutation as the full-key sort.
     """
+    if max_neighbours <= 0:
+        # rank < 0 keeps nothing in the legacy sort path; every
+        # implementation below must agree
+        return np.zeros(len(recv), bool)
+    if canonical_order:
+        return _cap_canonical(d2, recv, max_neighbours)
     order = np.lexsort(tuple(reversed(tie_keys)) + (d2, recv))
     srecv = recv[order]
     rank = np.arange(len(srecv)) - np.searchsorted(srecv, srecv, side="left")
     keep = np.zeros(len(recv), bool)
     keep[order[rank < max_neighbours]] = True
     return keep
+
+
+# dense-cap guards: above this row width, or past this padding-waste
+# factor, the [segments, max_degree] matrix stops paying for itself
+_CAP_DENSE_MAX_DEG = 2048
+_CAP_DENSE_WASTE = 8
+
+
+def _cap_canonical(d2: np.ndarray, recv: np.ndarray,
+                   max_neighbours: int) -> np.ndarray:
+    """`_cap_neighbours` for input already in the canonical
+    (recv, tie_keys...) order — see its docstring for why stability
+    makes the tie keys implicit. Returns the identical keep mask."""
+    n_edges = len(recv)
+    change = np.empty(n_edges, bool)
+    change[0] = True
+    np.not_equal(recv[1:], recv[:-1], out=change[1:])
+    seg_id = np.cumsum(change) - 1
+    starts = np.flatnonzero(change)
+    idx = np.arange(n_edges) - starts[seg_id]
+    n_seg = len(starts)
+    width = int(idx.max()) + 1
+    if (width > _CAP_DENSE_MAX_DEG
+            or n_seg * width > _CAP_DENSE_WASTE * n_edges + 4096):
+        order = np.lexsort((d2, recv))  # stable: ties keep input order
+        srecv = recv[order]
+        rank = (np.arange(n_edges)
+                - np.searchsorted(srecv, srecv, side="left"))
+        keep = np.zeros(n_edges, bool)
+        keep[order[rank < max_neighbours]] = True
+        return keep
+    if width <= max_neighbours:
+        return np.ones(n_edges, bool)  # no receiver exceeds the cap
+    mat = np.empty((n_seg, width))
+    return _dense_select(d2, seg_id, idx, starts, max_neighbours, mat)
+
+
+def _dense_select(val: np.ndarray, seg_id: np.ndarray, idx: np.ndarray,
+                  starts: np.ndarray, k: int,
+                  mat: np.ndarray) -> np.ndarray:
+    """Keep mask: per contiguous segment, the ``k`` smallest entries
+    under (val, input order) — THE one copy of the exact dense selection
+    kernel, shared by `_cap_canonical` and the Verlet-skin
+    `neighborlist._CandidateCap` (the incremental-vs-fresh bitwise
+    adjudication depends on the two call sites never diverging).
+
+    Exact selection without sorting: the k smallest of a row are
+    everything strictly below the row's k-th smallest VALUE, plus the
+    first (k - |strictly below|) entries EQUAL to it in input order —
+    O(width) introselect per row instead of O(width log width) sorting.
+    ``mat`` is the caller's [n_seg, width] scratch (cached across
+    trajectory steps by _CandidateCap); +inf pads short rows, and
+    callers passing +inf entries in ``val`` (out-of-cutoff candidates)
+    mask them back out of the returned keep."""
+    mat.fill(np.inf)
+    mat[seg_id, idx] = val
+    kth = np.partition(mat, k - 1, axis=1)[:, k - 1]
+    kth_e = kth[seg_id]
+    strict = val < kth_e
+    quota = k - np.add.reduceat(strict, starts)
+    eq = val == kth_e  # short/+inf rows: eq hits padding; callers mask
+    run = np.cumsum(eq, dtype=np.int64)
+    base = run[starts] - eq[starts]  # exclusive prefix at segment start
+    eq_rank = run - base[seg_id]     # 1-based among eq, input order
+    return strict | (eq & (eq_rank <= quota[seg_id]))
 
 
 def radius_graph_pbc(
@@ -207,6 +313,35 @@ def radius_graph_pbc(
     if n == 0:
         return (np.empty(0, np.int32), np.empty(0, np.int32),
                 np.empty((0, 3), np.float32))
+    send, recv, sid, shifts_int, d2 = _pbc_pairs(pos, cell, r, pbc)
+    shift = shifts_int[sid]
+    if max_neighbours is not None and len(recv):
+        keep = _cap_neighbours(d2, recv, max_neighbours, send, sid,
+                               canonical_order=True)
+        send, recv, shift = send[keep], recv[keep], shift[keep]
+    cart_shift = (shift @ cell).astype(np.float32)
+    return send.astype(np.int32), recv.astype(np.int32), cart_shift
+
+
+def _pbc_pairs(pos: np.ndarray, cell: np.ndarray, r: float,
+               pbc: Tuple[bool, bool, bool] = (True, True, True)
+               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray,
+                          np.ndarray]:
+    """All uncapped periodic pairs within ``r``: (send, recv, sid,
+    shifts_int, d²), int64 indices, the [S, 3] float64 ``shifts_int``
+    table the shift ids index, and the enumeration's own per-pair d²
+    (reused by the ``max_neighbours`` cap — one d² definition per edge),
+    in the canonical (receiver, sender, shift-id) order.
+
+    The PBC counterpart of ``_open_pairs``, shared by
+    ``radius_graph_pbc`` and the Verlet-skin NeighborList. Shift ids
+    enumerate (sx, sy, sz) lexicographically, so although a wider cutoff
+    enumerates MORE images (larger ids), the RELATIVE order of any two
+    integer shifts is cutoff-independent — the cap tie-break and the
+    emission order only consume that relative order, which is what keeps
+    the incremental list bitwise-adjudicable against a fresh build.
+    ``pos``/``cell`` must already be float64."""
+    n = pos.shape[0]
     # number of images needed per axis: ceil(r / plane-distance)
     recip = np.linalg.inv(cell).T  # rows = reciprocal vectors / 2pi
     nmax = []
@@ -241,7 +376,7 @@ def radius_graph_pbc(
     ghost_sid = ghost_sid[keep]
 
     r2 = r * r
-    send_l, recv_l, sid_l = [], [], []
+    send_l, recv_l, sid_l, d2_l = [], [], [], []
     for cand, center in _cell_candidate_blocks(ghost_pos, pos, r):
         d2 = np.sum((ghost_pos[cand] - pos[center]) ** 2, axis=-1)
         ok = d2 <= r2
@@ -251,17 +386,11 @@ def radius_graph_pbc(
         send_l.append(ghost_src[cand[ok]])
         recv_l.append(center[ok])
         sid_l.append(ghost_sid[cand[ok]])
+        d2_l.append(d2[ok])
     send = np.concatenate(send_l) if send_l else _EMPTY_I64
     recv = np.concatenate(recv_l) if recv_l else _EMPTY_I64
     sid = np.concatenate(sid_l) if sid_l else _EMPTY_I64
+    d2 = np.concatenate(d2_l) if d2_l else np.empty(0, np.float64)
     # canonical order: receiver-major, sender, shift id
     order = np.lexsort((sid, send, recv))
-    send, recv, sid = send[order], recv[order], sid[order]
-    shift = shifts_int[sid]
-    if max_neighbours is not None and len(recv):
-        disp = pos[send] + shift @ cell - pos[recv]
-        d2 = np.sum(disp * disp, axis=-1)
-        keep = _cap_neighbours(d2, recv, max_neighbours, send, sid)
-        send, recv, shift = send[keep], recv[keep], shift[keep]
-    cart_shift = (shift @ cell).astype(np.float32)
-    return send.astype(np.int32), recv.astype(np.int32), cart_shift
+    return send[order], recv[order], sid[order], shifts_int, d2[order]

@@ -85,11 +85,24 @@ def build_graph_sample(
     cell: Optional[np.ndarray] = None,
     forces: Optional[np.ndarray] = None,
     energy: Optional[float] = None,
+    edges: Optional[Tuple] = None,
+    with_targets: bool = True,
 ) -> GraphSample:
     """Full raw -> GraphSample path for one structure: rotation
     normalization, radius graph (+PBC), input/target selection, optional
     edge-length features (reference: SerializedDataLoader.load_serialized_data
-    serialized_dataset_loader.py:103-171)."""
+    serialized_dataset_loader.py:103-171).
+
+    ``edges=(senders, receivers, shifts_or_None)`` skips the radius-graph
+    construction and uses the given edge list instead — the raw-structure
+    serving path (docs/serving.md) passes the output of an incremental
+    ``graphs.neighborlist.NeighborList`` here, whose emission is bitwise
+    the fresh build's under the PR 5 total order. Incompatible with
+    ``rotational_invariance`` (the edges were built in the unrotated
+    frame). ``with_targets=False`` skips target selection entirely
+    (``y_graph``/``y_node`` stay None) so inference clients can pass a
+    feature matrix whose target columns are zero-filled placeholders.
+    """
     ds = config["Dataset"]
     nn = config["NeuralNetwork"]
     arch = nn["Architecture"]
@@ -98,6 +111,12 @@ def build_graph_sample(
     graph_dims = ds.get("graph_features", {}).get("dim", [])
 
     if ds.get("rotational_invariance", False):
+        if edges is not None:
+            raise ValueError(
+                "precomputed edges cannot be combined with "
+                "Dataset.rotational_invariance — the edge list was built "
+                "in the unrotated frame, the rotated positions would "
+                "disagree with it")
         pos, rot = normalize_rotation(pos, return_rotation=True)
         if cell is not None:
             # co-rotate the lattice so PBC minimum images stay correct
@@ -105,8 +124,9 @@ def build_graph_sample(
 
     radius = float(arch.get("radius") or 5.0)
     max_nb = arch.get("max_neighbours")
-    shifts = None
-    if arch.get("periodic_boundary_conditions", False):
+    if edges is not None:
+        send, recv, shifts = edges
+    elif arch.get("periodic_boundary_conditions", False):
         if cell is None:
             raise ValueError(
                 "periodic_boundary_conditions=true requires a cell "
@@ -114,14 +134,19 @@ def build_graph_sample(
         send, recv, shifts = radius_graph_pbc(pos, cell, radius,
                                               max_neighbours=max_nb)
     else:
+        shifts = None
         send, recv = radius_graph(pos, radius, max_neighbours=max_nb)
 
     x = update_atom_features(voi["input_node_features"],
                              node_feature_matrix, node_dims)
-    y_graph, y_node = update_predicted_values(
-        voi["type"], voi["output_index"],
-        graph_feats if graph_feats is not None else np.zeros(0, np.float32),
-        node_feature_matrix, graph_dims, node_dims)
+    if with_targets:
+        y_graph, y_node = update_predicted_values(
+            voi["type"], voi["output_index"],
+            graph_feats if graph_feats is not None
+            else np.zeros(0, np.float32),
+            node_feature_matrix, graph_dims, node_dims)
+    else:
+        y_graph = y_node = None
 
     edge_attr = None
     vec = pos[send] - pos[recv]

@@ -102,7 +102,7 @@ def run_prediction(config_or_path, datasets: Optional[Tuple] = None,
     if use_engine:
         trues, preds = _predict_with_engine(
             model, state, mcfg, testset, serving, num_shards,
-            nbr_fmt, test_loader.neighbor_k)
+            nbr_fmt, test_loader.neighbor_k, config)
     else:
         trues, preds = _predict_with_loader(
             model, state, mcfg, test_loader, train_cfg, num_shards)
@@ -198,7 +198,7 @@ def _sample_targets(mcfg, sample):
 
 
 def _predict_with_engine(model, state, mcfg, testset, serving, num_shards,
-                         neighbor_format, neighbor_k):
+                         neighbor_format, neighbor_k, config=None):
     """Engine path: every test sample becomes one serving request; the
     background dispatcher coalesces them into bucketed padded batches
     (serving/engine.py) — the same numerics as the legacy loop, measured
@@ -224,7 +224,13 @@ def _predict_with_engine(model, state, mcfg, testset, serving, num_shards,
         # deployment would fast-fail/expire a perfectly good prediction
         # run (docs/fault_tolerance.md). They apply to engines serving
         # live traffic via the InferenceEngine API.
-        breaker_threshold=0)
+        breaker_threshold=0,
+        # Serving.structure / HYDRAGNN_SERVE_STRUCTURE: hand the engine
+        # the full config so raw-structure clients (submit_structure /
+        # trajectory sessions, docs/serving.md) can use this engine too;
+        # the offline testset prediction below is unaffected
+        structure_config=config if serving.structure else None,
+        md_skin=serving.md_skin)
     try:
         if serving.metrics_port:
             # Serving.metrics_port / HYDRAGNN_SERVE_METRICS_PORT:

@@ -2,10 +2,10 @@
 compile cache (docs/serving.md), with explicit failure semantics —
 bounded admission, per-request deadlines, dispatcher circuit breaker
 (docs/fault_tolerance.md)."""
-from .config import ServingConfig, resolve_serving
+from .config import ServingConfig, Structure, resolve_serving
 from .engine import (CircuitOpenError, DeadlineExceededError,
                      InferenceEngine, QueueFullError, ServingError,
-                     bucket_ladder, select_bucket)
+                     StructureSession, bucket_ladder, select_bucket)
 
 __all__ = [
     "CircuitOpenError",
@@ -14,6 +14,8 @@ __all__ = [
     "QueueFullError",
     "ServingConfig",
     "ServingError",
+    "Structure",
+    "StructureSession",
     "bucket_ladder",
     "resolve_serving",
     "select_bucket",

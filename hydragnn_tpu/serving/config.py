@@ -20,8 +20,11 @@ Config schema (top-level block, alongside "Dataset"/"NeuralNetwork"):
         "breaker_threshold": 5,    # consecutive batch failures to trip
         "breaker_reset_s": 30.0,   # open -> half-open probe window
         "precision": null,         # serve-side compute dtype override
-        "metrics_port": 0          # /healthz + /metrics HTTP port
+        "metrics_port": 0,         # /healthz + /metrics HTTP port
                                    # (0 = off; see docs/observability.md)
+        "structure": false,        # raw-structure serving (submit_structure)
+        "md_skin": 0.3             # Verlet-skin width for trajectory
+                                   # sessions (docs/serving.md)
     }
 
 The queue/deadline/breaker knobs are the failure-semantics layer
@@ -34,11 +37,39 @@ unset, the engine inherits the train-side policy (HYDRAGNN_PRECISION /
 Architecture.dtype). A reduced-precision engine relaxes the PR 3
 bitwise-parity adjudication to the documented tolerance bound — each
 resolved future carries the bound (engine.py SERVE_REDUCED_RTOL/ATOL).
+
+`structure` (env: HYDRAGNN_SERVE_STRUCTURE) enables the raw-structure
+serving path (docs/serving.md): run_prediction hands the engine the full
+config so MD/relaxation/screening clients can call
+``engine.submit_structure`` with raw positions instead of prebuilt
+graphs. `md_skin` (env: HYDRAGNN_MD_SKIN; cutoff units) is the
+Verlet-skin width trajectory sessions build their incremental neighbor
+list with — wider = fewer rebuilds but more candidates per re-filter.
 """
 from __future__ import annotations
 
 import dataclasses
 from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Structure:
+    """One raw-structure request (the `submit_structure` schema).
+
+    * ``positions`` — [N, 3] cartesian coordinates;
+    * ``node_features`` — [N, sum(Dataset.node_features.dim)] in the
+      dataset's node-feature layout. Only the
+      ``Variables_of_interest.input_node_features`` columns are read at
+      inference; target columns may be zero-filled placeholders;
+    * ``cell`` — [3, 3] lattice, required under
+      ``periodic_boundary_conditions``;
+    * ``graph_feats`` — optional graph-feature vector (ignored at
+      inference, accepted for schema symmetry with the dataset loaders).
+    """
+    positions: Any
+    node_features: Any
+    cell: Optional[Any] = None
+    graph_feats: Optional[Any] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -55,6 +86,9 @@ class ServingConfig:
     precision: Optional[str] = None  # None = inherit the train-side policy
     metrics_port: int = 0         # 0 = no HTTP endpoint; > 0 = bind that
     # port on loopback for /healthz + /metrics (telemetry/http.py)
+    structure: bool = False       # raw-structure serving (submit_structure)
+    md_skin: float = 0.3          # Verlet-skin width for trajectory
+    # sessions (cutoff units; docs/serving.md raw-structure section)
 
 
 def resolve_serving(config: Optional[Dict[str, Any]]) -> ServingConfig:
@@ -77,6 +111,8 @@ def resolve_serving(config: Optional[Dict[str, Any]]) -> ServingConfig:
         breaker_reset_s=float(block.get("breaker_reset_s", 30.0)),
         precision=canonical_precision(block.get("precision")),
         metrics_port=int(block.get("metrics_port", 0) or 0),
+        structure=bool(block.get("structure", False)),
+        md_skin=float(block.get("md_skin", 0.3)),
     )
     return ServingConfig(
         enabled=env_strict_flag("HYDRAGNN_SERVE", base.enabled),
@@ -100,4 +136,7 @@ def resolve_serving(config: Optional[Dict[str, Any]]) -> ServingConfig:
                                     PRECISION_CHOICES, base.precision),
         metrics_port=env_strict_int("HYDRAGNN_SERVE_METRICS_PORT",
                                     base.metrics_port),
+        structure=env_strict_flag("HYDRAGNN_SERVE_STRUCTURE",
+                                  base.structure),
+        md_skin=env_strict_float("HYDRAGNN_MD_SKIN", base.md_skin),
     )

@@ -55,6 +55,22 @@ error, under any single-batch failure:
 * the ``serving-dispatch`` fault site (utils/faults.py) fires once per
   executed batch, so all of the above is exercised deterministically by
   tier-1 tests and the BENCH_FAULTS chaos mode.
+
+Raw-structure serving (docs/serving.md, ROADMAP item 3): with a
+``structure_config`` the engine also accepts raw positions —
+``submit_structure(positions, node_features[, cell])`` runs structure →
+radius graph → ``build_graph_sample`` → the bucketed forward in one
+call, and trajectory clients hold a ``structure_session()`` whose
+Verlet-skin incremental NeighborList (graphs/neighborlist.py) makes
+step t+1 re-filter step t's candidate cache instead of rebuilding the
+cell list. Emitted edges are bitwise the fresh build's (the PR 5 total
+order), futures carry ``.rebuilt``/``.graph_build_ms`` breadcrumbs next
+to ``.bucket``, and rebuild counts flow into the telemetry registry
+(``serve.nbr_rebuilds_total``, the rebuild-fraction gauge, the
+``serve.graph_build`` span) plus ``health()``//metrics so a scrape can
+tell neighbor-bound from compute-bound serving. ``ef_forward=True``
+serves energy+forces from a node-level energy head (forces = -dE/dpos),
+closing the MD loop end-to-end (examples/md_loop, BENCH_MD).
 """
 from __future__ import annotations
 
@@ -70,7 +86,9 @@ import numpy as np
 from ..graphs.batch import GraphBatch, GraphSample, collate
 from ..graphs.packing import MAX_GRAPH_SLOTS, PackBudget, choose_budget
 from ..telemetry import spans as _spans
+from ..telemetry.registry import get_registry
 from ..utils.faults import fault_point
+from .config import Structure
 
 _SHUTDOWN = object()
 
@@ -198,7 +216,10 @@ class InferenceEngine:
                  max_queue: int = 0,
                  default_deadline_ms: Optional[float] = None,
                  breaker_threshold: int = 5,
-                 breaker_reset_s: float = 30.0):
+                 breaker_reset_s: float = 30.0,
+                 structure_config: Optional[dict] = None,
+                 md_skin: float = 0.3,
+                 ef_forward: bool = False):
         import jax
         from ..train.precision import resolve_precision
         from ..train.train_step import make_forward_fn
@@ -265,6 +286,47 @@ class InferenceEngine:
                 neighbor_k = neighbor_budget(reference_samples)
             self.neighbor_k = int(neighbor_k)
 
+        # raw-structure serving (docs/serving.md): with a structure
+        # config the engine accepts raw (positions, node_features[, cell])
+        # via submit_structure and builds the radius graph itself —
+        # trajectory clients additionally hold a structure_session()
+        # whose Verlet-skin NeighborList reuses step t's candidate list
+        # at step t+1 (graphs/neighborlist.py)
+        self._structure_cfg = structure_config
+        self.md_skin = float(md_skin)
+        if structure_config is not None:
+            s_ds = structure_config["Dataset"]
+            s_arch = structure_config["NeuralNetwork"]["Architecture"]
+            self._structure_pbc = bool(
+                s_arch.get("periodic_boundary_conditions", False))
+            self._structure_radius = float(s_arch.get("radius") or 5.0)
+            self._structure_max_nb = s_arch.get("max_neighbours")
+            self._structure_rot = bool(
+                s_ds.get("rotational_invariance", False))
+
+        # EF serving (docs/serving.md): head 0 must be a NODE-level
+        # energy head (the energy_force_loss convention, train/loss.py);
+        # responses become [energy [1], forces [num_nodes, 3]] with
+        # forces = -d(sum of masked graph energies)/d pos. Per-graph
+        # independence holds exactly as for the plain forward (each
+        # graph's energy only sees its own nodes through the masked
+        # segment pooling), so the same-bucket batched-vs-single bitwise
+        # contract carries over (tests/test_serving.py).
+        self.ef_forward = bool(ef_forward)
+        if self.ef_forward:
+            if mcfg.heads[0].head_type != "node":
+                raise ValueError(
+                    "ef_forward=True needs head 0 to be a node-level "
+                    "energy head (the energy_force_loss convention); got "
+                    f"a {mcfg.heads[0].head_type!r} head")
+            if self.num_shards > 1:
+                raise ValueError(
+                    "ef_forward serving is single-shard for now — run "
+                    "one EF engine per device instead of num_shards > 1")
+            self._response_heads = ["graph", "node"]
+        else:
+            self._response_heads = [h.head_type for h in mcfg.heads]
+
         self._variables = {"params": variables["params"],
                            "batch_stats": variables.get("batch_stats", {})}
         if self.num_shards > 1:
@@ -276,9 +338,23 @@ class InferenceEngine:
         else:
             forward = make_forward_fn(model, mcfg, compute_dtype)
 
-            def head_forward(variables, batch):
-                outputs, _ = forward(variables, batch, train=False)
-                return list(outputs)
+            if self.ef_forward:
+                from ..train.loss import energy_forces_from_node_head
+
+                def head_forward(variables, batch):
+                    # the eval forward mutates nothing; adapt to the
+                    # energy_force_loss apply contract so the served
+                    # quantity IS the trained quantity (one shared core)
+                    def apply_fn(v, b, train):
+                        return forward(v, b, train=train), None
+
+                    graph_e, forces, _ = energy_forces_from_node_head(
+                        apply_fn, variables, batch, train=False)
+                    return [graph_e, forces]
+            else:
+                def head_forward(variables, batch):
+                    outputs, _ = forward(variables, batch, train=False)
+                    return list(outputs)
 
             self._jit_forward = jax.jit(head_forward)
 
@@ -303,6 +379,14 @@ class InferenceEngine:
         self._total_edge_slots = 0  # guarded-by: _lock
         self.max_queue_depth = 0  # guarded-by: _lock
         self._latencies: List[float] = []  # guarded-by: _lock
+        # raw-structure accounting (docs/serving.md): nbr_updates counts
+        # neighbor-list builds submit_structure performed, nbr_rebuilds
+        # the full (non-incremental) ones — a session-less submit is by
+        # definition a rebuild. A scrape comparing the two tells
+        # neighbor-bound from compute-bound serving.
+        self.structure_requests = 0  # guarded-by: _lock
+        self.nbr_updates = 0  # guarded-by: _lock
+        self.nbr_rebuilds = 0  # guarded-by: _lock
         # circuit-breaker + failure accounting (all under self._lock)
         self._breaker_state = "closed"  # guarded-by: _lock — closed |
         #                                 open | half_open
@@ -343,33 +427,10 @@ class InferenceEngine:
         # under the same lock BEFORE enqueuing the sentinel, so a request
         # can never land behind the sentinel on a queue nobody drains
         with self._lock:
-            if self._closed:
-                raise RuntimeError("InferenceEngine is shut down")
-            if self._fatal is not None:
-                raise RuntimeError(
-                    "InferenceEngine dispatcher died") from self._fatal
-            breaker = self._breaker_state
-            if breaker == "half_open":
-                # exactly ONE probe at a time: its outcome decides the
-                # circuit before anyone else is admitted
-                self.circuit_rejections += 1
-                raise CircuitOpenError(
-                    "circuit half-open: probe in flight; retry shortly")
-            if breaker == "open":
-                now = time.monotonic()
-                if now < self._open_until:
-                    self.circuit_rejections += 1
-                    raise CircuitOpenError(
-                        f"circuit open after {self.trip_count} trip(s) "
-                        f"({self._consec_failures} consecutive batch "
-                        f"failures); probing in {self._open_until - now:.2f}s")
-            if self.max_queue and self._queue.qsize() >= self.max_queue:
-                self.queue_rejections += 1
-                raise QueueFullError(
-                    f"admission queue full ({self.max_queue} pending); "
-                    "retry with backoff or raise Serving.max_queue")
-            if breaker == "open":
-                # all admission checks passed: this request IS the probe
+            self._admission_check()
+            if self._breaker_state == "open":
+                # all admission checks passed (so the probe window has
+                # elapsed): this request IS the probe
                 self._breaker_state = "half_open"
             # the queue is unbounded (admission bounding is the qsize
             # check above), so this put never blocks — and it must stay
@@ -380,6 +441,158 @@ class InferenceEngine:
             depth = self._queue.qsize()
             if depth > self.max_queue_depth:
                 self.max_queue_depth = depth
+        return fut
+
+    def _require_structure(self):
+        if self._structure_cfg is None:
+            raise RuntimeError(
+                "raw-structure serving is off — construct the "
+                "InferenceEngine with structure_config=<config dict> "
+                "(Serving.structure / HYDRAGNN_SERVE_STRUCTURE wires it "
+                "through run_prediction; docs/serving.md)")
+
+    # the ONE copy of the fast-fail admission checks, shared by submit()
+    # (authoritative) and the submit_structure precheck. Read-only: the
+    # open -> half_open probe reservation stays with submit() — a
+    # precheck reserving the probe would make the later authoritative
+    # check reject its own request. An open breaker whose window elapsed
+    # passes (that request may become the probe).
+    # holds-lock: _lock
+    def _admission_check(self) -> None:
+        if self._closed:
+            raise RuntimeError("InferenceEngine is shut down")
+        if self._fatal is not None:
+            raise RuntimeError(
+                "InferenceEngine dispatcher died") from self._fatal
+        if self._breaker_state == "half_open":
+            # exactly ONE probe at a time: its outcome decides the
+            # circuit before anyone else is admitted
+            self.circuit_rejections += 1
+            raise CircuitOpenError(
+                "circuit half-open: probe in flight; retry shortly")
+        if self._breaker_state == "open":
+            now = time.monotonic()
+            if now < self._open_until:
+                self.circuit_rejections += 1
+                raise CircuitOpenError(
+                    f"circuit open after {self.trip_count} trip(s) "
+                    f"({self._consec_failures} consecutive batch "
+                    f"failures); probing in {self._open_until - now:.2f}s")
+        if self.max_queue and self._queue.qsize() >= self.max_queue:
+            self.queue_rejections += 1
+            raise QueueFullError(
+                f"admission queue full ({self.max_queue} pending); "
+                "retry with backoff or raise Serving.max_queue")
+
+    def _shed_structure_load(self) -> None:
+        """Admission precheck for submit_structure: fast-fail BEFORE the
+        host-side neighbor update and graph build so load shedding sheds
+        the host work too (submit() re-checks authoritatively)."""
+        with self._lock:
+            self._admission_check()
+
+    def structure_session(self, skin: Optional[float] = None
+                          ) -> "StructureSession":
+        """A trajectory client's neighbor-list handle: submit_structure
+        calls carrying this session reuse one Verlet-skin NeighborList
+        (cutoff/max_neighbours/PBC from the structure config, skin from
+        `md_skin` unless overridden), so step t+1 re-filters step t's
+        candidate cache instead of rebuilding the cell list. One session
+        per SEQUENTIAL client — the neighbor list is stateful and not
+        thread-safe; concurrent trajectories each open their own."""
+        self._require_structure()
+        if self._structure_rot:
+            raise ValueError(
+                "trajectory sessions need Dataset.rotational_invariance "
+                "off — the incremental neighbor list tracks displacements "
+                "in the raw frame, per-step rotation normalization would "
+                "invalidate them")
+        from ..graphs.neighborlist import NeighborList
+        return StructureSession(NeighborList(
+            self._structure_radius,
+            self.md_skin if skin is None else float(skin),
+            max_neighbours=self._structure_max_nb,
+            pbc=(True, True, True) if self._structure_pbc else None))
+
+    def submit_structure(self, positions, node_features=None, cell=None,
+                         graph_feats=None,
+                         session: Optional["StructureSession"] = None,
+                         deadline_ms: Optional[float] = None) -> Future:
+        """Raw-structure request: structure -> radius graph ->
+        build_graph_sample -> the bucketed batched forward, one call
+        (docs/serving.md). `positions` may be a `serving.config.Structure`
+        (then the remaining schema arguments come from it). Without a
+        `session` every call builds the graph fresh; with one, the
+        session's Verlet-skin NeighborList re-filters its candidate
+        cache and only rebuilds past the skin/2 displacement bound —
+        either way the edges are bitwise the fresh build's (PR 5 total
+        order). The returned future carries `.rebuilt` and
+        `.graph_build_ms` breadcrumbs next to the usual `.bucket`."""
+        self._require_structure()
+        # load shedding must shed the HOST work too: a read-only
+        # admission precheck fast-fails an open breaker / full queue /
+        # shutdown BEFORE the neighbor update and graph build (submit()
+        # below remains the authoritative, state-transitioning check)
+        self._shed_structure_load()
+        if isinstance(positions, Structure):
+            struct = positions
+            positions = struct.positions
+            # explicit keyword arguments override the Structure's
+            # fields, uniformly across the schema
+            node_features = (struct.node_features if node_features is None
+                             else node_features)
+            cell = struct.cell if cell is None else cell
+            graph_feats = (struct.graph_feats if graph_feats is None
+                           else graph_feats)
+        if node_features is None:
+            raise ValueError(
+                "submit_structure needs node_features (the "
+                "Dataset.node_features layout; target columns may be "
+                "zero-filled)")
+        from ..preprocess.transforms import build_graph_sample
+        t0 = _spans.now()
+        pos = np.asarray(positions, dtype=np.float64)
+        edges = None
+        rebuilt = True
+        if session is not None:
+            send, recv, shifts, rebuilt = session.nlist.update(
+                pos, cell=cell if self._structure_pbc else None)
+            edges = (send, recv, shifts)
+        sample = build_graph_sample(
+            np.asarray(node_features, dtype=np.float32), pos,
+            self._structure_cfg, graph_feats=graph_feats, cell=cell,
+            edges=edges, with_targets=False)
+        build_s = _spans.now() - t0
+        rec = _spans.current_recorder()
+        if rec is not None:
+            rec.add("serve.graph_build", t0, build_s, "serving",
+                    {"rebuilt": bool(rebuilt),
+                     "incremental": session is not None,
+                     "edges": int(sample.num_edges)})
+        with self._lock:
+            self.structure_requests += 1
+            self.nbr_updates += 1
+            if rebuilt:
+                self.nbr_rebuilds += 1
+            updates, rebuilds = self.nbr_updates, self.nbr_rebuilds
+        # registry reporting (docs/observability.md): two O(1) dict
+        # updates under the registry lock per request — the same cost
+        # class as the engine's own counters
+        reg = get_registry()
+        reg.counter_inc("serve.nbr_updates_total",
+                        help="neighbor-list updates by submit_structure")
+        if rebuilt:
+            reg.counter_inc(
+                "serve.nbr_rebuilds_total",
+                help="full neighbor-list rebuilds (non-incremental "
+                     "updates) by submit_structure")
+        reg.gauge_set("serve.nbr_rebuild_fraction", rebuilds / updates,
+                      help="rebuilds over neighbor-list updates since "
+                           "engine start")
+        fut = self.submit(sample, deadline_ms=deadline_ms)
+        fut.rebuilt = bool(rebuilt)  # breadcrumbs beside `.bucket`: did
+        fut.graph_build_ms = build_s * 1e3  # this step rebuild, and what
+        # the host-side structure -> graph stage cost
         return fut
 
     def health(self) -> dict:
@@ -398,6 +611,12 @@ class InferenceEngine:
                 "queue_rejections": self.queue_rejections,
                 "circuit_rejections": self.circuit_rejections,
                 "requests_done": self.requests_done,
+                "structure_requests": self.structure_requests,
+                "nbr_updates": self.nbr_updates,
+                "nbr_rebuilds": self.nbr_rebuilds,
+                "nbr_rebuild_fraction": (
+                    self.nbr_rebuilds / self.nbr_updates
+                    if self.nbr_updates else 0.0),
                 "dispatcher_alive": self._dispatcher.is_alive(),
             }
 
@@ -492,6 +711,9 @@ class InferenceEngine:
             self._total_edge_slots = 0
             self.max_queue_depth = 0
             self._latencies = []
+            self.structure_requests = 0
+            self.nbr_updates = 0
+            self.nbr_rebuilds = 0
 
     def stats(self) -> dict:
         """Service counters for bench/monitoring: batch occupancy is real
@@ -530,6 +752,12 @@ class InferenceEngine:
                 "queue_rejections": self.queue_rejections,
                 "circuit_rejections": self.circuit_rejections,
                 "trip_count": self.trip_count,
+                "structure_requests": self.structure_requests,
+                "nbr_updates": self.nbr_updates,
+                "nbr_rebuilds": self.nbr_rebuilds,
+                "nbr_rebuild_fraction": (
+                    self.nbr_rebuilds / self.nbr_updates
+                    if self.nbr_updates else 0.0),
             }
         out.update(latency_percentiles(latencies))
         return out
@@ -650,8 +878,8 @@ class InferenceEngine:
             no = s * bucket.n_node
             for i, req in enumerate(shard):
                 per_head = []
-                for ih, head in enumerate(self.mcfg.heads):
-                    if head.head_type == "graph":
+                for ih, kind in enumerate(self._response_heads):
+                    if kind == "graph":
                         per_head.append(outs[ih][g0 + i])
                     else:
                         per_head.append(outs[ih][no:no + req.n])
@@ -894,3 +1122,22 @@ class InferenceEngine:
                     self._execute(shards)
                     if leftover is not None and leftover is not _SHUTDOWN:
                         self._queue.put(leftover)
+
+
+class StructureSession:
+    """One trajectory client's raw-structure serving handle: wraps the
+    Verlet-skin NeighborList `submit_structure` consults so consecutive
+    steps of the SAME trajectory share candidate caches. Obtained from
+    `InferenceEngine.structure_session()`; use sequentially from one
+    client (the neighbor list is stateful and not thread-safe)."""
+
+    __slots__ = ("nlist",)
+
+    def __init__(self, nlist):
+        self.nlist = nlist
+
+    @property
+    def rebuild_fraction(self) -> float:
+        """Rebuilds over updates for THIS trajectory (the engine-wide
+        fraction aggregates every client)."""
+        return self.nlist.rebuild_fraction

@@ -55,6 +55,14 @@ def engine_prometheus(engine, registry: Optional[MetricsRegistry] = None
          "submits fast-failed by the open breaker"),
         ("serving_breaker_trips_total", stats["trip_count"],
          "circuit-breaker open transitions"),
+        # raw-structure serving (docs/serving.md): rebuilds vs updates
+        # is the neighbor-bound-vs-compute-bound discriminator
+        ("serving_structure_requests_total", stats["structure_requests"],
+         "raw-structure requests served via submit_structure"),
+        ("serving_nbr_updates_total", stats["nbr_updates"],
+         "neighbor-list updates performed by submit_structure"),
+        ("serving_nbr_rebuilds_total", stats["nbr_rebuilds"],
+         "full (non-incremental) neighbor-list rebuilds"),
     )
     for name, value, help_text in counters:
         scrape.counter_inc(name, float(value), help=help_text)
@@ -75,6 +83,8 @@ def engine_prometheus(engine, registry: Optional[MetricsRegistry] = None
          "bucket ladder length"),
         ("serving_dispatcher_alive", float(health["dispatcher_alive"]),
          "1 while the dispatcher thread is live"),
+        ("serving_nbr_rebuild_fraction", stats["nbr_rebuild_fraction"],
+         "neighbor-list rebuilds over updates since engine start"),
     )
     for name, value, help_text in gauges:
         scrape.gauge_set(name, float(value), help=help_text)

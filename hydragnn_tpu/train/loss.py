@@ -68,6 +68,35 @@ def auto_force_weight(energy, forces, graph_mask, node_mask,
     return energy_weight * e_mean / (f_mean + 1e-8)
 
 
+def energy_forces_from_node_head(apply_fn: Callable, variables, batch,
+                                 train: bool = False):
+    """(graph_energies [G, 1], forces [N, 3], new_batch_stats) from a
+    node-level energy head — THE EF-head convention, in one place: head
+    0's first column is the per-node energy, graph energy is its masked
+    segment sum, and forces are -d(sum of real-graph energies)/d pos.
+    Shared by `energy_force_loss` (training/eval) and the serving
+    engine's ``ef_forward`` mode (docs/serving.md), so the quantity the
+    model is trained on and the quantity it serves can never drift.
+
+    ``apply_fn(variables, batch, train) -> ((outputs, outputs_var),
+    new_batch_stats_or_None)`` — the `energy_force_loss` apply contract.
+    """
+    def total_energy(pos):
+        b = batch.replace(pos=pos)
+        (outputs, _), new_bs = apply_fn(variables, b, train=train)
+        node_e = outputs[0][:, :1]
+        graph_e = global_sum_pool(node_e, b.node_graph, b.num_graphs,
+                                  b.node_mask)
+        # sum over real graphs only; padding contributes zero by masking
+        return (jnp.sum(jnp.where(batch.graph_mask[:, None], graph_e,
+                                  0.0)),
+                (graph_e, new_bs))
+
+    (_, (graph_e, new_bs)), neg_forces = jax.value_and_grad(
+        total_energy, has_aux=True)(batch.pos)
+    return graph_e, -neg_forces, new_bs
+
+
 def energy_force_loss(apply_fn: Callable, variables, cfg: ModelConfig,
                       batch: GraphBatch, loss_name: str = "mae",
                       energy_weight: float = 1.0, force_weight: float = 1.0,
@@ -84,18 +113,8 @@ def energy_force_loss(apply_fn: Callable, variables, cfg: ModelConfig,
     this path too — silently freezing them at init makes eval-mode
     normalization diverge from what training fit). Returned in the aux
     dict under "batch_stats"."""
-    def total_energy(pos):
-        b = batch.replace(pos=pos)
-        (outputs, _), new_bs = apply_fn(variables, b, train=train)
-        node_e = outputs[0][:, :1]
-        graph_e = global_sum_pool(node_e, b.node_graph, b.num_graphs, b.node_mask)
-        # sum over real graphs only; padding contributes zero by masking
-        return (jnp.sum(jnp.where(batch.graph_mask[:, None], graph_e, 0.0)),
-                (graph_e, new_bs))
-
-    (tot_e, (graph_e, new_bs)), neg_forces = jax.value_and_grad(
-        total_energy, has_aux=True)(batch.pos)
-    forces_pred = -neg_forces
+    graph_e, forces_pred, new_bs = energy_forces_from_node_head(
+        apply_fn, variables, batch, train=train)
 
     e_loss = masked_loss(loss_name, graph_e, batch.energy, batch.graph_mask)
     f_loss = masked_loss(loss_name, forces_pred, batch.forces, batch.node_mask)

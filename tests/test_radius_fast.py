@@ -90,7 +90,8 @@ class TestOpenBoundaryOracle:
             adj = d2 <= 0.7 * 0.7
             np.fill_diagonal(adj, False)
             rc, sd = np.nonzero(adj)  # the dense reference, row-major
-            s2, r2 = _cell_list_pairs(pos.astype(np.float64), 0.7, False)
+            s2, r2, _ = _cell_list_pairs(pos.astype(np.float64), 0.7,
+                                           False)
             np.testing.assert_array_equal(sd, s2)
             np.testing.assert_array_equal(rc, r2)
 

@@ -463,3 +463,231 @@ def test_bench_serve_load_smoke():
     # the CPU acceptance target is 3x (ISSUE 3); the CI guard is looser
     # to keep a busy shared box from flaking the lane
     assert out["speedup_vs_per_request"] >= 1.5, out
+
+
+# ------------------------------------------------- raw-structure serving
+
+@pytest.fixture(scope="module")
+def structured():
+    """A raw-structure engine: samples built THROUGH build_graph_sample
+    from the same config the engine holds, so submit_structure's
+    structure -> graph path and the prebuilt path share one schema."""
+    from hydragnn_tpu.preprocess.transforms import build_graph_sample
+    rng = np.random.RandomState(0)
+    cfg = make_config("PNA")
+    structures = []
+    for _ in range(16):
+        n = int(rng.randint(8, 16))
+        structures.append((rng.rand(n, 3).astype(np.float64) * 1.8,
+                           rng.rand(n, 3).astype(np.float32),
+                           rng.rand(1).astype(np.float32)))
+    samples = [build_graph_sample(nfm, pos, cfg, graph_feats=gf)
+               for pos, nfm, gf in structures]
+    cfg = update_config(cfg, samples)
+    mcfg = build_model_config(cfg)
+    model = create_model(mcfg)
+    variables = init_params(model, collate(samples[:4]))
+    # max_batch_size 1: trajectory-shaped traffic (one request at a
+    # time) and a single warmup compile — tier-1 budget discipline
+    eng = InferenceEngine(model, variables, mcfg,
+                          reference_samples=samples, max_batch_size=1,
+                          max_wait_ms=0.0, structure_config=cfg,
+                          md_skin=0.25)
+    eng.warmup()
+    yield structures, samples, cfg, eng
+    eng.shutdown()
+
+
+def test_submit_structure_matches_prebuilt_submit(structured):
+    """structure -> graph -> forward in one call == building the sample
+    offline and submitting it, bitwise; futures carry the .rebuilt /
+    .graph_build_ms breadcrumbs next to .bucket."""
+    from hydragnn_tpu.preprocess.transforms import build_graph_sample
+    structures, _, cfg, eng = structured
+    for pos, nfm, _ in structures[:4]:
+        fut = eng.submit_structure(pos, nfm)
+        res = fut.result(timeout=60)
+        sample = build_graph_sample(nfm, pos, cfg, with_targets=False)
+        ref = eng.submit(sample).result(timeout=60)
+        assert all(np.array_equal(a, b) for a, b in zip(res, ref))
+        assert fut.rebuilt is True  # session-less = fresh build
+        assert fut.graph_build_ms >= 0.0
+        assert fut.bucket in eng.buckets
+
+
+def test_structure_schema_object(structured):
+    from hydragnn_tpu.serving.config import Structure
+    structures, _, _, eng = structured
+    pos, nfm, _ = structures[0]
+    a = eng.submit_structure(Structure(positions=pos,
+                                       node_features=nfm)).result(60)
+    b = eng.submit_structure(pos, nfm).result(60)
+    assert all(np.array_equal(x, y) for x, y in zip(a, b))
+    with pytest.raises(ValueError, match="node_features"):
+        eng.submit_structure(pos)
+
+
+def test_structure_session_incremental_bitwise(structured):
+    """A trajectory session reuses its Verlet-skin list (rebuilds <
+    steps), marks the futures accordingly, and every step's outputs
+    equal the session-less fresh-build path bitwise."""
+    structures, _, _, eng = structured
+    rng = np.random.RandomState(1)
+    pos, nfm, _ = structures[0]
+    pos = pos.copy()
+    sess = eng.structure_session()
+    rebuilds = 0
+    for step in range(8):
+        pos = pos + rng.randn(*pos.shape) * 0.004
+        fut = eng.submit_structure(pos, nfm, session=sess)
+        res = fut.result(timeout=60)
+        fresh = eng.submit_structure(pos, nfm).result(timeout=60)
+        assert all(np.array_equal(a, b) for a, b in zip(res, fresh)), step
+        rebuilds += int(fut.rebuilt)
+    assert rebuilds < 8, "session never reused its candidate cache"
+    assert sess.rebuild_fraction < 1.0
+    assert sess.nlist.updates == 8
+
+
+def test_structure_requires_config(served):
+    samples, _, mcfg, model, variables = served
+    eng = InferenceEngine(model, variables, mcfg,
+                          reference_samples=samples, max_batch_size=2,
+                          max_wait_ms=0.0)
+    try:
+        with pytest.raises(RuntimeError, match="structure_config"):
+            eng.submit_structure(np.zeros((4, 3)), np.zeros((4, 1)))
+        with pytest.raises(RuntimeError, match="structure_config"):
+            eng.structure_session()
+    finally:
+        eng.shutdown()
+
+
+def test_structure_session_rejects_rotational_invariance(structured):
+    import copy as _copy
+    structures, samples, cfg, _ = structured
+    rcfg = _copy.deepcopy(cfg)
+    rcfg["Dataset"]["rotational_invariance"] = True
+    mcfg = build_model_config(rcfg)
+    model = create_model(mcfg)
+    variables = init_params(model, collate(samples[:4]))
+    eng = InferenceEngine(model, variables, mcfg,
+                          reference_samples=samples, max_batch_size=2,
+                          max_wait_ms=0.0, structure_config=rcfg)
+    try:
+        with pytest.raises(ValueError, match="rotational_invariance"):
+            eng.structure_session()
+    finally:
+        eng.shutdown()
+
+
+def test_structure_counters_health_metrics_registry(structured):
+    """Rebuild counts flow everywhere a monitor looks: health(),
+    stats(), the /metrics exposition, and the process registry
+    (serve.nbr_rebuilds_total + the rebuild-fraction gauge)."""
+    from hydragnn_tpu.telemetry.http import engine_prometheus
+    from hydragnn_tpu.telemetry.registry import get_registry
+    structures, _, _, eng = structured
+    rng = np.random.RandomState(2)
+    pos, nfm, _ = structures[1]
+    pos = pos.copy()
+    eng.reset_stats()
+    sess = eng.structure_session()
+    for _ in range(5):
+        pos = pos + rng.randn(*pos.shape) * 0.003
+        eng.submit_structure(pos, nfm, session=sess).result(timeout=60)
+    h = eng.health()
+    assert h["structure_requests"] == 5
+    assert h["nbr_updates"] == 5
+    assert 1 <= h["nbr_rebuilds"] < 5
+    assert 0.0 < h["nbr_rebuild_fraction"] < 1.0
+    st = eng.stats()
+    assert st["nbr_rebuilds"] == h["nbr_rebuilds"]
+    text = engine_prometheus(eng)
+    assert "hydragnn_serving_nbr_rebuilds_total" in text
+    assert "hydragnn_serving_nbr_rebuild_fraction" in text
+    assert "hydragnn_serving_structure_requests_total" in text
+    snap = get_registry().snapshot()
+    assert "serve.nbr_rebuilds_total" in snap
+    assert "serve.nbr_updates_total" in snap
+    assert "serve.nbr_rebuild_fraction" in snap
+
+
+@pytest.mark.slow
+def test_ef_forward_serving(served):
+    """ef_forward engine: responses become [energy [1], forces [n, 3]]
+    with forces = -dE/dpos of the node-energy head — bitwise equal to
+    the same computation run directly, and to forward_single on the
+    batch's bucket (the same-bucket contract extends to EF mode)."""
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.ops.segment import global_sum_pool
+    from hydragnn_tpu.train.train_step import make_forward_fn
+    samples = deterministic_graph_dataset(num_configs=12, heads=("node",))
+    cfg = make_config("SchNet", heads=("node",))
+    cfg = update_config(cfg, samples)
+    mcfg = build_model_config(cfg)
+    model = create_model(mcfg)
+    variables = init_params(model, collate(samples[:4]))
+    eng = InferenceEngine(model, variables, mcfg,
+                          reference_samples=samples, max_batch_size=4,
+                          max_wait_ms=5.0, ef_forward=True)
+    try:
+        eng.warmup()
+        futs = [eng.submit(s) for s in samples[:3]]
+        results = [f.result(timeout=120) for f in futs]
+        for s, res in zip(samples[:3], results):
+            assert res[0].shape == (1,)
+            assert res[1].shape == (s.num_nodes, 3)
+        # same-bucket single-request parity, EF mode
+        ref = eng.forward_single(samples[0], bucket=futs[0].bucket)
+        assert all(np.array_equal(a, b) for a, b in zip(results[0], ref))
+
+        # direct reference computation on the padded batch
+        bucket = futs[0].bucket
+        batch = eng._collate_bucket([samples[0]], bucket)
+        forward = make_forward_fn(model, mcfg, "float32")
+
+        def total_energy(p):
+            b = batch.replace(pos=p)
+            outputs, _ = forward(eng._variables, b, train=False)
+            ge = global_sum_pool(outputs[0][:, :1], b.node_graph,
+                                 b.num_graphs, b.node_mask)
+            return (jnp.sum(jnp.where(b.graph_mask[:, None], ge, 0.0)),
+                    ge)
+
+        (_, ge), neg = jax.jit(jax.value_and_grad(
+            total_energy, has_aux=True))(batch.pos)
+        np.testing.assert_array_equal(results[0][0], np.asarray(ge)[0])
+        np.testing.assert_array_equal(
+            results[0][1], np.asarray(-neg)[:samples[0].num_nodes])
+    finally:
+        eng.shutdown()
+
+
+def test_ef_forward_requires_node_head(served):
+    samples, _, mcfg, model, variables = served  # head 0 is graph-level
+    with pytest.raises(ValueError, match="node-level energy head"):
+        InferenceEngine(model, variables, mcfg,
+                        reference_samples=samples, ef_forward=True)
+
+
+def test_resolve_serving_structure_knobs(monkeypatch):
+    cfg = {"Serving": {"structure": True, "md_skin": 0.5}}
+    s = resolve_serving(cfg)
+    assert s.structure is True and s.md_skin == 0.5
+    monkeypatch.setenv("HYDRAGNN_SERVE_STRUCTURE", "0")
+    monkeypatch.setenv("HYDRAGNN_MD_SKIN", "0.75")
+    s = resolve_serving(cfg)
+    assert s.structure is False and s.md_skin == 0.75
+    # strict parsing: a typo warns and keeps the config value
+    monkeypatch.setenv("HYDRAGNN_SERVE_STRUCTURE", "ture")
+    monkeypatch.setenv("HYDRAGNN_MD_SKIN", "wide")
+    s = resolve_serving(cfg)
+    assert s.structure is True and s.md_skin == 0.5
+    # without a config block the typo values fall back to the defaults
+    s = resolve_serving(None)
+    assert s.structure is False and s.md_skin == 0.3
+    monkeypatch.setenv("HYDRAGNN_MD_SKIN", "0.75")
+    assert resolve_serving(None).md_skin == 0.75

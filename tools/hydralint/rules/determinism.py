@@ -8,7 +8,10 @@ set iteration order follows the per-process hash seed, and directory
 order follows the filesystem. Both are exactly the hazards the PR 5
 neighbor total-order and PR 2 global pack plan were built to shut out.
 
-Checked, in ``graphs/``, ``preprocess/``, ``datasets/``, ``parallel/``:
+Checked, in ``graphs/``, ``preprocess/``, ``datasets/``, ``parallel/``,
+and ``serving/`` (the raw-structure serving path made edge order a
+SERVING contract — submit_structure promises bitwise the PR 5 fresh-build
+edges, so the same ordering hazards apply there):
 
 * a set expression (literal ``{...}``, ``set(...)``/``frozenset(...)``,
   set comprehension) used as the iterable of a ``for`` loop or a
@@ -29,7 +32,8 @@ from typing import Dict, List, Tuple
 from ..engine import Finding, Rule
 
 SCOPE_DIRS = ("hydragnn_tpu/graphs/", "hydragnn_tpu/preprocess/",
-              "hydragnn_tpu/datasets/", "hydragnn_tpu/parallel/")
+              "hydragnn_tpu/datasets/", "hydragnn_tpu/parallel/",
+              "hydragnn_tpu/serving/")
 
 _FS_OS = ("listdir", "scandir")
 _FS_GLOB = ("glob", "iglob")
