@@ -174,6 +174,40 @@ Env knobs:
                        vs per-step drift sets the rebuild fraction
   BENCH_MD_OUT         also write the MD JSON to this path (the nightly
                        md-bench emits BENCH_MD.json)
+  BENCH_MD_FARM        =1: massively-batched MD-farm mode (docs/serving.md
+                       "MD farm", ROADMAP item 3 scale-out) — the
+                       device-resident trajectory farm
+                       (hydragnn_tpu/md/farm.py) over 1 vs 64 vs 1024
+                       concurrent trajectories of one tiny LJ system:
+                       aggregate steps/s per trajectory count, rebuild
+                       fraction, steps-per-dispatch, the first
+                       trajectories adjudicated BITWISE against the
+                       PR 10 single-session submit_structure loop, and
+                       trajectory 0 adjudicated bitwise ACROSS farm
+                       widths. Forces JAX_ENABLE_X64 (the farm's grid
+                       integrator is f64) and the shared CPU
+                       host-thread pinning. All BENCH_MD_FARM_* values
+                       parse via the strict env helpers.
+  BENCH_MD_FARM_ATOMS / BENCH_MD_FARM_STEPS / BENCH_MD_FARM_HIDDEN
+                       farm-mode scale (default 8 atoms — rounded to a
+                       cube — / 64 steps / hidden 4): the
+                       near-identical tiny-systems screening shape
+                       (FlashSchNet's regime) where per-dispatch
+                       overhead, not per-trajectory compute, is the
+                       cost to amortize
+  BENCH_MD_FARM_SKIN / BENCH_MD_FARM_DT / BENCH_MD_FARM_TEMP /
+  BENCH_MD_FARM_RADIUS / BENCH_MD_FARM_LATTICE / BENCH_MD_FARM_CAP
+                       trajectory physics (default skin 0.3 / dt 0.004 /
+                       T 0.3 / cutoff 1.2 / lattice 1.0 / cap 6)
+  BENCH_MD_FARM_TRAJ   comma-separated trajectory counts
+                       (default "1,64,1024")
+  BENCH_MD_FARM_CHECK_TRAJ
+                       how many trajectories to adjudicate against the
+                       single-session loop (default 2)
+  HYDRAGNN_MD_FARM_STEPS_PER_DISPATCH / HYDRAGNN_MD_FARM_CAND_HEADROOM
+                       farm knobs (serving/config.resolve_md_farm)
+  BENCH_MD_FARM_OUT    also write the farm JSON to this path (the
+                       nightly md-farm-bench emits BENCH_MD_FARM.json)
 """
 import itertools
 import json
@@ -949,6 +983,195 @@ def run_bench_md(backend=None):
         "compile_count_after_warmup": compiles_after_warmup,
     }
     out_path = (env_str("BENCH_MD_OUT") or "").strip()
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1)
+    return out
+
+
+def run_bench_md_farm(backend=None):
+    """BENCH_MD_FARM: the massively-batched on-device trajectory farm
+    (hydragnn_tpu/md/farm.py) vs trajectory count, adjudicated bitwise
+    against the single-session serving loop.
+
+    The shape is deliberately the opposite of BENCH_MD's: BENCH_MD runs
+    ONE big system (1728 atoms) where neighbor construction dominates;
+    the farm mode runs MANY tiny near-identical systems (the
+    screening/sampling regime FlashSchNet targets) where the per-step
+    fixed cost — engine round-trip, XLA dispatch, host python — is what
+    batching amortizes. Aggregate steps/s must therefore SCALE with the
+    trajectory count; the committed artifact pins 1 vs 64 vs 1024.
+
+    Adjudications: the first BENCH_MD_FARM_CHECK_TRAJ trajectories of
+    every farm width are replayed through the PR 10 single-session
+    `run_md` incremental loop from identical initial conditions —
+    final positions, velocities, and first/last energies must match
+    BITWISE (the md/integrator.py grid contract end to end); and
+    trajectory 0 must be bitwise-identical ACROSS farm widths (the
+    vmapped program may not depend on who else is in the batch)."""
+    from examples.md_loop.md_loop import (init_lattice, lj_md_config,
+                                          maxwell_velocities, md_buckets,
+                                          run_md)
+    from hydragnn_tpu.config import build_model_config, update_config
+    from hydragnn_tpu.graphs.batch import collate
+    from hydragnn_tpu.models.create import create_model, init_params
+    from hydragnn_tpu.preprocess.transforms import build_graph_sample
+    from hydragnn_tpu.serving.engine import InferenceEngine
+    from hydragnn_tpu.serving.config import resolve_md_farm
+    from hydragnn_tpu.utils.envflags import (env_str, env_strict_float,
+                                             env_strict_int)
+
+    if backend is None:
+        backend = _resolve_backend_and_cache()
+    atoms = env_strict_int("BENCH_MD_FARM_ATOMS", 8)
+    apd = max(int(round(float(atoms) ** (1.0 / 3.0))), 2)
+    steps = env_strict_int("BENCH_MD_FARM_STEPS", 64)
+    hidden = env_strict_int("BENCH_MD_FARM_HIDDEN", 4)
+    skin = env_strict_float("BENCH_MD_FARM_SKIN", 0.3)
+    dt = env_strict_float("BENCH_MD_FARM_DT", 0.004)
+    temp = env_strict_float("BENCH_MD_FARM_TEMP", 0.3)
+    radius = env_strict_float("BENCH_MD_FARM_RADIUS", 1.2)
+    lattice = env_strict_float("BENCH_MD_FARM_LATTICE", 1.0)
+    cap = env_strict_int("BENCH_MD_FARM_CAP", 6)
+    cap = cap if cap and cap > 0 else None
+    check_traj = env_strict_int("BENCH_MD_FARM_CHECK_TRAJ", 2)
+    traj_spec = env_str("BENCH_MD_FARM_TRAJ", "1,64,1024")
+    try:
+        traj_counts = [int(v) for v in traj_spec.split(",") if v.strip()]
+    except ValueError:
+        traj_counts = []
+    if not traj_counts or any(c < 1 for c in traj_counts):
+        # same warn-and-default contract as the strict env helpers
+        print(f"# BENCH_MD_FARM_TRAJ={traj_spec!r} is not a "
+              "comma-separated list of positive ints; using 1,64,1024",
+              file=sys.stderr)
+        traj_counts = [1, 64, 1024]
+    knobs = resolve_md_farm()
+
+    cfg = lj_md_config(radius=radius, max_neighbours=cap,
+                       hidden_dim=hidden, num_conv_layers=1,
+                       num_gaussians=8)
+    pos0, cell = init_lattice(apd, lattice, jitter=0.03, seed=1)
+    n = pos0.shape[0]
+    node_features = np.ones((n, 1), np.float32)
+    frame0 = build_graph_sample(node_features, pos0, cfg, cell=cell,
+                                with_targets=False)
+    ucfg = update_config(cfg, [frame0])
+    mcfg = build_model_config(ucfg)
+    model = create_model(mcfg)
+    variables = init_params(model, collate([frame0]))
+    engine = InferenceEngine(
+        model, variables, mcfg, buckets=md_buckets(n, frame0.num_edges),
+        proto_sample=frame0, max_batch_size=1, max_wait_ms=0.0,
+        structure_config=ucfg, md_skin=skin, ef_forward=True)
+    engine.warmup()
+
+    def initial_conditions(count):
+        # trajectory t's initial conditions depend only on t, so every
+        # width shares prefixes — the cross-width adjudication's anchor
+        p = np.stack([init_lattice(apd, lattice, jitter=0.03,
+                                   seed=100 + t)[0] for t in range(count)])
+        v = np.stack([maxwell_velocities(n, temp, seed=200 + t)
+                      for t in range(count)])
+        return p, v
+
+    rows = {}
+    finals = {}
+    try:
+        for count in traj_counts:
+            pos_t, vel_t = initial_conditions(count)
+            farm = engine.trajectory_farm(dt=dt, skin=skin)
+            r = farm.run(pos_t, vel_t, steps,
+                         node_features=node_features, cell=cell)
+            finals[count] = r
+            rows[str(count)] = {
+                "aggregate_steps_per_s": r["aggregate_steps_per_s"],
+                "per_traj_steps_per_s": r["per_traj_steps_per_s"],
+                "wall_s": r["wall_s"],
+                "dispatches": r["dispatches"],
+                "steps_per_dispatch_effective":
+                    r["steps_per_dispatch_effective"],
+                "rebuild_swaps": r["rebuild_swaps"],
+                "rebuild_fraction": r["rebuild_fraction"],
+                "cand_capacity": r["cand_capacity"],
+            }
+
+        # adjudication 1: farm TRAJECTORIES (positions + velocities) ==
+        # the PR 10 single-session loop, bitwise, from identical initial
+        # conditions. The scalar energy READOUT is adjudicated to a
+        # tight tolerance instead: the batched masked segment-sum
+        # pooling may reassociate in the last ulp at large widths
+        # (measured at T=64), while the trajectory stays exact — a sum's
+        # backward is a cotangent broadcast, so the FORCES that drive
+        # the integrator carry no reduction at all (docs/serving.md).
+        pos_c, vel_c = initial_conditions(
+            max(1, min(check_traj, max(traj_counts))))
+        session_equal = True
+        session_checked = 0
+        energy_rel_err = 0.0
+        for c in range(pos_c.shape[0]):
+            seq = run_md(engine, ucfg, pos_c[c], vel_c[c], cell,
+                         node_features, steps=steps, dt=dt,
+                         mode="incremental", skin=skin)
+            for count, r in finals.items():
+                if c >= count:
+                    continue
+                session_checked += 1
+                session_equal &= (
+                    np.array_equal(r["final_pos"][c], seq["final_pos"])
+                    and np.array_equal(r["final_vel"][c],
+                                       seq["final_vel"]))
+                for farm_e, seq_e in ((r["energy_first"][c],
+                                       seq["energy_first"]),
+                                      (r["energy_last"][c],
+                                       seq["energy_last"])):
+                    denom = max(abs(seq_e), 1e-30)
+                    energy_rel_err = max(energy_rel_err,
+                                         abs(float(farm_e) - seq_e)
+                                         / denom)
+
+        # adjudication 2: trajectory 0 bitwise-identical across widths
+        widths = sorted(finals)
+        cross_equal = all(
+            np.array_equal(finals[widths[0]]["final_pos"][0],
+                           finals[w]["final_pos"][0])
+            and np.array_equal(finals[widths[0]]["final_vel"][0],
+                               finals[w]["final_vel"][0])
+            for w in widths[1:])
+    finally:
+        engine.shutdown()
+
+    base = rows[str(traj_counts[0])]  # the first listed count (1 by
+    # default) anchors the scaling ratios
+    scaling = {
+        str(c): (round(rows[str(c)]["aggregate_steps_per_s"]
+                       / base["aggregate_steps_per_s"], 2)
+                 if base["aggregate_steps_per_s"] else None)
+        for c in traj_counts}
+    top = str(max(traj_counts))
+    out = {
+        "metric": "md_farm_aggregate_steps_per_sec",
+        "value": rows[top]["aggregate_steps_per_s"],
+        "unit": "steps/s",
+        "vs_baseline": None,
+        "backend": backend,
+        "shape": {"atoms": n, "edges_first_frame": int(frame0.num_edges),
+                  "radius": radius, "skin": skin, "dt": dt,
+                  "temperature": temp, "lattice": lattice, "steps": steps,
+                  "hidden": hidden, "max_neighbours": cap,
+                  "trajectory_counts": traj_counts,
+                  "steps_per_dispatch": knobs.steps_per_dispatch,
+                  "cand_headroom": knobs.cand_headroom,
+                  "model": "SchNet", "pbc": True, "ef_forward": True},
+        "trajectories": rows,
+        "aggregate_scaling_vs_first": scaling,
+        "farm_vs_session_bitwise": bool(session_equal),
+        "farm_vs_session_trajectories_checked": session_checked,
+        "farm_vs_session_energy_rel_err": energy_rel_err,
+        "farm_vs_session_energy_within_tol": bool(energy_rel_err <= 1e-9),
+        "cross_width_bitwise": bool(cross_equal),
+    }
+    out_path = (env_str("BENCH_MD_FARM_OUT") or "").strip()
     if out_path:
         with open(out_path, "w") as f:
             json.dump(out, f, indent=1)
@@ -1896,6 +2119,23 @@ def sweep():
     return best
 
 
+def _pin_cpu_host_threads():
+    """Shared CPU preamble for the MD modes (BENCH_MD, BENCH_MD_FARM):
+    the closed loops ping-pong between single-threaded host numpy
+    (neighbor lists, cache packing) and the XLA forward; XLA's spinning
+    Eigen pool steals the cores from the host stages in between, so pin
+    it to one thread BEFORE jax initializes. No effect on a real
+    accelerator backend (the forward runs on-chip), and one shared
+    helper so the farm's CPU numbers are measured under exactly the
+    BENCH_MD contention regime rather than a drifted copy of it."""
+    if "cpu" in (os.environ.get("JAX_PLATFORMS") or ""):
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_cpu_multi_thread_eigen" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_cpu_multi_thread_eigen=false"
+                " intra_op_parallelism_threads=1").strip()
+
+
 def main():
     if os.environ.get("BENCH_SWEEP") == "1":
         out = sweep()
@@ -1904,18 +2144,16 @@ def main():
     elif os.environ.get("BENCH_FAULTS") == "1":
         out = run_bench_faults()
     elif os.environ.get("BENCH_MD") == "1":
-        # on CPU the closed loop ping-pongs between single-threaded host
-        # numpy (neighbor lists) and the XLA forward; XLA's spinning
-        # Eigen pool steals the cores from the host stages in between,
-        # so pin it to one thread BEFORE jax initializes (no effect on a
-        # real accelerator backend — the loop's forward runs on-chip)
-        if "cpu" in (os.environ.get("JAX_PLATFORMS") or ""):
-            flags = os.environ.get("XLA_FLAGS", "")
-            if "xla_cpu_multi_thread_eigen" not in flags:
-                os.environ["XLA_FLAGS"] = (
-                    flags + " --xla_cpu_multi_thread_eigen=false"
-                    " intra_op_parallelism_threads=1").strip()
+        _pin_cpu_host_threads()
         out = run_bench_md()
+    elif os.environ.get("BENCH_MD_FARM") == "1":
+        _pin_cpu_host_threads()
+        # the farm's grid integrator carries f64 state, and the
+        # farm-vs-session bitwise adjudication needs the SESSION engine
+        # traced under the same x64 semantics — set it before jax
+        # initializes (docs/serving.md "MD farm")
+        os.environ["JAX_ENABLE_X64"] = "1"
+        out = run_bench_md_farm()
     elif os.environ.get("BENCH_PREPROC") == "1":
         out = run_bench_preproc()
     elif os.environ.get("BENCH_KERNELS") == "1":

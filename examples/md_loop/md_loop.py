@@ -164,13 +164,22 @@ def run_md(engine, config: Dict, pos0: np.ndarray, vel0: np.ndarray,
     unwrapped (continuous), the NeighborList displacement-tracking
     contract; excursions stay tiny over a bench-length run.
 
+    Integration runs on the ``hydragnn_tpu.md.integrator`` binary grid —
+    THE shared velocity-Verlet definition: the device-resident trajectory
+    farm (hydragnn_tpu/md/farm.py, BENCH_MD_FARM) integrates with the
+    same exact-arithmetic expressions, which is what makes every farm
+    trajectory BITWISE-equal to this loop from identical initial
+    conditions (docs/serving.md "MD farm"). Initial positions/velocities
+    and the cell are snapped to the grid here, identically on both paths.
+
     Returns steps/s, rebuild fraction, the graph-build/serve time split,
     energies, and the final (pos, vel) state.
     """
+    from hydragnn_tpu.md import integrator as mdi
     from hydragnn_tpu.preprocess.transforms import build_graph_sample
-    pbc = bool(config["NeuralNetwork"]["Architecture"].get(
-        "periodic_boundary_conditions", False))
-    ccell = cell if pbc else None
+    arch = config["NeuralNetwork"]["Architecture"]
+    pbc = bool(arch.get("periodic_boundary_conditions", False))
+    ccell = mdi.quantize_cell(cell) if pbc else None
     session = None
     if mode == "incremental":
         session = engine.structure_session(skin=skin)
@@ -193,24 +202,29 @@ def run_md(engine, config: Dict, pos0: np.ndarray, vel0: np.ndarray,
         return engine.submit_structure(pos, node_features, cell=ccell,
                                        session=session)
 
-    pos = np.asarray(pos0, np.float64).copy()
-    vel = np.asarray(vel0, np.float64).copy()
+    pos, vd = mdi.init_state(pos0, vel0, dt)
+    mdi.validate_ranges(float(np.abs(pos).max(initial=0.0)),
+                        float(arch.get("radius") or 5.0)
+                        + float(skin if skin is not None
+                                else getattr(engine, "md_skin", 0.0)))
+    s_hi, s_lo = mdi.force_scale_split(dt, force_scale, mass)
     res = serve(pos).result()
-    acc = np.asarray(res[1], np.float64) * (force_scale / mass)
+    ad2 = mdi.accel_term(np.asarray(res[1], np.float32), s_hi, s_lo)
     energies = [float(np.asarray(res[0]).ravel()[0])]
     rebuilds = 0
     build_ms_sum = 0.0
     positions = []
     t_start = time.perf_counter()
     for _ in range(steps):
-        pos = pos + vel * dt + (0.5 * dt * dt) * acc
+        pos = mdi.drift(pos, vd, ad2)
         fut = serve(pos)
         res = fut.result()
         rebuilds += int(fut.rebuilt)
         build_ms_sum += fut.graph_build_ms
-        acc_new = np.asarray(res[1], np.float64) * (force_scale / mass)
-        vel = vel + (0.5 * dt) * (acc + acc_new)
-        acc = acc_new
+        ad2_new = mdi.accel_term(np.asarray(res[1], np.float32), s_hi,
+                                 s_lo)
+        vd = mdi.kick(vd, ad2, ad2_new)
+        ad2 = ad2_new
         energies.append(float(np.asarray(res[0]).ravel()[0]))
         if record_positions:
             positions.append(pos.copy())
@@ -226,7 +240,7 @@ def run_md(engine, config: Dict, pos0: np.ndarray, vel0: np.ndarray,
         "energy_first": energies[0],
         "energy_last": energies[-1],
         "final_pos": pos,
-        "final_vel": vel,
+        "final_vel": vd / dt,
     }
     if record_positions:
         out["positions"] = positions
@@ -249,10 +263,18 @@ def main():
     p.add_argument("--radius", type=float, default=2.0)
     p.add_argument("--hidden_dim", type=int, default=32)
     p.add_argument("--num_conv_layers", type=int, default=2)
+    p.add_argument("--farm", type=int, default=0, metavar="T",
+                   help="run T device-resident trajectories through the "
+                        "MD farm (docs/serving.md 'MD farm') instead of "
+                        "the single-session loop")
     p.add_argument("--cpu", action="store_true",
                    help="force CPU backend with 8 virtual devices")
     args = p.parse_args()
 
+    if args.farm > 0:
+        # the farm's grid integrator carries f64 state — enable x64
+        # before jax initializes
+        os.environ.setdefault("JAX_ENABLE_X64", "1")
     if args.cpu:
         from examples.cli_utils import setup_cpu_devices
         setup_cpu_devices()
@@ -296,8 +318,32 @@ def main():
         structure_config=completed, md_skin=args.skin, ef_forward=True)
     engine.warmup()
 
-    # 3) the MD loop
+    # 3) the MD loop — one session round-tripping per step, or a
+    # device-resident trajectory farm (docs/serving.md "MD farm")
     try:
+        if args.farm > 0:
+            pos_t = np.stack([
+                init_lattice(args.atoms_per_dim, args.lattice,
+                             jitter=0.05, seed=100 + t)[0]
+                for t in range(args.farm)])
+            vel_t = np.stack([
+                maxwell_velocities(n, args.temperature, seed=200 + t)
+                for t in range(args.farm)])
+            farm = engine.trajectory_farm(dt=args.dt, skin=args.skin)
+            stats = farm.run(pos_t, vel_t, args.steps,
+                             node_features=node_features, cell=cell)
+            print(json.dumps({
+                "atoms": n,
+                "trajectories": args.farm,
+                "aggregate_steps_per_s": stats["aggregate_steps_per_s"],
+                "rebuild_fraction": stats["rebuild_fraction"],
+                "dispatches": stats["dispatches"],
+                "steps_per_dispatch_effective":
+                    stats["steps_per_dispatch_effective"],
+                "energy_first_traj0": float(stats["energy_first"][0]),
+                "energy_last_traj0": float(stats["energy_last"][0]),
+            }, indent=1))
+            return
         stats = run_md(engine, completed, pos0, vel0, cell, node_features,
                        steps=args.steps, dt=args.dt)
         health = engine.health()

@@ -54,7 +54,7 @@ import numpy as np
 
 from .radius import (_CAP_DENSE_MAX_DEG, _CAP_DENSE_WASTE,
                      _cap_neighbours, _dense_select, _open_pairs,
-                     _pbc_pairs)
+                     _pbc_pairs, _segment_layout)
 
 _EMPTY_EDGES = (np.empty(0, np.int32), np.empty(0, np.int32))
 
@@ -85,12 +85,7 @@ class _CandidateCap:
     def __init__(self, recv: np.ndarray, k: int):
         self.k = int(k)
         n = len(recv)
-        change = np.empty(n, bool)
-        change[0] = True
-        np.not_equal(recv[1:], recv[:-1], out=change[1:])
-        self.seg_id = np.cumsum(change, dtype=np.int64) - 1
-        self.starts = np.flatnonzero(change)
-        self.idx = np.arange(n, dtype=np.int64) - self.starts[self.seg_id]
+        self.seg_id, self.starts, self.idx = _segment_layout(recv)
         self.width = int(self.idx.max()) + 1 if n else 0
         self.keep_all = self.width <= self.k
         dense = (not self.keep_all and self.width <= _CAP_DENSE_MAX_DEG
@@ -237,6 +232,34 @@ class NeighborList:
                      else _CandidateCap(recv, self.max_neighbours))
         self._scratch = None
         self._ref_pos = pos.copy()
+
+    def export_candidates(self):
+        """Snapshot of the current candidate cache for an external
+        compiled re-filter — the MD trajectory farm (md/farm.py) packs
+        this into its stacked per-trajectory device layout and re-filters
+        on-device with the same selection rule `_emit` applies here.
+
+        Returns ``(senders, receivers, offsets, cart_shifts_f32,
+        ref_pos)``: int64 candidate pair indices in the canonical
+        (receiver-major, sender[, shift-id]) order, the per-candidate
+        float64 ghost offsets (``None`` for open boundaries), the
+        per-candidate float32 cartesian shift vectors exactly as `_emit`
+        would attach them to kept edges (``None`` for open boundaries),
+        and the reference positions the displacement bound is measured
+        against. Call right after an ``update`` that rebuilt; raises if
+        no cache exists yet."""
+        if self._cand is None:
+            raise RuntimeError(
+                "export_candidates: no candidate cache — call update() "
+                "(which builds on first use) before exporting")
+        if self.pbc is None:
+            cs, cr = self._cand
+            return cs, cr, None, None, self._ref_pos
+        cs, cr, csid = self._cand
+        # row gather of a precomputed row-wise matmul == per-candidate
+        # matmul of the gathered rows: bitwise the `_emit` shift values
+        return (cs, cr, self._cand_off,
+                self._cand_off.astype(np.float32), self._ref_pos)
 
     def _cand_distances(self, pos: np.ndarray, fresh: bool) -> np.ndarray:
         """Per-candidate d² at the current positions. On the rebuild step

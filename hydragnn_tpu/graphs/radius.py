@@ -227,18 +227,35 @@ _CAP_DENSE_MAX_DEG = 2048
 _CAP_DENSE_WASTE = 8
 
 
+def _segment_layout(recv: np.ndarray):
+    """(seg_id, starts, idx) for a canonical (receiver-major) edge or
+    candidate array: contiguous-segment id per entry, segment start
+    offsets, and each entry's in-segment index. THE one bookkeeping
+    definition behind the dense cap selection — shared by
+    `_cap_canonical`, the Verlet-skin `neighborlist._CandidateCap`, and
+    the MD-farm candidate packer (md/farm.py), whose compiled re-filter
+    must scatter candidates into exactly the rows/slots the host
+    selection uses."""
+    n = len(recv)
+    if n == 0:
+        return (np.empty(0, np.int64), np.empty(0, np.int64),
+                np.empty(0, np.int64))
+    change = np.empty(n, bool)
+    change[0] = True
+    np.not_equal(recv[1:], recv[:-1], out=change[1:])
+    seg_id = np.cumsum(change, dtype=np.int64) - 1
+    starts = np.flatnonzero(change)
+    idx = np.arange(n, dtype=np.int64) - starts[seg_id]
+    return seg_id, starts, idx
+
+
 def _cap_canonical(d2: np.ndarray, recv: np.ndarray,
                    max_neighbours: int) -> np.ndarray:
     """`_cap_neighbours` for input already in the canonical
     (recv, tie_keys...) order — see its docstring for why stability
     makes the tie keys implicit. Returns the identical keep mask."""
     n_edges = len(recv)
-    change = np.empty(n_edges, bool)
-    change[0] = True
-    np.not_equal(recv[1:], recv[:-1], out=change[1:])
-    seg_id = np.cumsum(change) - 1
-    starts = np.flatnonzero(change)
-    idx = np.arange(n_edges) - starts[seg_id]
+    seg_id, starts, idx = _segment_layout(recv)
     n_seg = len(starts)
     width = int(idx.max()) + 1
     if (width > _CAP_DENSE_MAX_DEG
@@ -263,7 +280,12 @@ def _dense_select(val: np.ndarray, seg_id: np.ndarray, idx: np.ndarray,
     under (val, input order) — THE one copy of the exact dense selection
     kernel, shared by `_cap_canonical` and the Verlet-skin
     `neighborlist._CandidateCap` (the incremental-vs-fresh bitwise
-    adjudication depends on the two call sites never diverging).
+    adjudication depends on the two call sites never diverging). The
+    MD farm's compiled batched re-filter (md/farm.py) mirrors this
+    selection rule in jax on the SAME exact d² values (the grid
+    integrator makes them exact, docs/serving.md "MD farm") — its
+    mirror is adjudicated against this kernel in tests/test_md_farm.py,
+    so a change here must change both.
 
     Exact selection without sorting: the k smallest of a row are
     everything strictly below the row's k-th smallest VALUE, plus the

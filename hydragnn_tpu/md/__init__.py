@@ -1,0 +1,8 @@
+"""Massively-batched on-device MD: the trajectory farm (ROADMAP item 3,
+FlashSchNet) and the association-proof grid integrator it shares with the
+single-session serving loop (examples/md_loop). See docs/serving.md
+"MD farm" and docs/preprocessing.md for the determinism contracts."""
+from .farm import TrajectoryFarm
+from . import integrator
+
+__all__ = ["TrajectoryFarm", "integrator"]

@@ -1,0 +1,163 @@
+"""Association-proof velocity-Verlet on a binary grid — THE one
+integrator definition shared by the single-session MD serving loop
+(examples/md_loop.run_md) and the device-resident trajectory farm
+(md/farm.py), so the two paths cannot drift (the `_dense_select` /
+`pna_stats_epilogue` sharing pattern, applied to integration).
+
+Why a grid. The farm's bitwise contract — every farm trajectory equals
+the PR 10 single-session loop bit for bit — pits host numpy against
+XLA-compiled device code. Measured on this toolchain (and documented in
+docs/serving.md): XLA CPU's LLVM codegen freely CONTRACTS ``a + b*c``
+into one fused-multiply-add and REASSOCIATES 3-term float sums, no
+``XLA_FLAGS`` combination or ``lax.optimization_barrier`` prevents it,
+and the choice varies with the surrounding fusion DAG. Plain f64
+arithmetic therefore cannot match numpy bitwise. Instead, every value
+this integrator touches is kept EXACTLY REPRESENTABLE so that no
+operation rounds — and an operation that never rounds is immune to any
+association or contraction the compiler picks:
+
+* positions live on the ``2**-POS_BITS`` grid, velocity*dt ("vd") and
+  acceleration*dt^2 ("ad2") terms on the ``2**-(VEL_BITS+1)`` grid —
+  sums of grid multiples within the documented magnitude limits are
+  exact in f64 under ANY association;
+* the only multiplications are by powers of two (exact by construction)
+  or the force-scaling products ``F * s_hi`` / ``F * s_lo``, where F
+  carries a float32 mantissa (24 bits) and the Veltkamp-split scale
+  halves carry <= 27 bits — both products are exact, so even an FMA
+  contraction of the adjacent add computes the identical value;
+* each re-quantization rounds exactly once, through
+  ``floor(x * 2**bits + 0.5)`` whose multiply is exact and whose single
+  add cannot be reassociated past the ``floor`` boundary.
+
+The same exactness makes the *decisions* downstream bitwise too: the
+Verlet-skin displacement check and the candidate re-filter d^2 are sums
+of squares of grid coordinates, exact in f64 within ``validate_ranges``
+limits, so host ``NeighborList`` and the compiled farm agree on every
+rebuild decision and every cap tie-break without sharing any code path.
+
+Every function takes an ``xp`` array namespace (numpy by default; the
+farm passes ``jax.numpy`` inside its compiled step) — one expression
+serves both sides because the expressions never round.
+
+Physical cost of the grid: positions are snapped to ``2**-21`` (~5e-7
+box units — finer than the float32 resolution the model forward sees
+anyway) and per-step velocity increments to ``2**-41``. For the MD
+serving workloads this layer targets, that is far below thermal noise.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+# Grid exponents. POS_BITS bounds the exact-d^2 budget (see
+# validate_ranges); VEL_BITS the velocity-increment resolution. These are
+# contract constants, not knobs: changing them changes every trajectory.
+POS_BITS = 21
+VEL_BITS = 40
+
+_POS_SCALE = float(2.0 ** POS_BITS)
+_POS_INV = float(2.0 ** -POS_BITS)
+_VEL_SCALE = float(2.0 ** VEL_BITS)
+_VEL_INV = float(2.0 ** -VEL_BITS)
+
+# magnitude limits under which every integrator add is exact (f64 holds
+# integers to 2^53; the finest grid in play is 2^-(VEL_BITS+1) = 2^-41,
+# so coordinates must stay below 2^(53-41) = 2^12 — COORD_LIMIT keeps a
+# 2x margin) and every candidate/displacement d^2 is exact
+# (per-axis distance d: 3 * (d * 2^POS_BITS)^2 < 2^53 needs d <= ~26;
+# candidates from adjacent cells reach ~2*(r+skin), so r+skin <= 8
+# leaves a safety factor)
+COORD_LIMIT = float(2.0 ** 11)
+CUTOFF_LIMIT = 8.0
+
+_SPLITTER = float(2.0 ** 27 + 1.0)  # Veltkamp split constant for f64
+
+
+def validate_ranges(coord_max: float, cutoff_plus_skin: float) -> None:
+    """Raise when the exactness budget that makes host==device bitwise
+    cannot be guaranteed (docs/serving.md "MD farm")."""
+    if not np.isfinite(coord_max) or coord_max > COORD_LIMIT:
+        raise ValueError(
+            f"MD grid integrator: coordinate magnitude {coord_max} exceeds "
+            f"the exact-arithmetic limit {COORD_LIMIT} (positions must "
+            "stay below it for every integrator add to be exact; "
+            "recenter the system or shrink the box)")
+    if not np.isfinite(cutoff_plus_skin) or cutoff_plus_skin > CUTOFF_LIMIT:
+        raise ValueError(
+            f"MD grid integrator: cutoff + skin = {cutoff_plus_skin} "
+            f"exceeds the exact-d^2 limit {CUTOFF_LIMIT} (candidate "
+            "distances must square exactly on the position grid; use a "
+            "smaller cutoff or rescale coordinates)")
+
+
+def quantize_pos(x, xp=np):
+    """Snap to the position grid: floor(x * 2^POS_BITS + 0.5) * 2^-POS_BITS.
+    The multiply is a power of two (exact); the single add rounds once,
+    identically on every backend; floor is exact."""
+    return xp.floor(x * _POS_SCALE + 0.5) * _POS_INV
+
+
+def quantize_vel(x, xp=np):
+    """Snap to the velocity-increment grid (2^-VEL_BITS)."""
+    return xp.floor(x * _VEL_SCALE + 0.5) * _VEL_INV
+
+
+def init_state(pos0, vel0, dt: float) -> Tuple[np.ndarray, np.ndarray]:
+    """(pos, vd) initial state on the grids. ``vd`` carries vel*dt — the
+    scaled-variable form in which every subsequent update is exact. The
+    one arbitrary product here (vel0 * dt) runs on the HOST exactly once,
+    so it needs no exactness engineering."""
+    pos = quantize_pos(np.asarray(pos0, np.float64))
+    vd = quantize_vel(np.asarray(vel0, np.float64) * float(dt))
+    return pos, vd
+
+
+def quantize_cell(cell) -> np.ndarray:
+    """Snap a [3, 3] lattice to the position grid so ghost-image offsets
+    (shifts_int @ cell) land exactly on it too — the PBC re-filter's
+    exact-d^2 precondition."""
+    return quantize_pos(np.asarray(cell, np.float64).reshape(3, 3))
+
+
+def force_scale_split(dt: float, force_scale: float = 1.0,
+                      mass: float = 1.0) -> Tuple[float, float]:
+    """Veltkamp halves of ``(force_scale / mass) * dt^2 * 2^VEL_BITS``.
+
+    ``accel_term`` multiplies float32-mantissa forces by each half: 24+27
+    significand bits <= 53, so both products are exact and the combined
+    value is association-independent on any backend."""
+    s2 = (float(force_scale) / float(mass)) * float(dt) * float(dt) * _VEL_SCALE
+    if not np.isfinite(s2):
+        raise ValueError(
+            f"MD grid integrator: non-finite force scale from dt={dt}, "
+            f"force_scale={force_scale}, mass={mass}")
+    c = s2 * _SPLITTER
+    hi = c - (c - s2)
+    lo = s2 - hi
+    return float(hi), float(lo)
+
+
+def accel_term(forces, s_hi: float, s_lo: float, xp=np):
+    """ad2 = quantized ``F * (force_scale/mass) * dt^2`` on the velocity
+    grid. Forces are rounded through float32 first — a no-op for the
+    usual f32 model output, a single deterministic rounding for an
+    x64-promoted forward — because the split-product exactness needs a
+    24-bit force mantissa; both split products are then exact and each
+    floor rounds exactly once."""
+    f = forces.astype(xp.float32).astype(xp.float64)
+    a = xp.floor(f * s_hi + 0.5) + xp.floor(f * s_lo + 0.5)
+    return a * _VEL_INV
+
+
+def drift(pos, vd, ad2, xp=np):
+    """pos' = quantize(pos + vel*dt + 0.5*acc*dt^2) in scaled variables.
+    All three addends are grid multiples (exact sum, any association);
+    0.5 * ad2 is a power-of-two multiply (exact)."""
+    return quantize_pos(pos + vd + 0.5 * ad2, xp)
+
+
+def kick(vd, ad2, ad2_new, xp=np):
+    """vd' = vd + 0.5 * (ad2 + ad2') — the velocity half-kicks in scaled
+    variables. Grid adds and a power-of-two multiply: exact."""
+    return vd + 0.5 * (ad2 + ad2_new)

@@ -23,8 +23,15 @@ Config schema (top-level block, alongside "Dataset"/"NeuralNetwork"):
         "metrics_port": 0,         # /healthz + /metrics HTTP port
                                    # (0 = off; see docs/observability.md)
         "structure": false,        # raw-structure serving (submit_structure)
-        "md_skin": 0.3             # Verlet-skin width for trajectory
+        "md_skin": 0.3,            # Verlet-skin width for trajectory
                                    # sessions (docs/serving.md)
+        "md_farm": {               # trajectory-farm knobs (docs/serving.md
+                                   # "MD farm"; engine.trajectory_farm)
+            "steps_per_dispatch": 8,   # device-resident MD steps per
+                                       # dispatch (K)
+            "cand_headroom": 0.5       # static candidate/degree capacity
+                                       # headroom over the initial builds
+        }
     }
 
 The queue/deadline/breaker knobs are the failure-semantics layer
@@ -45,6 +52,14 @@ config so MD/relaxation/screening clients can call
 graphs. `md_skin` (env: HYDRAGNN_MD_SKIN; cutoff units) is the
 Verlet-skin width trajectory sessions build their incremental neighbor
 list with — wider = fewer rebuilds but more candidates per re-filter.
+
+`md_farm` (env: HYDRAGNN_MD_FARM_STEPS_PER_DISPATCH /
+HYDRAGNN_MD_FARM_CAND_HEADROOM, strict parsing) tunes the trajectory
+farm (docs/serving.md "MD farm"): `steps_per_dispatch` trades host
+round-trips against wasted device iterations after a mid-dispatch
+skin-bound violation; `cand_headroom` sizes the static stacked candidate
+layout over the initial builds (too small raises mid-run with an
+actionable message, too large pays re-filter width for nothing).
 """
 from __future__ import annotations
 
@@ -70,6 +85,37 @@ class Structure:
     node_features: Any
     cell: Optional[Any] = None
     graph_feats: Optional[Any] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class MdFarm:
+    """Trajectory-farm knobs (docs/serving.md "MD farm"). The contract
+    surface — grids, selection rules, bucket layout — is NOT knobbed;
+    these only trade throughput for memory/round-trips."""
+    steps_per_dispatch: int = 8   # device-resident MD steps per dispatch
+    cand_headroom: float = 0.5    # static candidate/degree capacity
+    # headroom over the initial per-trajectory builds
+
+
+def resolve_md_farm(config: Optional[Dict[str, Any]] = None) -> MdFarm:
+    """Merge the `Serving.md_farm` block and the HYDRAGNN_MD_FARM_* env
+    knobs (strict parsing — a typo warns and keeps the default). Shared
+    by `InferenceEngine.trajectory_farm` and bench.py BENCH_MD_FARM so
+    the precedence cannot drift."""
+    from ..utils.envflags import env_strict_float, env_strict_int
+    block = ((config or {}).get("Serving", {}) or {}).get("md_farm",
+                                                          {}) or {}
+    base = MdFarm(
+        steps_per_dispatch=int(block.get("steps_per_dispatch", 8)),
+        cand_headroom=float(block.get("cand_headroom", 0.5)),
+    )
+    return MdFarm(
+        steps_per_dispatch=env_strict_int(
+            "HYDRAGNN_MD_FARM_STEPS_PER_DISPATCH",
+            base.steps_per_dispatch),
+        cand_headroom=env_strict_float("HYDRAGNN_MD_FARM_CAND_HEADROOM",
+                                       base.cand_headroom),
+    )
 
 
 @dataclasses.dataclass(frozen=True)

@@ -329,6 +329,8 @@ class InferenceEngine:
 
         self._variables = {"params": variables["params"],
                            "batch_stats": variables.get("batch_stats", {})}
+        self._model = model  # retained for trajectory_farm (the farm
+        # builds its own vmapped EF forward from the same model/config)
         if self.num_shards > 1:
             from ..parallel.mesh import make_mesh
             from ..parallel.spmd import make_spmd_forward
@@ -513,6 +515,57 @@ class InferenceEngine:
             self.md_skin if skin is None else float(skin),
             max_neighbours=self._structure_max_nb,
             pbc=(True, True, True) if self._structure_pbc else None))
+
+    def trajectory_farm(self, *, dt: float, skin: Optional[float] = None,
+                        mass: float = 1.0, force_scale: float = 1.0,
+                        steps_per_dispatch: Optional[int] = None,
+                        cand_headroom: Optional[float] = None):
+        """A massively-batched device-resident MD farm over this engine's
+        model (docs/serving.md "MD farm"): vmapped velocity-Verlet +
+        Verlet-skin re-filter with K steps per dispatch, each trajectory
+        BITWISE-equal to the single-session `submit_structure` loop from
+        identical initial conditions. Requires the raw-structure +
+        ``ef_forward`` configuration and a single-bucket ladder (the
+        farm serves every step on ONE compiled shape, the same shape the
+        session adjudication reference runs on). Knobs default to
+        `serving.config.resolve_md_farm` (HYDRAGNN_MD_FARM_*)."""
+        self._require_structure()
+        if not self.ef_forward:
+            raise ValueError(
+                "trajectory_farm needs ef_forward=True — the farm "
+                "integrates forces served as -dE/dpos")
+        if self.num_shards > 1:
+            raise ValueError(
+                "trajectory_farm is single-shard (like ef_forward "
+                "serving) — run one farm per device")
+        if self._structure_rot:
+            raise ValueError(
+                "trajectory farms need Dataset.rotational_invariance off "
+                "— the incremental neighbor list tracks displacements in "
+                "the raw frame")
+        if len(self.buckets) != 1:
+            raise ValueError(
+                "trajectory_farm needs a single-bucket ladder (e.g. "
+                "examples.md_loop.md_buckets) so every step of the farm "
+                "and of the session adjudication reference runs the same "
+                "compiled shape")
+        from ..md.farm import TrajectoryFarm
+        from .config import resolve_md_farm
+        # the engine holds the full config, so the Serving.md_farm block
+        # participates in the documented env-over-config-over-default
+        # precedence
+        knobs = resolve_md_farm(self._structure_cfg)
+        return TrajectoryFarm(
+            self._model, self._variables, self.mcfg, self._structure_cfg,
+            bucket=self.buckets[0], dt=dt,
+            skin=self.md_skin if skin is None else float(skin),
+            mass=mass, force_scale=force_scale,
+            steps_per_dispatch=(knobs.steps_per_dispatch
+                                if steps_per_dispatch is None
+                                else int(steps_per_dispatch)),
+            cand_headroom=(knobs.cand_headroom if cand_headroom is None
+                           else float(cand_headroom)),
+            compute_dtype=self.compute_dtype)
 
     def submit_structure(self, positions, node_features=None, cell=None,
                          graph_feats=None,

@@ -62,6 +62,7 @@ def test_traced_env_rule_scope():
     assert rule.applies("hydragnn_tpu/kernels/nbr_pallas.py")
     assert rule.applies("hydragnn_tpu/telemetry/registry.py")
     assert rule.applies("hydragnn_tpu/train/precision.py")
+    assert rule.applies("hydragnn_tpu/md/farm.py")  # PR 11 farm scan body
     assert not rule.applies("hydragnn_tpu/parallel/mesh.py")  # documented
     assert not rule.applies("hydragnn_tpu/train/trainer.py")  # host-side
 
@@ -137,6 +138,16 @@ def test_determinism_rule_negative_fixtures():
            "        pass\n"
            "    s = set(xs)\n")              # building a set is fine
     assert r_det.find_unsorted_iteration(src, "f.py") == []
+
+
+def test_determinism_rule_scope_covers_md_farm():
+    """The trajectory farm's bitwise contract (docs/serving.md "MD
+    farm") makes its packing/swap bookkeeping ordering-sensitive — the
+    nondeterministic-order rule must cover hydragnn_tpu/md/."""
+    rule = r_det.NondeterministicOrderRule()
+    assert rule.applies("hydragnn_tpu/md/farm.py")
+    assert rule.applies("hydragnn_tpu/md/integrator.py")
+    assert "hydragnn_tpu/md/" in r_det.SCOPE_DIRS
 
 
 LOCK_FIXTURE_HEADER = (

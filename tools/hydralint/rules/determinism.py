@@ -9,9 +9,12 @@ order follows the filesystem. Both are exactly the hazards the PR 5
 neighbor total-order and PR 2 global pack plan were built to shut out.
 
 Checked, in ``graphs/``, ``preprocess/``, ``datasets/``, ``parallel/``,
-and ``serving/`` (the raw-structure serving path made edge order a
-SERVING contract — submit_structure promises bitwise the PR 5 fresh-build
-edges, so the same ordering hazards apply there):
+``serving/`` (the raw-structure serving path made edge order a SERVING
+contract — submit_structure promises bitwise the PR 5 fresh-build edges,
+so the same ordering hazards apply there), and ``md/`` (the trajectory
+farm promises bitwise-equal trajectories vs the single-session loop —
+its candidate packing and cache-swap bookkeeping must iterate in
+deterministic order):
 
 * a set expression (literal ``{...}``, ``set(...)``/``frozenset(...)``,
   set comprehension) used as the iterable of a ``for`` loop or a
@@ -33,7 +36,7 @@ from ..engine import Finding, Rule
 
 SCOPE_DIRS = ("hydragnn_tpu/graphs/", "hydragnn_tpu/preprocess/",
               "hydragnn_tpu/datasets/", "hydragnn_tpu/parallel/",
-              "hydragnn_tpu/serving/")
+              "hydragnn_tpu/serving/", "hydragnn_tpu/md/")
 
 _FS_OS = ("listdir", "scandir")
 _FS_GLOB = ("glob", "iglob")

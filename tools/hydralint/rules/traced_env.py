@@ -49,6 +49,11 @@ TRACED_DIRS = (
     # rendezvous + SLURM walltime probes, host-side startup code that
     # never runs under trace
     os.path.join("hydragnn_tpu", "parallel"),
+    # the MD farm's scan body + batched re-filter are compiled programs
+    # whose knobs (steps-per-dispatch, candidate headroom) must resolve
+    # via serving/config.resolve_md_farm at construction — an env read
+    # here would be trace-time-frozen exactly like the kernels' (PR 11)
+    os.path.join("hydragnn_tpu", "md"),
 )
 
 # host-side files inside an otherwise-traced directory; every entry must
