@@ -74,6 +74,37 @@ Env knobs:
                        non-batching server cannot sustain)
   BENCH_SERVE_OUT      also write the serving JSON to this path (the
                        slow-lane smoke emits BENCH_SERVE.json)
+  BENCH_SERVE_FLEET    =1: fleet serving mode (docs/serving.md "Fleet") —
+                       a ReplicaRouter over N engines sharing one
+                       persistent AOT compile store, adjudicated
+                       end-to-end: replica 0 compiles the ladder fresh
+                       and every later replica warms from disk with 0
+                       fresh compiles; an open-loop Poisson stream with
+                       an injected replica-kill mid-stream must lose
+                       ZERO futures (each resolved exactly once, late
+                       duplicates counted and dropped); a hot-swap
+                       mid-stream from a BEST checkpoint must change
+                       the version tag echoed on the futures with no
+                       request failures; the killed replica restarts
+                       warm from the store. Reports fleet-aggregate
+                       p50/p95/p99 and the re-dispatch count. All
+                       BENCH_SERVE_FLEET_* values parse via the
+                       utils/envflags strict helpers.
+  BENCH_SERVE_FLEET_REQUESTS / BENCH_SERVE_FLEET_REPLICAS
+                       stream length and fleet width (default 192 / 2)
+  BENCH_SERVE_FLEET_KILL_AT
+                       router dispatch index the replica-kill fault
+                       fires at (default requests // 3)
+  BENCH_SERVE_FLEET_RATE
+                       open-loop arrival rate in req/s (default: 2x the
+                       measured closed-loop throughput)
+  BENCH_SERVE_FLEET_STORE
+                       compile-store directory (default: a scratch
+                       tempdir, removed after the run)
+  BENCH_SERVE_FLEET_OUT
+                       also write the fleet JSON to this path (the
+                       nightly fleet-chaos job emits
+                       BENCH_SERVE_FLEET.json)
   BENCH_FAULTS         =1: chaos mode (docs/fault_tolerance.md) — run the
                        fault-tolerance adjudications end-to-end: a
                        training run killed at an injected forward-step
@@ -819,6 +850,224 @@ def run_bench_serve(backend=None):
         },
     }
     out_path = os.environ.get("BENCH_SERVE_OUT", "").strip()
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1)
+    return out
+
+
+def run_bench_serve_fleet(backend=None):
+    """BENCH_SERVE_FLEET: the replica router end to end (docs/serving.md
+    "Fleet") — compile-store warm-start adjudication, an open-loop
+    Poisson stream surviving an injected replica-kill with zero lost
+    futures (exactly-once resolution), a mid-stream hot-swap from a
+    BEST checkpoint with no request failures, and a warm restart of the
+    killed replica. The aggregate p99 is computed from the raw request
+    latencies pooled across every replica."""
+    import shutil
+    import tempfile
+    import threading
+
+    from hydragnn_tpu.graphs.batch import collate
+    from hydragnn_tpu.models.create import init_params
+    from hydragnn_tpu.serving.engine import InferenceEngine
+    from hydragnn_tpu.serving.fleet import ReplicaRouter
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.train_step import TrainState
+    from hydragnn_tpu.utils.checkpoint import save_model
+    from hydragnn_tpu.utils.devices import CompileStore
+    from hydragnn_tpu.utils.envflags import (env_str, env_strict_float,
+                                             env_strict_int)
+    from hydragnn_tpu.utils.faults import install_fault_plan, \
+        parse_fault_plan
+
+    if backend is None:
+        backend = _resolve_backend_and_cache()
+    n_req = env_strict_int("BENCH_SERVE_FLEET_REQUESTS", 192)
+    n_rep = max(env_strict_int("BENCH_SERVE_FLEET_REPLICAS", 2), 2)
+    kill_at = env_strict_int("BENCH_SERVE_FLEET_KILL_AT", n_req // 3)
+    rate = env_strict_float("BENCH_SERVE_FLEET_RATE", 0.0)
+    use_nbr = os.environ.get("BENCH_NBR", "1") != "0"
+
+    rng = np.random.RandomState(0)
+    samples = synth_samples(n_req, rng, (8, 40), dist="loguniform")
+    _, mcfg, model, tx, _, compute_dtype = _bench_model(samples)
+    variables = init_params(model, collate(samples[:4]))
+
+    work = tempfile.mkdtemp(prefix="bench_fleet_")
+    store_dir = env_str("BENCH_SERVE_FLEET_STORE",
+                        os.path.join(work, "compile_store"))
+    store = CompileStore(store_dir)
+
+    def factory(idx):
+        return InferenceEngine(
+            model, variables, mcfg, reference_samples=samples,
+            max_batch_size=8, max_wait_ms=1.0, neighbor_format=use_nbr,
+            compute_dtype=compute_dtype, compile_store=store,
+            model_version="v1", breaker_threshold=3, breaker_reset_s=0.3)
+
+    try:
+        router = ReplicaRouter(factory, n_rep)
+        # --- compile-store adjudication: replica 0 compiles the ladder
+        # fresh and persists it; every later replica loads from disk
+        warm_reports = router.warmup()
+        store_cold_ok = (warm_reports[0]["fresh"] ==
+                         warm_reports[0]["compiled"] > 0)
+        store_warm_ok = all(r["fresh"] == 0
+                            and r["store_hits"] == r["compiled"]
+                            for r in warm_reports[1:])
+
+        # --- the hot-swap payload: a perturbed state committed through
+        # the PR 4 checkpoint contract and restored via the BEST marker
+        import jax
+        vars2 = dict(variables)
+        vars2["params"] = jax.tree_util.tree_map(
+            lambda a: a * (1.0 + 1e-3), variables["params"])
+        state2 = TrainState.create(
+            {"params": vars2["params"],
+             "batch_stats": variables.get("batch_stats", {})},
+            select_optimizer({"Optimizer": {"type": "AdamW",
+                                            "learning_rate": 1e-3}}))
+        save_model(state2, "fleet_bench", path=work, mark_best=True,
+                   best_val=0.0)
+        template = TrainState.create(
+            {"params": variables["params"],
+             "batch_stats": variables.get("batch_stats", {})},
+            select_optimizer({"Optimizer": {"type": "AdamW",
+                                            "learning_rate": 1e-3}}))
+        # restore through the BEST marker up front: the orbax read is
+        # I/O whose latency would race a short stream — the SWAP (drain
+        # + atomic variable swap) is what must land mid-stream
+        from hydragnn_tpu.utils.checkpoint import load_best_model
+        best_state = load_best_model(template, "fleet_bench", path=work)
+        if best_state is None:
+            raise RuntimeError("BEST checkpoint did not restore")
+        best_vars = {"params": best_state.params,
+                     "batch_stats": best_state.batch_stats}
+        best_tag = f"best:step_{int(best_state.step)}"
+
+        # --- closed-loop throughput (also calibrates the open-loop rate)
+        t0 = time.perf_counter()
+        router.predict(samples, timeout=300)
+        closed_gps = n_req / (time.perf_counter() - t0)
+        if rate <= 0:
+            rate = 2.0 * closed_gps
+
+        # --- open-loop stream: seeded Poisson arrivals, one injected
+        # replica-kill mid-stream, one hot-swap roll mid-stream
+        router.reset_stats()
+        install_fault_plan(parse_fault_plan(f"replica-kill@{kill_at}"))
+        arrival_rng = np.random.RandomState(7)
+        gaps = arrival_rng.exponential(1.0 / rate, size=n_req)
+        swap_report = {}
+        swap_err = []
+
+        def do_swap():
+            try:
+                swap_report.update(router.hot_swap(best_vars, best_tag))
+            except Exception as exc:  # noqa: BLE001 — adjudicated below
+                swap_err.append(f"{type(exc).__name__}: {exc}")
+
+        swap_thread = threading.Thread(target=do_swap)
+        t0 = time.perf_counter()
+        futs = []
+        for i, (s, gap) in enumerate(zip(samples, gaps)):
+            time.sleep(max(0.0, gap))
+            if i == n_req // 2:
+                swap_thread.start()  # rolls while arrivals continue
+            if i == (3 * n_req) // 4:
+                # the roll must land mid-stream: arrivals in [1/2, 3/4)
+                # overlap the drains, the tail provably echoes the new
+                # version
+                swap_thread.join(timeout=120)
+            futs.append(router.submit(s))
+        from concurrent.futures import TimeoutError as FutTimeout
+        unresolved = 0
+        for f in futs:
+            try:
+                f.exception(timeout=300)  # blocks until resolved
+            except FutTimeout:
+                unresolved += 1
+        swap_thread.join(timeout=120)
+        open_dt = time.perf_counter() - t0
+        install_fault_plan(None)
+        failures = [f for f in futs
+                    if f.done() and f.exception(timeout=0) is not None]
+        versions = sorted({f.model_version for f in futs
+                           if f.done() and f.exception(timeout=0) is None
+                           and hasattr(f, "model_version")})
+        health = router.health()
+        stats = router.stats()
+        dead = [int(i) for i, h in sorted(health["replicas"].items())
+                if not h["alive"]]
+
+        # --- the replacement replica warms from the store, not a ladder
+        # recompile
+        restart_report = (router.restart_replica(dead[0])
+                          if dead else {})
+        router.shutdown()
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    resolved_exactly_once = (unresolved == 0
+                             and all(f.done() for f in futs))
+    # the kill itself is the gated event; the re-dispatch COUNT is
+    # reported but not gated — a kill landing on a replica with no
+    # router-tracked inflight at that instant legitimately moves zero
+    # requests, which is correct behavior, not a failure
+    passed = (store_cold_ok and store_warm_ok and not failures
+              and unresolved == 0 and len(versions) == 2
+              and not swap_err and not swap_report.get("failed")
+              and stats["kills"] >= 1
+              and (not restart_report or restart_report["fresh"] == 0))
+    out = {
+        "metric": "serve_fleet_open_loop_p99_ms",
+        "value": round(stats.get("p99_ms", 0.0), 3),
+        "unit": "ms",
+        "vs_baseline": None,
+        "backend": backend,
+        "passed": passed,
+        "shape": {"requests": n_req, "replicas": n_rep,
+                  "size_range": [8, 40], "hidden": HIDDEN,
+                  "max_batch_size": 8},
+        "dtype": compute_dtype,
+        "closed_loop_gps": round(closed_gps, 2),
+        "open_loop": {
+            "rate_rps": round(rate, 2),
+            "throughput_gps": round(n_req / open_dt, 2),
+            "p50_ms": round(stats.get("p50_ms", 0.0), 3),
+            "p95_ms": round(stats.get("p95_ms", 0.0), 3),
+            "p99_ms": round(stats.get("p99_ms", 0.0), 3),
+            "mean_ms": round(stats.get("mean_ms", 0.0), 3),
+        },
+        "fault": {
+            "replica_kill_at_dispatch": kill_at,
+            "killed_replicas": dead,
+            "kills": stats["kills"],
+            "redispatches": stats["redispatches"],
+            "duplicate_resolutions_dropped":
+                stats["duplicate_resolutions"],
+            "stale_failures_dropped": stats["stale_failures"],
+            "request_failures": len(failures),
+            "unresolved_futures": unresolved,
+            "no_lost_futures": unresolved == 0,
+            "resolved_exactly_once": resolved_exactly_once,
+        },
+        "hot_swap": {
+            "report": swap_report,
+            "errors": swap_err,
+            "versions_echoed_on_futures": versions,
+            "version_changed_mid_stream": len(versions) == 2,
+        },
+        "compile_store": {
+            "warmup_reports": warm_reports,
+            "cold_replica_fresh_compiles": warm_reports[0]["fresh"],
+            "warm_replicas_zero_fresh": store_warm_ok,
+            "restart_report": restart_report,
+            "restart_fresh_compiles": restart_report.get("fresh"),
+        },
+    }
+    out_path = os.environ.get("BENCH_SERVE_FLEET_OUT", "").strip()
     if out_path:
         with open(out_path, "w") as f:
             json.dump(out, f, indent=1)
@@ -2139,6 +2388,8 @@ def _pin_cpu_host_threads():
 def main():
     if os.environ.get("BENCH_SWEEP") == "1":
         out = sweep()
+    elif os.environ.get("BENCH_SERVE_FLEET") == "1":
+        out = run_bench_serve_fleet()
     elif os.environ.get("BENCH_SERVE") == "1":
         out = run_bench_serve()
     elif os.environ.get("BENCH_FAULTS") == "1":

@@ -202,50 +202,78 @@ def _predict_with_engine(model, state, mcfg, testset, serving, num_shards,
     """Engine path: every test sample becomes one serving request; the
     background dispatcher coalesces them into bucketed padded batches
     (serving/engine.py) — the same numerics as the legacy loop, measured
-    3x+ faster per request on CPU (BENCH_SERVE)."""
+    3x+ faster per request on CPU (BENCH_SERVE).
+
+    With `Serving.fleet.replicas` > 1 (HYDRAGNN_FLEET_REPLICAS) the
+    requests route through a ReplicaRouter of that many engines instead
+    — per-replica breaker isolation, re-dispatch off dead replicas, and
+    a shared persistent compile store when `Serving.fleet.compile_store`
+    names one (docs/serving.md "Fleet"). The results are identical
+    either way: every replica serves the same checkpoint on the same
+    bucket ladder."""
+    from .serving.config import resolve_fleet
     from .serving.engine import InferenceEngine
     variables = {"params": state.params, "batch_stats": state.batch_stats}
-    engine = InferenceEngine(
-        model, variables, mcfg, reference_samples=testset,
-        max_batch_size=serving.max_batch_size,
-        max_wait_ms=serving.max_wait_ms,
-        num_buckets=serving.num_buckets,
-        bucket_multiple=serving.bucket_multiple,
-        num_shards=num_shards if num_shards and num_shards > 1 else 1,
-        neighbor_format=neighbor_format, neighbor_k=neighbor_k,
-        # serve-side precision override (Serving.precision /
-        # HYDRAGNN_SERVE_PRECISION, docs/kernels_mixed_precision.md);
-        # None inherits the train-side policy
-        compute_dtype=serving.precision,
-        # the failure-semantics knobs (max_queue/deadline_ms/breaker_*)
-        # deliberately stay at their permissive defaults here: this is the
-        # OFFLINE batch-predict path, which submits the whole testset at
-        # once — an online admission bound or deadline tuned for a
-        # deployment would fast-fail/expire a perfectly good prediction
-        # run (docs/fault_tolerance.md). They apply to engines serving
-        # live traffic via the InferenceEngine API.
-        breaker_threshold=0,
-        # Serving.structure / HYDRAGNN_SERVE_STRUCTURE: hand the engine
-        # the full config so raw-structure clients (submit_structure /
-        # trajectory sessions, docs/serving.md) can use this engine too;
-        # the offline testset prediction below is unaffected
-        structure_config=config if serving.structure else None,
-        md_skin=serving.md_skin)
+    fleet = resolve_fleet(config)
+    compile_store = None
+    if fleet.compile_store:
+        from .utils.devices import CompileStore
+        compile_store = CompileStore(fleet.compile_store)
+
+    def make_engine(replica_idx=0):
+        return InferenceEngine(
+            model, variables, mcfg, reference_samples=testset,
+            max_batch_size=serving.max_batch_size,
+            max_wait_ms=serving.max_wait_ms,
+            num_buckets=serving.num_buckets,
+            bucket_multiple=serving.bucket_multiple,
+            num_shards=num_shards if num_shards and num_shards > 1 else 1,
+            neighbor_format=neighbor_format, neighbor_k=neighbor_k,
+            # serve-side precision override (Serving.precision /
+            # HYDRAGNN_SERVE_PRECISION, docs/kernels_mixed_precision.md);
+            # None inherits the train-side policy
+            compute_dtype=serving.precision,
+            # the failure-semantics knobs (max_queue/deadline_ms/breaker_*)
+            # deliberately stay at their permissive defaults here: this is
+            # the OFFLINE batch-predict path, which submits the whole
+            # testset at once — an online admission bound or deadline tuned
+            # for a deployment would fast-fail/expire a perfectly good
+            # prediction run (docs/fault_tolerance.md). They apply to
+            # engines serving live traffic via the InferenceEngine API.
+            breaker_threshold=0,
+            # Serving.structure / HYDRAGNN_SERVE_STRUCTURE: hand the engine
+            # the full config so raw-structure clients (submit_structure /
+            # trajectory sessions, docs/serving.md) can use this engine
+            # too; the offline testset prediction below is unaffected
+            structure_config=config if serving.structure else None,
+            md_skin=serving.md_skin,
+            compile_store=compile_store,
+            # the hot-swap version tag names the restored checkpoint step
+            model_version=f"step_{int(state.step)}")
+
+    if fleet.replicas > 1:
+        from .serving.fleet import ReplicaRouter
+        server = ReplicaRouter(
+            make_engine, fleet.replicas,
+            max_redispatch=fleet.redispatch_max or None,
+            drain_timeout_s=fleet.drain_timeout_s)
+    else:
+        server = make_engine()
     try:
         if serving.metrics_port:
             # Serving.metrics_port / HYDRAGNN_SERVE_METRICS_PORT:
             # /healthz + /metrics over HTTP for the run's duration
             # (docs/observability.md); loopback-only here — fleet
-            # exposure is a deliberate InferenceEngine-API decision
-            server = engine.start_metrics_server(
-                port=serving.metrics_port)
+            # exposure is a deliberate API decision. A fleet exposes ONE
+            # aggregated endpoint with per-replica labels.
+            http = server.start_metrics_server(port=serving.metrics_port)
             import logging
             logging.getLogger("hydragnn_tpu").info(
-                "serving metrics endpoint at %s/metrics", server.url)
-        engine.warmup()
-        results = engine.predict(testset)
+                "serving metrics endpoint at %s/metrics", http.url)
+        server.warmup()
+        results = server.predict(testset)
     finally:
-        engine.shutdown()
+        server.shutdown()
     trues = [[] for _ in mcfg.heads]
     preds = [[] for _ in mcfg.heads]
     for sample, res in zip(testset, results):

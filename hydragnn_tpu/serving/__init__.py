@@ -1,22 +1,31 @@
 """Batched inference serving: request micro-batching over a bucketed
 compile cache (docs/serving.md), with explicit failure semantics —
 bounded admission, per-request deadlines, dispatcher circuit breaker
-(docs/fault_tolerance.md)."""
-from .config import ServingConfig, Structure, resolve_serving
+(docs/fault_tolerance.md) — and the fleet layer on top: a replica
+router with per-replica failure isolation, zero-downtime hot-swap, and
+a persistent AOT compile store (docs/serving.md "Fleet")."""
+from .config import (FleetConfig, ServingConfig, Structure, resolve_fleet,
+                     resolve_serving)
 from .engine import (CircuitOpenError, DeadlineExceededError,
                      InferenceEngine, QueueFullError, ServingError,
                      StructureSession, bucket_ladder, select_bucket)
+from .fleet import FleetUnavailableError, ReplicaRouter, SwapFailedError
 
 __all__ = [
     "CircuitOpenError",
     "DeadlineExceededError",
+    "FleetConfig",
+    "FleetUnavailableError",
     "InferenceEngine",
     "QueueFullError",
+    "ReplicaRouter",
     "ServingConfig",
     "ServingError",
     "Structure",
     "StructureSession",
+    "SwapFailedError",
     "bucket_ladder",
+    "resolve_fleet",
     "resolve_serving",
     "select_bucket",
 ]

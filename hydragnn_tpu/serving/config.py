@@ -31,6 +31,17 @@ Config schema (top-level block, alongside "Dataset"/"NeuralNetwork"):
                                        # dispatch (K)
             "cand_headroom": 0.5       # static candidate/degree capacity
                                        # headroom over the initial builds
+        },
+        "fleet": {                 # replica-router knobs (docs/serving.md
+                                   # "Fleet"; serving/fleet.py)
+            "replicas": 1,             # engines behind the router
+                                       # (<= 1 = single-engine path)
+            "compile_store": null,     # persistent AOT executable store
+                                       # dir (utils/devices.CompileStore);
+                                       # null/"" = off
+            "redispatch_max": 0,       # re-dispatch budget per request
+                                       # (0 = one try per replica)
+            "drain_timeout_s": 30.0    # hot-swap per-replica drain bound
         }
     }
 
@@ -52,6 +63,15 @@ config so MD/relaxation/screening clients can call
 graphs. `md_skin` (env: HYDRAGNN_MD_SKIN; cutoff units) is the
 Verlet-skin width trajectory sessions build their incremental neighbor
 list with — wider = fewer rebuilds but more candidates per re-filter.
+
+`fleet` (env: HYDRAGNN_FLEET_REPLICAS / HYDRAGNN_FLEET_COMPILE_STORE /
+HYDRAGNN_FLEET_REDISPATCH_MAX / HYDRAGNN_FLEET_DRAIN_TIMEOUT_S, strict
+parsing) configures the replica router (docs/serving.md "Fleet"):
+`replicas` > 1 makes run_prediction serve through a ReplicaRouter of
+that many engines (least-queue-depth dispatch, per-replica breaker
+isolation, re-dispatch off dead replicas); `compile_store` points every
+replica at one persistent AOT executable store so warmups load the
+bucket ladder from disk.
 
 `md_farm` (env: HYDRAGNN_MD_FARM_STEPS_PER_DISPATCH /
 HYDRAGNN_MD_FARM_CAND_HEADROOM, strict parsing) tunes the trajectory
@@ -115,6 +135,43 @@ def resolve_md_farm(config: Optional[Dict[str, Any]] = None) -> MdFarm:
             base.steps_per_dispatch),
         cand_headroom=env_strict_float("HYDRAGNN_MD_FARM_CAND_HEADROOM",
                                        base.cand_headroom),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Replica-router knobs (docs/serving.md "Fleet"; serving/fleet.py).
+    The routing/isolation CONTRACT (least-queue-depth, exactly-once
+    resolution, per-replica breakers) is not knobbed — these only size
+    the fleet and its recovery budgets."""
+    replicas: int = 1             # <= 1 = the single-engine path
+    compile_store: Optional[str] = None  # persistent AOT store dir
+    redispatch_max: int = 0       # 0 = one try per replica
+    drain_timeout_s: float = 30.0
+
+
+def resolve_fleet(config: Optional[Dict[str, Any]] = None) -> FleetConfig:
+    """Merge the `Serving.fleet` block and the HYDRAGNN_FLEET_* env knobs
+    (strict parsing — a typo warns and keeps the default). Shared by
+    run_prediction and bench.py so the precedence cannot drift."""
+    from ..utils.envflags import env_str, env_strict_float, env_strict_int
+    block = ((config or {}).get("Serving", {}) or {}).get("fleet",
+                                                          {}) or {}
+    base = FleetConfig(
+        replicas=int(block.get("replicas", 1) or 1),
+        compile_store=(str(block.get("compile_store")).strip() or None
+                       if block.get("compile_store") else None),
+        redispatch_max=int(block.get("redispatch_max", 0) or 0),
+        drain_timeout_s=float(block.get("drain_timeout_s", 30.0) or 30.0),
+    )
+    return FleetConfig(
+        replicas=env_strict_int("HYDRAGNN_FLEET_REPLICAS", base.replicas),
+        compile_store=env_str("HYDRAGNN_FLEET_COMPILE_STORE",
+                              base.compile_store),
+        redispatch_max=env_strict_int("HYDRAGNN_FLEET_REDISPATCH_MAX",
+                                      base.redispatch_max),
+        drain_timeout_s=env_strict_float("HYDRAGNN_FLEET_DRAIN_TIMEOUT_S",
+                                         base.drain_timeout_s),
     )
 
 

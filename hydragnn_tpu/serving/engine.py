@@ -71,6 +71,19 @@ to ``.bucket``, and rebuild counts flow into the telemetry registry
 tell neighbor-bound from compute-bound serving. ``ef_forward=True``
 serves energy+forces from a node-level energy head (forces = -dE/dpos),
 closing the MD loop end-to-end (examples/md_loop, BENCH_MD).
+
+Fleet hooks (docs/serving.md "Fleet", serving/fleet.py): the engine is
+the fleet's unit of failure isolation — each ``ReplicaRouter`` replica
+is one engine with its own breaker and its own compiled programs.
+Three engine-level capabilities exist for that layer: an atomic
+``swap_variables`` hot-swap (the PR 4 BEST/LATEST checkpoint contract;
+``model_version`` is echoed on every resolved future and in
+``health()``), a persistent AOT ``compile_store``
+(utils/devices.CompileStore) so a replacement replica's ``warmup()``
+loads the bucket ladder from disk instead of recompiling
+(``compile_store_hits`` vs ``compile_fresh`` report the split), and
+``latency_snapshot()`` so the router can compute fleet-aggregate
+percentiles from raw per-replica latencies.
 """
 from __future__ import annotations
 
@@ -219,7 +232,9 @@ class InferenceEngine:
                  breaker_reset_s: float = 30.0,
                  structure_config: Optional[dict] = None,
                  md_skin: float = 0.3,
-                 ef_forward: bool = False):
+                 ef_forward: bool = False,
+                 compile_store=None,
+                 model_version: str = "v0"):
         import jax
         from ..train.precision import resolve_precision
         from ..train.train_step import make_forward_fn
@@ -327,8 +342,15 @@ class InferenceEngine:
         else:
             self._response_heads = [h.head_type for h in mcfg.heads]
 
-        self._variables = {"params": variables["params"],
+        # the served model state: swapped ATOMICALLY (one reference
+        # assignment under the lock) by swap_variables — a batch uses
+        # whichever (variables, version) pair it snapshotted, never a
+        # torn mix (docs/serving.md "Fleet": hot-swap drain contract)
+        self._variables = {"params": variables["params"],  # guarded-by: _lock
                            "batch_stats": variables.get("batch_stats", {})}
+        self.model_version = str(model_version)  # guarded-by: _lock
+        self.swap_count = 0  # guarded-by: _lock
+        self._started_at = time.monotonic()
         self._model = model  # retained for trajectory_farm (the farm
         # builds its own vmapped EF forward from the same model/config)
         if self.num_shards > 1:
@@ -366,6 +388,13 @@ class InferenceEngine:
         # a `with self._lock:` block (or __init__) fails the lint.
         self._compiled = {}  # guarded-by: _lock
         self.compile_count = 0  # guarded-by: _lock
+        # persistent AOT compile store (utils/devices.CompileStore):
+        # hits loaded the executable from disk, fresh paid a real
+        # compile — a replica warm-started from a populated store
+        # reports compile_fresh == 0 (BENCH_SERVE_FLEET adjudication)
+        self._compile_store = compile_store
+        self.compile_store_hits = 0  # guarded-by: _lock
+        self.compile_fresh = 0  # guarded-by: _lock
         self._lock = threading.Lock()
 
         # dispatcher state + service statistics
@@ -395,6 +424,9 @@ class InferenceEngine:
         self._consec_failures = 0  # guarded-by: _lock
         self._open_until = 0.0  # guarded-by: _lock — monotonic probe point
         self.trip_count = 0  # guarded-by: _lock
+        self.probe_count = 0  # guarded-by: _lock — open -> half_open
+        # transitions: how many probes this breaker ever admitted (the
+        # fleet hammer test pins exactly one in flight per open window)
         self.batch_failures = 0  # guarded-by: _lock
         self.deadline_expired = 0  # guarded-by: _lock
         self.queue_rejections = 0  # guarded-by: _lock
@@ -434,6 +466,7 @@ class InferenceEngine:
                 # all admission checks passed (so the probe window has
                 # elapsed): this request IS the probe
                 self._breaker_state = "half_open"
+                self.probe_count += 1
             # the queue is unbounded (admission bounding is the qsize
             # check above), so this put never blocks — and it must stay
             # under the lock so a request can never land behind the
@@ -555,8 +588,10 @@ class InferenceEngine:
         # participates in the documented env-over-config-over-default
         # precedence
         knobs = resolve_md_farm(self._structure_cfg)
+        with self._lock:  # hot-swap-consistent snapshot of the served state
+            variables = self._variables
         return TrajectoryFarm(
-            self._model, self._variables, self.mcfg, self._structure_cfg,
+            self._model, variables, self.mcfg, self._structure_cfg,
             bucket=self.buckets[0], dt=dt,
             skin=self.md_skin if skin is None else float(skin),
             mass=mass, force_scale=force_scale,
@@ -651,13 +686,26 @@ class InferenceEngine:
     def health(self) -> dict:
         """Liveness/saturation snapshot for monitors and load balancers:
         breaker state, queue depth, trip/failure counters, dispatcher
-        liveness. Cheap — counters only, no device work."""
+        liveness, model version + uptime (the hot-swap observability
+        contract: the version tag is echoed here AND on every resolved
+        future, so a swap is verifiable end to end). Cheap — counters
+        only, no device work."""
         with self._lock:
             return {
                 "state": ("shutdown" if self._closed
                           else self._breaker_state),
+                "model_version": self.model_version,
+                "uptime_s": time.monotonic() - self._started_at,
+                "swap_count": self.swap_count,
                 "queue_depth": self._queue.qsize(),
                 "trip_count": self.trip_count,
+                "probe_count": self.probe_count,
+                # the router's re-admission hook: an open breaker whose
+                # probe window elapsed will admit the next submit as its
+                # single half-open probe
+                "breaker_probe_due": (
+                    self._breaker_state == "open"
+                    and time.monotonic() >= self._open_until),
                 "consecutive_failures": self._consec_failures,
                 "batch_failures": self.batch_failures,
                 "deadline_expired": self.deadline_expired,
@@ -678,6 +726,58 @@ class InferenceEngine:
         futs = [self.submit(s) for s in samples]
         return [f.result(timeout=timeout) for f in futs]
 
+    def swap_variables(self, variables, version: str) -> str:
+        """Zero-downtime model hot-swap: atomically replace the served
+        state with `variables` and tag subsequent futures/health with
+        `version`; returns the version it replaced.
+
+        The swap is ONE reference assignment under the engine lock —
+        every batch snapshots its (variables, version) pair under the
+        same lock, so a batch serves entirely-old or entirely-new,
+        never a torn mix. The compiled bucket programs take variables
+        as a runtime argument, so a swap costs zero recompiles. For the
+        fleet's drain contract (requests in flight when the swap lands
+        keep their admission-time behavior), the ReplicaRouter drains
+        the replica first (docs/serving.md "Fleet").
+
+        Tree structure and leaf shapes/dtypes must match the serving
+        state — the compiled programs are shape-specialized, and a
+        mismatched checkpoint must fail THIS call, not poison every
+        subsequent batch. The ``swap-fail`` fault site fires before any
+        mutation, so an injected failure leaves the old version serving
+        (tests/test_serving_fleet.py pins the rollback)."""
+        fault_point("swap-fail")
+        import jax
+        new_vars = {"params": variables["params"],
+                    "batch_stats": variables.get("batch_stats", {})}
+        with self._lock:
+            old_vars = self._variables
+        old_shapes = jax.tree_util.tree_map(
+            lambda a: (getattr(a, "shape", None), getattr(a, "dtype", None)),
+            old_vars)
+        new_shapes = jax.tree_util.tree_map(
+            lambda a: (getattr(a, "shape", None), getattr(a, "dtype", None)),
+            new_vars)
+        if old_shapes != new_shapes:
+            raise ValueError(
+                "swap_variables: the new state's tree/shapes/dtypes do "
+                "not match the serving state — the compiled programs are "
+                "shape-specialized; rebuild the engine for an "
+                "architecture change instead of hot-swapping it")
+        with self._lock:
+            old_version = self.model_version
+            self._variables = new_vars
+            self.model_version = str(version)
+            self.swap_count += 1
+        return old_version
+
+    def latency_snapshot(self) -> List[float]:
+        """Raw request latencies (seconds) since the last reset — the
+        fleet router aggregates these across replicas for fleet-wide
+        percentiles (per-replica percentiles cannot be combined)."""
+        with self._lock:
+            return list(self._latencies)
+
     def forward_single(self, sample: GraphSample,
                        bucket: Optional[PackBudget] = None):
         """The per-request reference path: one sample, padded alone into
@@ -694,14 +794,18 @@ class InferenceEngine:
         if bucket is None:
             bucket = select_bucket(self.buckets, 1, req.n, req.e)
         shards = [[req]] + [[] for _ in range(self.num_shards - 1)]
-        outs = self._forward_requests(shards, bucket)
+        outs, _ = self._forward_requests(shards, bucket)
         return self._unpad(shards, bucket, outs)[0]
 
     def warmup(self) -> int:
         """Precompile every bucket (and for `num_shards > 1` the stacked
         SPMD shape) with a zeroed proto batch; returns the number of
         compiled programs. After warmup no request pays a compile — the
-        bench's compile-count bound."""
+        bench's compile-count bound. With a `compile_store`, buckets
+        whose executables are already on disk LOAD instead of compiling
+        (`compile_store_hits` vs `compile_fresh` in stats() report the
+        split; a replica warmed from a populated store reports
+        compile_fresh == 0)."""
         for bucket in self.buckets:
             proto = self._collate_bucket([self._proto], bucket)
             if self.num_shards > 1:
@@ -797,9 +901,14 @@ class InferenceEngine:
                     if self._total_edge_slots else 0.0),
                 "max_queue_depth": self.max_queue_depth,
                 "compile_count": self.compile_count,
+                "compile_store_hits": self.compile_store_hits,
+                "compile_fresh": self.compile_fresh,
                 "num_buckets": len(self.buckets),
                 "compute_dtype": self.compute_dtype,
                 "parity": self.parity,
+                "model_version": self.model_version,
+                "swap_count": self.swap_count,
+                "probe_count": self.probe_count,
                 "batch_failures": self.batch_failures,
                 "deadline_expired": self.deadline_expired,
                 "queue_rejections": self.queue_rejections,
@@ -889,21 +998,54 @@ class InferenceEngine:
                   for s in shards]
         return _stack_batches(filled)
 
+    def _store_key(self, bucket: PackBudget) -> str:
+        """Compile-store fingerprint for one bucket's program: model
+        config + bucket shape + everything else that changes the
+        compiled artifact (dtype, shard count, schema layout). The
+        store itself folds in the jax version and backend platform."""
+        p = self._proto
+        schema = tuple(
+            (name, None if getattr(p, name) is None
+             else tuple(np.asarray(getattr(p, name)).shape[1:]))
+            for name in ("x", "pos", "edge_attr", "edge_shifts", "cell"))
+        from ..utils.devices import CompileStore
+        return CompileStore.fingerprint(
+            self.mcfg, (bucket.n_node, bucket.n_edge, bucket.n_graph),
+            self.compute_dtype, self.num_shards, self.neighbor_k,
+            self.ef_forward, schema)
+
     def _get_compiled(self, bucket: PackBudget, proto_batch: GraphBatch):
         with self._lock:
             hit = self._compiled.get(bucket)
+            variables = self._variables
         if hit is not None:
             return hit
-        compiled = self._jit_forward.lower(self._variables,
-                                           proto_batch).compile()
+        # persistent AOT store first (docs/serving.md "Fleet"): a hit
+        # skips tracing AND compiling entirely; a miss compiles fresh
+        # and persists so the NEXT replica (or process) warms from disk
+        compiled = None
+        from_store = False
+        if self._compile_store is not None:
+            compiled = self._compile_store.load(self._store_key(bucket))
+            from_store = compiled is not None
+        if compiled is None:
+            compiled = self._jit_forward.lower(variables,
+                                               proto_batch).compile()
+            if self._compile_store is not None:
+                self._compile_store.save(self._store_key(bucket), compiled)
         with self._lock:
             hit = self._compiled.setdefault(bucket, compiled)
             if hit is compiled:
                 self.compile_count += 1
+                if from_store:
+                    self.compile_store_hits += 1
+                else:
+                    self.compile_fresh += 1
         return hit
 
     def _forward_requests(self, shards: List[List[_Request]],
-                          bucket: PackBudget) -> List[np.ndarray]:
+                          bucket: PackBudget
+                          ) -> Tuple[List[np.ndarray], str]:
         if self.num_shards > 1:
             parts = [self._collate_bucket([r.sample for r in sh], bucket)
                      if sh else None for sh in shards]
@@ -912,8 +1054,14 @@ class InferenceEngine:
             batch = self._collate_bucket([r.sample for r in shards[0]],
                                          bucket)
         compiled = self._get_compiled(bucket, batch)
-        outs = compiled(self._variables, batch)
-        return [np.asarray(o) for o in outs]
+        # ONE snapshot of the (variables, version) pair: a concurrent
+        # hot-swap lands entirely before or entirely after this batch,
+        # and the echoed version always names the weights that ran
+        with self._lock:
+            variables = self._variables
+            version = self.model_version
+        outs = compiled(variables, batch)
+        return [np.asarray(o) for o in outs], version
 
     def _unpad(self, shards: List[List[_Request]], bucket: PackBudget,
                outs: List[np.ndarray]) -> List[List[np.ndarray]]:
@@ -1017,7 +1165,7 @@ class InferenceEngine:
                     rec.add("serve.queue_wait", r.t_submit,
                             t_disp - r.t_submit, "serving")
                 t_fwd = _spans.now()
-            outs = self._forward_requests(shards, bucket)
+            outs, version = self._forward_requests(shards, bucket)
             if rec is not None:
                 rec.add("serve.forward", t_fwd, _spans.now() - t_fwd,
                         "serving",
@@ -1047,6 +1195,8 @@ class InferenceEngine:
                 req.future.parity = self.parity       # bucket this batch
                 req.future.parity_rtol = self.parity_rtol  # ran on + the
                 req.future.parity_atol = self.parity_atol  # parity bound
+                req.future.model_version = version  # + the hot-swap tag:
+                # which weights actually served this request
                 req.future.set_result(res)
         except BaseException as e:  # noqa: BLE001 — must reach the callers
             # dispatcher supervision: a failed batch resolves only ITS OWN
@@ -1128,6 +1278,7 @@ class InferenceEngine:
                         "request was queued before the trip")
                 else:
                     self._breaker_state = "half_open"
+                    self.probe_count += 1
         if err is None:
             return False
         if not req.future.done():

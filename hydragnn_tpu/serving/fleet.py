@@ -1,0 +1,733 @@
+"""Fleet-grade serving: a replica router over N inference engines.
+
+One engine = one process was the PR 3-10 serving story: a dispatcher
+death, a tripped breaker, or a model upgrade takes the whole service
+down, and every fresh process recompiles the whole bucket ladder at
+warmup. This module composes the existing primitives — per-engine
+circuit breakers and admission contracts (PR 4), health()/metrics
+(PR 7), the BEST/LATEST checkpoint contract (PR 4), and the persistent
+AOT compile store (utils/devices.CompileStore) — into a fleet that
+survives replica death and model upgrades with zero lost futures
+(docs/serving.md "Fleet"):
+
+* ``ReplicaRouter`` fronts N ``InferenceEngine`` replicas, each built by
+  the caller's ``engine_factory(idx)`` with its own device/shard set and
+  its OWN breaker — failure isolation is per replica: one replica's
+  tripped breaker or dead dispatcher never rejects traffic the others
+  can serve.
+* Dispatch is least-queue-depth over the routable replicas (breaker
+  closed, dispatcher alive, not draining), ties broken by replica index
+  — a pure function of the health snapshot.
+* A request that fails for REPLICA-level reasons (dead dispatcher,
+  breaker rejection, a failed batch) is re-dispatched to another
+  replica, bounded by ``max_redispatch`` attempts; the router-level
+  future resolves EXACTLY ONCE — a "dead" replica's late resolution is
+  detected and dropped (execution is at-least-once under a kill,
+  resolution is exactly-once; adjudicated under injected
+  ``replica-kill`` faults by tests + BENCH_SERVE_FLEET). Request-level
+  failures (deadline expiry, schema validation) resolve immediately —
+  they would fail identically anywhere.
+* Unhealthy replicas are ejected from rotation by their own breaker
+  state; once a breaker's probe window elapses the router routes ONE
+  live request to it as the half-open probe (the engine admits exactly
+  one fleet-wide per open replica — the hammer test pins it). A
+  successful probe closes the breaker and the replica re-enters
+  rotation; a failed one re-opens it and the probe request re-dispatches
+  to a healthy replica.
+* ``hot_swap`` upgrades the model with zero downtime: replicas swap one
+  at a time (the rest keep serving) — drain (no new dispatches, wait
+  for in-flight requests) → atomic ``engine.swap_variables`` → back in
+  rotation. ``hot_swap_from_checkpoint`` feeds it from the PR 4
+  BEST/LATEST contract. The version tag is echoed on every future and
+  in ``/healthz``. The ``swap-fail`` fault site makes a swap fail
+  cleanly BEFORE mutation: the old version keeps serving, no request
+  fails.
+* ``kill_replica`` is the deterministic stand-in for process death
+  (driven by the ``replica-kill`` fault site): the replica leaves
+  rotation immediately, its in-flight requests re-dispatch, and
+  ``restart_replica`` builds a replacement engine from the factory —
+  which warms from the persistent compile store in seconds instead of
+  recompiling the ladder (0 fresh compiles on a populated store).
+
+Lock discipline (docs/static_analysis.md): this file is in hydralint's
+lock-discipline scope — `# guarded-by: _lock` state is machine-checked,
+and no blocking call sits under the lock. Engine calls (submit/health/
+swap) are made OUTSIDE the router lock; the lock order is always
+router -> engine, and engines never call back into the router while
+holding their own lock (futures resolve outside the engine lock), so
+the two lock classes cannot deadlock.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..telemetry.registry import get_registry
+from ..utils.faults import InjectedFault, fault_point
+from .engine import (CircuitOpenError, DeadlineExceededError,
+                     InferenceEngine, QueueFullError, ServingError)
+
+
+class FleetUnavailableError(ServingError):
+    """No routable replica: every replica is dead, shut down, or
+    breaker-open inside its window (and none is due a probe)."""
+
+
+class SwapFailedError(ServingError):
+    """hot_swap could not swap one or more replicas (the report names
+    them); the failed replicas keep serving the OLD version."""
+
+
+class _RouterRequest:
+    """One router-level request: the caller's future plus the
+    re-dispatch bookkeeping. `resolved` flips exactly once under the
+    router lock — the idempotency point for late results from killed
+    replicas."""
+
+    __slots__ = ("sample", "future", "deadline_ms", "attempts", "tried",
+                 "resolved", "wait_deadline")
+
+    def __init__(self, sample, deadline_ms):
+        self.sample = sample
+        self.future: Future = Future()
+        self.deadline_ms = deadline_ms
+        self.attempts = 0   # dispatches consumed (first + re-dispatches)
+        self.tried = set()  # replica idxs that failed this request
+        #                     (membership only — never iterated)
+        self.resolved = False
+        self.wait_deadline = None  # ONE transient-unavailability wait
+        # budget for the request's whole lifetime (set on first
+        # _await_routable) — per-call deadlines would reset on every
+        # retry and turn the bound into an unbounded spin
+
+
+class _Replica:
+    """Router-side view of one engine replica. Mutable fields are
+    guarded by the ROUTER lock (they are router bookkeeping, not engine
+    state — the engine's own counters live behind its own lock)."""
+
+    __slots__ = ("idx", "engine", "alive", "draining", "inflight",
+                 "dispatched")
+
+    def __init__(self, idx: int, engine: InferenceEngine):
+        self.idx = idx
+        self.engine = engine
+        self.alive = True
+        self.draining = False
+        self.inflight: Dict[_RouterRequest, Future] = {}
+        self.dispatched = 0  # router-side dispatch count (health())
+
+
+class ReplicaRouter:
+    """N-replica serving fleet: least-queue-depth dispatch, per-replica
+    failure isolation, exactly-once request resolution under replica
+    death, zero-downtime hot-swap, compile-store-warmed restarts.
+
+    `engine_factory(idx)` builds replica `idx`'s InferenceEngine —
+    device placement, shard set, and the shared compile store are the
+    factory's choice; the router only requires the replicas to accept
+    the same request schema. All replicas are built (and optionally
+    warmed) at construction."""
+
+    def __init__(self, engine_factory: Callable[[int], InferenceEngine],
+                 num_replicas: int, *,
+                 max_redispatch: Optional[int] = None,
+                 drain_timeout_s: float = 30.0,
+                 unavailable_wait_s: float = 5.0):
+        if num_replicas < 1:
+            raise ValueError("ReplicaRouter needs num_replicas >= 1")
+        self._factory = engine_factory
+        self._replicas: List[_Replica] = [
+            _Replica(i, engine_factory(i)) for i in range(num_replicas)]
+        # one try per replica by default: N replicas = N total dispatch
+        # attempts = N - 1 RE-dispatches. A request that failed on every
+        # replica has seen the whole fleet — surface the REAL error (the
+        # last batch failure), not an extra retry's availability noise
+        self.max_redispatch = (int(max_redispatch)
+                               if max_redispatch is not None
+                               else max(num_replicas - 1, 0))
+        self.drain_timeout_s = float(drain_timeout_s)
+        # how long submit() waits for a drain/swap to finish before
+        # fast-failing when it left no routable replica (single-replica
+        # fleets hot-swapping); multi-replica fleets never wait
+        self.unavailable_wait_s = float(unavailable_wait_s)
+        self._lock = threading.Lock()
+        self._closed = False  # guarded-by: _lock
+        self.requests_done = 0  # guarded-by: _lock
+        self.redispatch_count = 0  # guarded-by: _lock
+        self.duplicate_resolutions = 0  # guarded-by: _lock — late results
+        #   from killed/raced replicas dropped by the exactly-once gate
+        self.stale_failures = 0  # guarded-by: _lock — failures from a
+        #   dispatch kill_replica already superseded, dropped (the live
+        #   re-dispatched copy owns the outcome)
+        self.kill_count = 0  # guarded-by: _lock
+        self.restart_count = 0  # guarded-by: _lock
+        self.swap_attempts = 0  # guarded-by: _lock
+        self.swap_failures = 0  # guarded-by: _lock
+        self._metrics_server = None
+
+    # ------------------------------------------------------------ client API
+
+    def submit(self, sample, deadline_ms: Optional[float] = None) -> Future:
+        """Route one request to the best replica; returns a Future that
+        resolves exactly once — with the result of whichever replica
+        finally served it (re-dispatched transparently across replica
+        death / breaker rejection / batch failure), or with the terminal
+        error. The resolved future carries the serving replica's
+        breadcrumbs (`.bucket`, `.parity*`, `.model_version`) plus
+        `.replica` (its index)."""
+        rr = _RouterRequest(sample, deadline_ms)
+        self._dispatch(rr)
+        return rr.future
+
+    def predict(self, samples: Sequence, timeout=None):
+        """Submit all samples, wait, return results in order."""
+        futs = [self.submit(s) for s in samples]
+        return [f.result(timeout=timeout) for f in futs]
+
+    def warmup(self) -> List[dict]:
+        """Warm every live replica's bucket ladder; per-replica report of
+        {replica, compiled, store_hits, fresh} — on a populated compile
+        store, `fresh` is 0 (the BENCH_SERVE_FLEET adjudication)."""
+        reports = []
+        for rep in self._replicas:
+            with self._lock:
+                skip = not rep.alive
+            if skip:
+                continue
+            rep.engine.warmup()
+            st = rep.engine.stats()
+            reports.append({"replica": rep.idx,
+                            "compiled": st["compile_count"],
+                            "store_hits": st["compile_store_hits"],
+                            "fresh": st["compile_fresh"]})
+        return reports
+
+    def health(self) -> dict:
+        """Fleet liveness aggregate: "serving" while at least one replica
+        is routable (alive + breaker not rejecting), else "unavailable";
+        "shutdown" after shutdown(). Includes every replica's own
+        health() (model_version/uptime_s included) keyed by index, so
+        one probe shows the whole fleet including the hot-swap version
+        tags."""
+        with self._lock:
+            closed = self._closed
+            reps = list(self._replicas)
+            alive = {r.idx: r.alive for r in reps}
+            draining = {r.idx: r.draining for r in reps}
+            dispatched = {r.idx: r.dispatched for r in reps}
+            counters = {
+                "requests_done": self.requests_done,
+                "redispatches": self.redispatch_count,
+                "duplicate_resolutions": self.duplicate_resolutions,
+                "stale_failures": self.stale_failures,
+                "kills": self.kill_count,
+                "restarts": self.restart_count,
+                "swap_attempts": self.swap_attempts,
+                "swap_failures": self.swap_failures,
+            }
+        replicas = {}
+        routable = 0
+        for rep in reps:
+            h = rep.engine.health()
+            h["alive"] = alive[rep.idx]
+            h["draining"] = draining[rep.idx]
+            h["dispatched"] = dispatched[rep.idx]
+            # routable mirrors _pick EXACTLY: a half_open replica is
+            # NOT routable (its probe owns the breaker) — /healthz must
+            # never say "serving" while every dispatch would fail
+            if (alive[rep.idx] and not draining[rep.idx]
+                    and h["dispatcher_alive"]
+                    and (h["state"] == "closed"
+                         or h.get("breaker_probe_due"))):
+                routable += 1
+            replicas[str(rep.idx)] = h
+        state = ("shutdown" if closed
+                 else "serving" if routable else "unavailable")
+        out = {"state": state, "num_replicas": len(reps),
+               "routable_replicas": routable, "replicas": replicas}
+        out.update(counters)
+        return out
+
+    def stats(self) -> dict:
+        """Fleet-aggregate service stats: counter sums plus TRUE
+        fleet-wide latency percentiles computed from the concatenated
+        raw per-replica latencies (per-replica percentiles cannot be
+        combined)."""
+        from ..utils.profiling import latency_percentiles
+        with self._lock:
+            reps = list(self._replicas)
+            out = {
+                "requests_done": self.requests_done,
+                "redispatches": self.redispatch_count,
+                "duplicate_resolutions": self.duplicate_resolutions,
+                "stale_failures": self.stale_failures,
+                "kills": self.kill_count,
+                "restarts": self.restart_count,
+            }
+        latencies: List[float] = []
+        per_replica = {}
+        for rep in reps:
+            st = rep.engine.stats()
+            latencies.extend(rep.engine.latency_snapshot())
+            per_replica[str(rep.idx)] = st
+        out["replicas"] = per_replica
+        out["requests"] = sum(st["requests"]
+                              for st in per_replica.values())
+        out["batches"] = sum(st["batches"] for st in per_replica.values())
+        out.update(latency_percentiles(latencies))
+        return out
+
+    def reset_stats(self) -> None:
+        """Zero every live replica's service counters (compile caches and
+        the router's lifecycle counters untouched) — bench phases report
+        closed-loop and open-loop stats separately."""
+        with self._lock:
+            reps = list(self._replicas)
+        for rep in reps:
+            rep.engine.reset_stats()
+
+    def start_metrics_server(self, host: str = "127.0.0.1", port: int = 0):
+        """ONE aggregated HTTP endpoint for the whole fleet
+        (telemetry/http.py): GET /healthz -> the fleet health()
+        aggregate (200 while >= 1 replica is routable), GET /metrics ->
+        per-replica-labeled Prometheus gauges (breaker state one-hot per
+        replica, queue depths, model-version info) + fleet counters +
+        the process registry. port=0 binds an ephemeral port — N
+        replicas' engines and one router can all serve metrics from a
+        single process without colliding; the bound port is
+        `server.port`."""
+        if self._metrics_server is not None:
+            return self._metrics_server
+        from ..telemetry.http import serve_fleet_metrics
+        self._metrics_server = serve_fleet_metrics(self, host=host,
+                                                   port=port)
+        return self._metrics_server
+
+    def shutdown(self, wait: bool = True):
+        """Stop routing and shut every replica down (each drains its own
+        queue — no hung callers). Idempotent."""
+        server, self._metrics_server = self._metrics_server, None
+        if server is not None:
+            server.stop()
+        with self._lock:
+            self._closed = True
+            reps = list(self._replicas)
+        for rep in reps:
+            rep.engine.shutdown(wait=wait)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.shutdown(wait=True)
+        return False
+
+    # -------------------------------------------------- failure / lifecycle
+
+    def kill_replica(self, idx: int) -> int:
+        """Abrupt replica death (the ``replica-kill`` fault site's
+        effect, also callable directly by chaos drivers): the replica
+        leaves rotation immediately and every router request in flight
+        on it re-dispatches to a healthy replica. Returns the number of
+        re-dispatched requests.
+
+        The dying engine is shut down in the background — in-process it
+        may still resolve some of its futures, and the exactly-once gate
+        drops those late results (`duplicate_resolutions` counts them):
+        execution is at-least-once under a kill, resolution is
+        exactly-once."""
+        with self._lock:
+            rep = self._replicas[idx]
+            if not rep.alive:
+                return 0
+            rep.alive = False
+            self.kill_count += 1
+            victims = list(rep.inflight)
+            rep.inflight.clear()
+        get_registry().counter_inc(
+            "serve.fleet_kills_total",
+            help="replicas removed from rotation by kill_replica")
+        # non-blocking: the dying dispatcher drains on its own thread;
+        # whatever it still resolves is dropped by the exactly-once gate
+        rep.engine.shutdown(wait=False)
+        moved = 0
+        for rr in victims:
+            with self._lock:
+                if rr.resolved:
+                    continue
+                rr.tried.add(idx)
+                self.redispatch_count += 1
+            moved += 1
+            get_registry().counter_inc(
+                "serve.fleet_redispatches_total",
+                help="requests re-dispatched off a dead/failed replica")
+            self._dispatch(rr)
+        return moved
+
+    def restart_replica(self, idx: int, warmup: bool = True) -> dict:
+        """Replace a dead (or live) replica with a fresh engine from the
+        factory and return its warmup report — with a shared persistent
+        compile store the replacement warms from disk: 0 fresh compiles,
+        seconds instead of a ladder recompile (docs/serving.md
+        "Fleet"). Restarting a LIVE replica re-dispatches its in-flight
+        requests exactly like a kill — the old engine's drain-time
+        resolutions are stale, so without the re-dispatch those callers
+        would hang."""
+        engine = self._factory(idx)
+        with self._lock:
+            rep = self._replicas[idx]
+            old_engine, was_alive = rep.engine, rep.alive
+            victims = list(rep.inflight)
+            rep.engine = engine
+            rep.alive = True
+            rep.draining = False
+            rep.inflight = {}
+            self.restart_count += 1
+        if was_alive:
+            old_engine.shutdown(wait=False)
+        for rr in victims:
+            with self._lock:
+                if rr.resolved:
+                    continue
+                self.redispatch_count += 1
+            self._dispatch(rr)
+        report = {"replica": idx, "compiled": 0, "store_hits": 0,
+                  "fresh": 0, "warmup_s": 0.0}
+        if warmup:
+            t0 = time.perf_counter()
+            engine.warmup()
+            st = engine.stats()
+            report.update(compiled=st["compile_count"],
+                          store_hits=st["compile_store_hits"],
+                          fresh=st["compile_fresh"],
+                          warmup_s=time.perf_counter() - t0)
+        return report
+
+    def drain_replica(self, idx: int,
+                      timeout_s: Optional[float] = None) -> None:
+        """Take one replica out of rotation and wait until its in-flight
+        requests (router-tracked futures AND its queued engine requests)
+        have resolved. The caller re-admits via `undrain_replica` (or
+        hot_swap, which wraps drain -> swap -> undrain). Raises
+        TimeoutError when the drain outlives `timeout_s`."""
+        deadline = time.monotonic() + (self.drain_timeout_s
+                                       if timeout_s is None
+                                       else float(timeout_s))
+        with self._lock:
+            rep = self._replicas[idx]
+            rep.draining = True
+        while True:
+            with self._lock:
+                inflight = len(rep.inflight)
+            depth = rep.engine.health()["queue_depth"]
+            if inflight == 0 and depth == 0:
+                return
+            if time.monotonic() >= deadline:
+                with self._lock:
+                    rep.draining = False  # re-admit: a wedged drain must
+                    # not silently keep capacity out of rotation
+                raise TimeoutError(
+                    f"replica {idx} did not drain in time "
+                    f"({inflight} in flight, queue depth {depth})")
+            time.sleep(0.002)
+
+    def undrain_replica(self, idx: int) -> None:
+        with self._lock:
+            self._replicas[idx].draining = False
+
+    def hot_swap(self, variables, version: str,
+                 raise_on_failure: bool = True) -> dict:
+        """Zero-downtime rolling model upgrade: for each live replica —
+        drain (the REST keep serving) -> atomic ``swap_variables`` ->
+        back into rotation. No request fails because of the swap:
+        requests in flight on the draining replica complete on the old
+        weights, requests arriving during its drain route to the other
+        replicas, and the version tag on every future names the weights
+        that actually served it.
+
+        A failed swap (the ``swap-fail`` fault site, a mismatched
+        checkpoint) leaves THAT replica serving the old version and is
+        reported in `failed`; with `raise_on_failure` a SwapFailedError
+        summarizes them after the roll completes (never mid-roll — a
+        partial fleet on the new version plus an exception would be the
+        worst of both)."""
+        with self._lock:
+            self.swap_attempts += 1
+            reps = [r for r in self._replicas if r.alive]
+        report = {"version": str(version), "replicas": {}, "failed": []}
+        for rep in reps:
+            try:
+                self.drain_replica(rep.idx)
+                try:
+                    old = rep.engine.swap_variables(variables, version)
+                    report["replicas"][str(rep.idx)] = {
+                        "from": old, "to": str(version)}
+                finally:
+                    self.undrain_replica(rep.idx)
+            except (InjectedFault, ValueError, TimeoutError,
+                    RuntimeError) as exc:
+                with self._lock:
+                    self.swap_failures += 1
+                report["failed"].append(
+                    {"replica": rep.idx, "error":
+                     f"{type(exc).__name__}: {exc}"})
+                import logging
+                logging.getLogger("hydragnn_tpu").warning(
+                    "hot-swap to %s failed on replica %d (%s); the old "
+                    "version keeps serving there", version, rep.idx, exc)
+        get_registry().counter_inc(
+            "serve.fleet_swaps_total",
+            help="hot-swap rolls attempted across the fleet")
+        if report["failed"] and raise_on_failure:
+            raise SwapFailedError(
+                f"hot-swap to {version!r} failed on "
+                f"{len(report['failed'])} replica(s): {report['failed']} "
+                "— they keep serving the old version; fix the checkpoint "
+                "and re-run hot_swap")
+        return report
+
+    def hot_swap_from_checkpoint(self, state_template, log_name: str,
+                                 path: str = "./logs",
+                                 which: str = "best",
+                                 version: Optional[str] = None) -> dict:
+        """hot_swap fed from the PR 4 checkpoint contract: restore the
+        BEST (or LATEST) committed checkpoint for `log_name` onto
+        `state_template` (a TrainState matching the serving
+        architecture) and roll it out. The version tag defaults to
+        "<which>:step_<n>" so /healthz and every future name the exact
+        checkpoint serving."""
+        from ..utils.checkpoint import load_best_model, load_existing_model
+        if which == "best":
+            state = load_best_model(state_template, log_name, path=path)
+        elif which == "latest":
+            state = load_existing_model(state_template, log_name, path=path)
+        else:
+            raise ValueError(
+                f"which={which!r} — hot_swap_from_checkpoint restores "
+                "'best' (the BEST marker) or 'latest' (the LATEST marker)")
+        if state is None:
+            raise FileNotFoundError(
+                f"no verified {which.upper()} checkpoint for run "
+                f"'{log_name}' under {path}")
+        if version is None:
+            version = f"{which}:step_{int(state.step)}"
+        variables = {"params": state.params,
+                     "batch_stats": state.batch_stats}
+        return self.hot_swap(variables, version)
+
+    # ------------------------------------------------------------- dispatch
+
+    def _pick(self, rr: _RouterRequest) -> Optional[_Replica]:
+        """The routing policy, a pure function of the health snapshot:
+        probe-due replicas first (ONE request buys back a whole
+        replica's capacity; the engine admits exactly one probe), then
+        the closed-breaker replica with the smallest queue depth, ties
+        by index. Replicas this request already failed on are avoided
+        until only they remain."""
+        with self._lock:
+            candidates = [r for r in self._replicas
+                          if r.alive and not r.draining]
+        untried = [r for r in candidates if r.idx not in rr.tried]
+        if untried:
+            candidates = untried
+        closed = []
+        probe_due = []
+        for rep in candidates:
+            h = rep.engine.health()
+            if h["state"] == "shutdown" or not h["dispatcher_alive"]:
+                self._mark_dead(rep)
+                continue
+            if h["state"] == "closed":
+                closed.append((h["queue_depth"], rep.idx, rep))
+            elif h["state"] == "open" and h["breaker_probe_due"]:
+                probe_due.append(rep)
+        if probe_due:
+            return probe_due[0]
+        if closed:
+            return min(closed)[2]
+        return None
+
+    def _mark_dead(self, rep: _Replica) -> None:
+        with self._lock:
+            rep.alive = False
+
+    def _dispatch(self, rr: _RouterRequest) -> None:
+        """Place `rr` on a replica (or resolve it with the terminal
+        error). Runs on the submitting thread for fresh requests and on
+        a replica's dispatcher thread for re-dispatches — never holds
+        the router lock across an engine call."""
+        last_err: Optional[BaseException] = None
+        while True:
+            with self._lock:
+                closed = self._closed
+            if closed:
+                self._resolve(rr, exc=RuntimeError(
+                    "ReplicaRouter is shut down"))
+                return
+            try:
+                # deterministic chaos: replica-kill@k kills the replica
+                # the k-th router dispatch selects (utils/faults.py)
+                fault_point("replica-kill")
+                kill = False
+            except InjectedFault:
+                kill = True
+            rep = self._pick(rr)
+            if rep is None:
+                if self._await_routable(rr):
+                    continue
+                self._resolve(rr, exc=FleetUnavailableError(
+                    "no routable replica (all dead, draining, or "
+                    "breaker-open)" + (f"; last error: {last_err}"
+                                       if last_err else "")))
+                return
+            if kill:
+                # the selected replica dies before this request lands on
+                # it — its in-flight requests re-dispatch; this request
+                # just re-picks (it was never registered there)
+                self.kill_replica(rep.idx)
+                continue
+            with self._lock:
+                if not rep.alive:  # killed between _pick and here
+                    continue
+                rep.inflight[rr] = None  # registered BEFORE submit: a
+                # kill landing mid-submit re-dispatches this request
+                # instead of stranding it on the dead engine
+                rep.dispatched += 1
+                rr.attempts += 1
+            try:
+                fut = rep.engine.submit(rr.sample,
+                                        deadline_ms=rr.deadline_ms)
+            except (QueueFullError, CircuitOpenError) as exc:
+                with self._lock:
+                    rep.inflight.pop(rr, None)
+                    rr.tried.add(rep.idx)
+                last_err = exc
+                if self._budget_spent(rr):
+                    self._resolve(rr, exc=exc)
+                    return
+                continue
+            except RuntimeError as exc:
+                # dispatcher died / engine shut down underneath us:
+                # the replica is gone, not the request
+                with self._lock:
+                    rep.inflight.pop(rr, None)
+                    rr.tried.add(rep.idx)
+                self._mark_dead(rep)
+                last_err = exc
+                if self._budget_spent(rr):
+                    self._resolve(rr, exc=exc)
+                    return
+                continue
+            with self._lock:
+                if rr in rep.inflight:
+                    rep.inflight[rr] = fut
+            fut.add_done_callback(
+                lambda f, rr=rr, rep=rep: self._on_result(rr, rep, f))
+            return
+
+    def _budget_spent(self, rr: _RouterRequest) -> bool:
+        # first dispatch is free; re-dispatches consume the budget
+        with self._lock:
+            return rr.attempts > self.max_redispatch
+
+    def _await_routable(self, rr: _RouterRequest) -> bool:
+        """When nothing is routable only TRANSIENTLY — a drain/swap in
+        progress, or a half-open probe in flight (it resolves to closed
+        or to a re-probeable open in moments) — wait briefly instead of
+        failing the request. Returns True to retry the pick; False when
+        the fleet is genuinely down (dead replicas, open breakers not
+        yet due). The wait budget is PER REQUEST, not per call — the
+        dispatch loop re-enters here after every failed pick, and a
+        fresh deadline each time would wait forever on a wedged
+        probe/drain."""
+        if rr.wait_deadline is None:
+            rr.wait_deadline = time.monotonic() + self.unavailable_wait_s
+        while time.monotonic() < rr.wait_deadline:
+            with self._lock:
+                alive = [r for r in self._replicas if r.alive]
+                transient = any(r.draining for r in alive)
+            if not transient:
+                transient = any(
+                    r.engine.health()["state"] == "half_open"
+                    for r in alive)
+            if not transient:
+                return False  # genuinely unavailable — fail fast
+            time.sleep(0.002)
+            with self._lock:
+                ready = [r for r in self._replicas
+                         if r.alive and not r.draining]
+            if ready:
+                return True  # re-pick: it may now be closed/probe-due
+        return False
+
+    def _on_result(self, rr: _RouterRequest, rep: _Replica,
+                   fut: Future) -> None:
+        """Replica future resolved: settle the router future exactly
+        once, or re-dispatch a replica-level failure. Runs on the
+        replica's dispatcher thread with NO locks held by the engine."""
+        with self._lock:
+            registered = rr in rep.inflight
+            rep.inflight.pop(rr, None)
+            if rr.resolved:
+                self.duplicate_resolutions += 1
+                return
+        exc = fut.exception()
+        if exc is None:
+            self._resolve(rr, result=fut.result(), source=fut,
+                          replica=rep.idx)
+            return
+        if not registered:
+            # kill_replica already moved this request off this replica:
+            # the live re-dispatched copy owns the outcome, and a stale
+            # failure from the dying dispatcher must neither burn the
+            # re-dispatch budget nor resolve the future with an error a
+            # concurrent live copy is about to beat
+            with self._lock:
+                self.stale_failures += 1
+            return
+        if isinstance(exc, (DeadlineExceededError, ValueError)):
+            # request-level: it would fail identically on any replica
+            # (the deadline is already gone / the schema is wrong)
+            self._resolve(rr, exc=exc)
+            return
+        # replica-level (dead dispatcher, breaker, failed batch):
+        # re-dispatch while the budget lasts
+        with self._lock:
+            rr.tried.add(rep.idx)
+        if self._budget_spent(rr):
+            self._resolve(rr, exc=exc)
+            return
+        with self._lock:
+            self.redispatch_count += 1
+        get_registry().counter_inc(
+            "serve.fleet_redispatches_total",
+            help="requests re-dispatched off a dead/failed replica")
+        self._dispatch(rr)
+
+    def _resolve(self, rr: _RouterRequest, result=None, exc=None,
+                 source: Optional[Future] = None,
+                 replica: Optional[int] = None) -> bool:
+        """The exactly-once gate: the first resolution wins, every later
+        one is counted and dropped."""
+        with self._lock:
+            if rr.resolved:
+                self.duplicate_resolutions += 1
+                return False
+            rr.resolved = True
+            self.requests_done += 1
+        if exc is not None:
+            rr.future.set_exception(exc)
+            return True
+        if source is not None:
+            # carry the serving engine's breadcrumbs out to the caller
+            for attr in ("bucket", "parity", "parity_rtol", "parity_atol",
+                         "model_version", "rebuilt", "graph_build_ms"):
+                if hasattr(source, attr):
+                    setattr(rr.future, attr, getattr(source, attr))
+        if replica is not None:
+            rr.future.replica = replica
+        rr.future.set_result(result)
+        return True

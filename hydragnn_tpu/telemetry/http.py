@@ -55,6 +55,10 @@ def engine_prometheus(engine, registry: Optional[MetricsRegistry] = None
          "submits fast-failed by the open breaker"),
         ("serving_breaker_trips_total", stats["trip_count"],
          "circuit-breaker open transitions"),
+        ("serving_breaker_probes_total", stats["probe_count"],
+         "half-open probes admitted (one per open window)"),
+        ("serving_swaps_total", stats["swap_count"],
+         "model hot-swaps applied (swap_variables)"),
         # raw-structure serving (docs/serving.md): rebuilds vs updates
         # is the neighbor-bound-vs-compute-bound discriminator
         ("serving_structure_requests_total", stats["structure_requests"],
@@ -79,6 +83,10 @@ def engine_prometheus(engine, registry: Optional[MetricsRegistry] = None
          "high-water queue depth since reset"),
         ("serving_compile_count", stats["compile_count"],
          "compiled bucket programs (frozen at ladder length after warmup)"),
+        ("serving_compile_store_hits", stats["compile_store_hits"],
+         "bucket programs loaded from the persistent AOT compile store"),
+        ("serving_compile_fresh", stats["compile_fresh"],
+         "bucket programs compiled fresh (store miss or no store)"),
         ("serving_num_buckets", stats["num_buckets"],
          "bucket ladder length"),
         ("serving_dispatcher_alive", float(health["dispatcher_alive"]),
@@ -94,6 +102,11 @@ def engine_prometheus(engine, registry: Optional[MetricsRegistry] = None
         scrape.gauge_set("serving_breaker_state",
                          1.0 if health["state"] == s else 0.0,
                          help="one-hot breaker state", state=s)
+    # hot-swap observability: the version tag as an info gauge, so a
+    # scrape can verify a swap end to end (docs/serving.md "Fleet")
+    scrape.gauge_set("serving_model", 1.0,
+                     help="info gauge: the model version being served",
+                     version=str(health["model_version"]))
     # latency quantiles (always the full key set — utils/profiling
     # .latency_percentiles returns zeroed quantiles before any traffic)
     for q in ("p50_ms", "p95_ms", "p99_ms", "mean_ms"):
@@ -165,6 +178,116 @@ class MetricsServer:
     @property
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
+
+
+def fleet_prometheus(router, registry: Optional[MetricsRegistry] = None
+                     ) -> str:
+    """Prometheus text for a ReplicaRouter (docs/serving.md "Fleet"):
+    fleet counters, TRUE fleet-wide latency quantiles, and per-replica
+    gauges carrying a ``replica`` label — including the per-replica
+    breaker-state one-hot (`serving_replica_breaker_state{replica="0",
+    state="open"}`) and a model-version info gauge so a single scrape
+    shows which replica serves which checkpoint mid-hot-swap."""
+    scrape = MetricsRegistry()
+    health = router.health()
+    stats = router.stats()
+    fleet_counters = (
+        ("serving_fleet_requests_total", stats["requests_done"],
+         "router-level requests resolved (exactly once each)"),
+        ("serving_fleet_redispatches_total", stats["redispatches"],
+         "requests re-dispatched off a dead/failed replica"),
+        ("serving_fleet_duplicate_resolutions_total",
+         stats["duplicate_resolutions"],
+         "late replica results dropped by the exactly-once gate"),
+        ("serving_fleet_stale_failures_total", stats["stale_failures"],
+         "failures from kill-superseded dispatches, dropped (the live "
+         "re-dispatched copy owns the outcome)"),
+        ("serving_fleet_kills_total", stats["kills"],
+         "replicas removed from rotation by kill_replica"),
+        ("serving_fleet_restarts_total", stats["restarts"],
+         "replicas replaced by restart_replica"),
+        ("serving_fleet_swap_attempts_total", health["swap_attempts"],
+         "hot-swap rolls attempted"),
+        ("serving_fleet_swap_failures_total", health["swap_failures"],
+         "per-replica hot-swap failures (old version kept serving)"),
+    )
+    for name, value, help_text in fleet_counters:
+        scrape.counter_inc(name, float(value), help=help_text)
+    scrape.gauge_set("serving_fleet_replicas",
+                     float(health["num_replicas"]),
+                     help="replicas configured")
+    scrape.gauge_set("serving_fleet_routable_replicas",
+                     float(health["routable_replicas"]),
+                     help="replicas currently accepting dispatches")
+    for q in ("p50_ms", "p95_ms", "p99_ms", "mean_ms"):
+        scrape.gauge_set("serving_fleet_latency_ms",
+                         float(stats.get(q, 0.0)),
+                         help="fleet-wide request latency quantiles "
+                              "(raw latencies pooled across replicas)",
+                         quantile=q[:-3])
+    for idx in sorted(health["replicas"]):
+        h = health["replicas"][idx]
+        st = stats["replicas"].get(idx, {})
+        scrape.gauge_set("serving_replica_alive",
+                         1.0 if h["alive"] else 0.0,
+                         help="1 while the replica is in the rotation "
+                              "set (0 = killed/dead)", replica=idx)
+        scrape.gauge_set("serving_replica_queue_depth",
+                         float(h["queue_depth"]),
+                         help="requests queued on this replica",
+                         replica=idx)
+        scrape.gauge_set("serving_replica_uptime_s", float(h["uptime_s"]),
+                         help="seconds since this replica engine started",
+                         replica=idx)
+        scrape.counter_inc("serving_replica_requests_total",
+                           float(st.get("requests", 0)),
+                           help="requests this replica resolved",
+                           replica=idx)
+        scrape.counter_inc("serving_replica_breaker_trips_total",
+                           float(h["trip_count"]),
+                           help="breaker open transitions on this replica",
+                           replica=idx)
+        scrape.counter_inc("serving_replica_breaker_probes_total",
+                           float(h["probe_count"]),
+                           help="half-open probes this replica admitted",
+                           replica=idx)
+        for s in ("closed", "open", "half_open", "shutdown"):
+            scrape.gauge_set("serving_replica_breaker_state",
+                             1.0 if h["state"] == s else 0.0,
+                             help="one-hot breaker state per replica",
+                             replica=idx, state=s)
+        scrape.gauge_set("serving_replica_model",
+                         1.0, help="info gauge: the model version this "
+                                   "replica is serving (hot-swap tag)",
+                         replica=idx, version=str(h["model_version"]))
+    text = scrape.to_prometheus()
+    reg = registry if registry is not None else get_registry()
+    return text + reg.to_prometheus()
+
+
+def serve_fleet_metrics(router, host: str = "127.0.0.1", port: int = 0,
+                        registry: Optional[MetricsRegistry] = None
+                        ) -> MetricsServer:
+    """One aggregated MetricsServer for a whole replica fleet:
+    /healthz returns the router's fleet aggregate (200 while at least
+    one replica is routable, 503 when the fleet is unavailable or shut
+    down), /metrics the per-replica-labeled exposition. port=0 binds an
+    ephemeral port, so N engines + a router can all expose metrics from
+    one process without collisions."""
+
+    def healthz() -> Tuple[int, str, str]:
+        h = router.health()
+        return (200 if h["state"] == "serving" else 503,
+                "application/json", json.dumps(h, sort_keys=True))
+
+    def metrics() -> Tuple[int, str, str]:
+        return (200, "text/plain; version=0.0.4; charset=utf-8",
+                fleet_prometheus(router, registry))
+
+    server = MetricsServer({"/healthz": healthz, "/metrics": metrics},
+                           host=host, port=port)
+    server.start()
+    return server
 
 
 def serve_engine_metrics(engine, host: str = "127.0.0.1", port: int = 0,

@@ -1,4 +1,5 @@
-"""Backend liveness probing and recovery for the axon-tunneled TPU.
+"""Backend liveness probing and recovery for the axon-tunneled TPU,
+plus the persistent AOT compile store the serving fleet warms from.
 
 The tunnel can wedge: ``jax.devices()`` then hangs forever in-process, and
 ``JAX_PLATFORMS=cpu`` in the env is overridden by the axon sitecustomize.
@@ -7,10 +8,13 @@ in a throwaway subprocess and force a working CPU platform when needed.
 """
 from __future__ import annotations
 
+import hashlib
 import os
+import pickle
 import re
 import subprocess
 import sys
+import threading
 import time
 from typing import Optional, Tuple
 
@@ -107,6 +111,116 @@ def resolve_compile_cache_dir(default: Optional[str] = None
         if val is not None:
             return val
     return default
+
+
+class CompileStore:
+    """Persistent AOT executable store: serialized compiled programs on
+    disk, keyed by a caller-supplied fingerprint (docs/serving.md
+    "Fleet").
+
+    The jax in-process compile cache dies with the process and the
+    XLA compilation cache (``enable_compile_cache``) still pays tracing
+    plus a cache probe per program; this store pickles the COMPILED
+    executable (``jax.experimental.serialize_executable``) so a
+    replacement serving replica can load its whole bucket ladder from
+    disk in seconds — ``InferenceEngine.warmup()`` on a warm store
+    reports 0 fresh compiles (BENCH_SERVE_FLEET adjudicates it).
+
+    Contract: same machine class, same backend, same jax version — the
+    serialized artifact embeds compiled code, exactly like XLA's own CPU
+    AOT cache entries. ``fingerprint()`` folds the jax version and the
+    live backend platform into every key, and any load failure (corrupt
+    file, foreign artifact, incompatible runtime) degrades to a miss —
+    the caller compiles fresh and overwrites. Writes are atomic
+    (tmp + ``os.replace``); a lost rename race means a peer replica won,
+    which is fine because keyed contents are identical by construction.
+    Thread-safe; one store may back every replica in a process."""
+
+    SUFFIX = ".jaxexec"
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self._lock = threading.Lock()
+        self.hits = 0  # guarded-by: _lock
+        self.misses = 0  # guarded-by: _lock
+        self.saves = 0  # guarded-by: _lock
+        self.errors = 0  # guarded-by: _lock
+
+    @staticmethod
+    def fingerprint(*parts) -> str:
+        """Stable key from repr()s of the parts + jax version + backend
+        platform (an artifact compiled for another runtime must never be
+        a hit)."""
+        import jax
+        h = hashlib.sha256()
+        h.update(f"jax={jax.__version__}".encode())
+        h.update(f";backend={jax.devices()[0].platform}".encode())
+        for p in parts:
+            h.update(b";")
+            h.update(repr(p).encode())
+        return h.hexdigest()
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key + self.SUFFIX)
+
+    def load(self, key: str):
+        """The deserialized executable for `key`, or None on a miss —
+        including ANY failure to read/deserialize (corrupt entry,
+        runtime mismatch): the store must degrade to a fresh compile,
+        never take a warmup down."""
+        path = self._path(key)
+        if not os.path.exists(path):
+            with self._lock:
+                self.misses += 1
+            return None
+        try:
+            from jax.experimental.serialize_executable import \
+                deserialize_and_load
+            with open(path, "rb") as f:
+                payload, in_tree, out_tree = pickle.load(f)
+            loaded = deserialize_and_load(payload, in_tree, out_tree)
+        except Exception as exc:  # noqa: BLE001 — degrade to a miss
+            import logging
+            logging.getLogger("hydragnn_tpu").warning(
+                "compile store entry %s is unloadable (%s: %s); "
+                "compiling fresh", path, type(exc).__name__, exc)
+            with self._lock:
+                self.errors += 1
+                self.misses += 1
+            return None
+        with self._lock:
+            self.hits += 1
+        return loaded
+
+    def save(self, key: str, compiled) -> bool:
+        """Serialize `compiled` under `key`; atomic, best-effort (a full
+        or read-only disk warns and returns False — the run already has
+        its executable in memory)."""
+        try:
+            from jax.experimental.serialize_executable import serialize
+            payload, in_tree, out_tree = serialize(compiled)
+            tmp = self._path(key) + f".tmp-{os.getpid()}"
+            with open(tmp, "wb") as f:
+                pickle.dump((payload, in_tree, out_tree), f)
+            os.replace(tmp, self._path(key))
+        except Exception as exc:  # noqa: BLE001 — best-effort persistence
+            import logging
+            logging.getLogger("hydragnn_tpu").warning(
+                "compile store save for %s failed (%s: %s); continuing "
+                "without persisting", key[:12], type(exc).__name__, exc)
+            with self._lock:
+                self.errors += 1
+            return False
+        with self._lock:
+            self.saves += 1
+        return True
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "saves": self.saves, "errors": self.errors,
+                    "root": self.root}
 
 
 def enable_compile_cache(cache_dir: Optional[str],

@@ -15,7 +15,7 @@ Plan grammar (``HYDRAGNN_FAULT_PLAN`` env / ``Training.fault_plan``)::
     plan  := entry (';' entry)*
     entry := site '@' index (',' index)*
     site  := checkpoint-write | loader-fetch | forward-step
-             | serving-dispatch
+             | serving-dispatch | replica-kill | swap-fail
     index := non-negative int — the 0-based invocation count of that site
 
 Example: ``forward-step@7;serving-dispatch@2,5`` kills the 8th training
@@ -39,7 +39,14 @@ import threading
 from typing import Dict, FrozenSet, List, Optional, Tuple
 
 SITES = ("checkpoint-write", "loader-fetch", "forward-step",
-         "serving-dispatch")
+         "serving-dispatch", "replica-kill", "swap-fail")
+# Fleet-level sites (docs/fault_tolerance.md, serving/fleet.py):
+# ``replica-kill`` fires once per ReplicaRouter dispatch and abruptly
+# kills the replica the router selected for that request (its in-flight
+# requests re-dispatch to a healthy replica, each resolving exactly
+# once); ``swap-fail`` fires once per InferenceEngine.swap_variables and
+# makes that hot-swap fail cleanly BEFORE any state mutated (the old
+# model version keeps serving).
 
 
 class InjectedFault(RuntimeError):

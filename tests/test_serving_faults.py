@@ -220,6 +220,111 @@ def test_breaker_reopens_on_failed_probe(served):
         eng.shutdown()
 
 
+def test_fleet_half_open_single_probe_hammer(served):
+    """The fleet probe contract under concurrency (PR 12 satellite):
+    with BOTH replicas' breakers open and their windows elapsed, a
+    concurrent submit hammer through the router admits EXACTLY ONE
+    half-open probe per open replica fleet-wide (engine.probe_count),
+    the probes succeed, and every hammered future resolves."""
+    from hydragnn_tpu.serving.fleet import ReplicaRouter
+    samples, mcfg, model, variables = served
+
+    def factory(idx):
+        return InferenceEngine(model, variables, mcfg,
+                               reference_samples=samples,
+                               max_batch_size=2, max_wait_ms=0.0,
+                               breaker_threshold=1, breaker_reset_s=0.3)
+
+    router = ReplicaRouter(factory, 2)
+    try:
+        router.warmup()  # cold compiles must not eat the probe windows
+        # one poisoned request trips BOTH breakers: its batch fails on
+        # the first replica (dispatch fault 0), re-dispatches, and fails
+        # on the second (dispatch fault 1). The budget is one try per
+        # replica, so the REAL error (the injected batch failure)
+        # surfaces — not an extra retry's availability noise
+        install_fault_plan(parse_fault_plan("serving-dispatch@0,1"))
+        with pytest.raises(InjectedFault):
+            router.submit(samples[0]).result(timeout=60)
+        states = [h["state"]
+                  for _, h in sorted(router.health()["replicas"].items())]
+        assert states == ["open", "open"]
+        probes_before = [h["probe_count"] for _, h in
+                         sorted(router.health()["replicas"].items())]
+        assert probes_before == [0, 0]
+
+        time.sleep(0.35)  # both probe windows elapse
+        barrier = threading.Barrier(8)
+        futs = []
+        futs_lock = threading.Lock()
+
+        def hammer(k):
+            barrier.wait()
+            for s in samples[1 + 2 * k:3 + 2 * k]:
+                f = router.submit(s)
+                with futs_lock:
+                    futs.append(f)
+
+        threads = [threading.Thread(target=hammer, args=(k,))
+                   for k in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        for f in futs:
+            f.exception(timeout=60)
+        assert all(f.done() for f in futs)  # nothing hangs or leaks
+        health = router.health()
+        per_rep = [h for _, h in sorted(health["replicas"].items())]
+        # the pinned claim: exactly ONE probe admitted per open replica,
+        # regardless of 16 concurrent submits racing the window
+        assert [h["probe_count"] for h in per_rep] == [1, 1]
+        assert [h["trip_count"] for h in per_rep] == [1, 1]
+        assert [h["state"] for h in per_rep] == ["closed", "closed"]
+        # post-recovery the fleet serves normally
+        assert router.submit(samples[0]).result(timeout=60) is not None
+    finally:
+        router.shutdown()
+
+
+def test_expired_probe_reopens_instead_of_wedging(served):
+    """A probe that expires unexecuted must RE-OPEN the breaker (so the
+    next submit becomes a fresh probe) — not wedge half-open forever."""
+    samples, _, _, _ = served
+    eng = _engine(served, max_batch_size=1, max_wait_ms=0.0,
+                  breaker_threshold=1, breaker_reset_s=0.1)
+    block = None
+    try:
+        eng.warmup()
+        install_fault_plan(parse_fault_plan("serving-dispatch@0"))
+        with pytest.raises(InjectedFault):
+            eng.submit(samples[0]).result(timeout=60)
+        assert eng.health()["state"] == "open"
+        time.sleep(0.15)  # window elapses
+        block = _BlockedDispatcher(eng)
+        probe = eng.submit(samples[1], deadline_ms=20.0)  # THE probe
+        assert eng.health()["state"] == "half_open"
+        assert eng.health()["probe_count"] == 1
+        # concurrent submits are rejected while the probe is in flight
+        with pytest.raises(CircuitOpenError):
+            eng.submit(samples[2])
+        time.sleep(0.05)  # the probe's deadline lapses while queued
+        block.release.set()
+        with pytest.raises(DeadlineExceededError):
+            probe.result(timeout=60)
+        assert eng.health()["state"] == "open"  # re-opened, not wedged
+        # the window is already past: the next submit is a NEW probe and
+        # recovery completes
+        f = eng.submit(samples[3])
+        assert f.result(timeout=60) is not None
+        assert eng.health()["state"] == "closed"
+        assert eng.health()["probe_count"] == 2
+    finally:
+        if block is not None:
+            block.release.set()
+        eng.shutdown()
+
+
 def test_queued_requests_fail_fast_behind_open_breaker(served):
     """Requests already queued when the breaker trips must not hang: the
     dispatcher resolves them with CircuitOpenError."""

@@ -58,6 +58,7 @@ case "$shard" in
     # original shard split and were previously in no shard
     python -m pytest -q tests/test_async_loader.py tests/test_packing.py \
       tests/test_serving.py tests/test_serving_faults.py \
+      tests/test_serving_fleet.py \
       tests/test_faults.py tests/test_env_lint.py tests/test_lint.py \
       tests/test_ref_shims.py tests/test_telemetry.py
     ;;

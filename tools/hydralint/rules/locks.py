@@ -24,8 +24,9 @@ rule turns both audits into structure:
 
 The check is lexical by design — it cannot see a lock held by a caller,
 which is what the ``holds-lock`` annotation documents. Scope:
-serving/engine.py, datasets/async_loader.py, telemetry/registry.py (the
-three concurrent subsystems with audited locking contracts).
+serving/engine.py, serving/fleet.py, datasets/async_loader.py,
+telemetry/registry.py (the concurrent subsystems with audited locking
+contracts).
 """
 from __future__ import annotations
 
@@ -37,6 +38,7 @@ from ..engine import Finding, Rule
 
 SCOPE_FILES = (
     "hydragnn_tpu/serving/engine.py",
+    "hydragnn_tpu/serving/fleet.py",
     "hydragnn_tpu/datasets/async_loader.py",
     "hydragnn_tpu/telemetry/registry.py",
 )
