@@ -1,0 +1,23 @@
+"""Fault-tolerant HPO at pod scale (docs/hpo.md, ROADMAP item 5).
+
+``TrialSupervisor`` runs N concurrent trials as preemptible child jobs
+on top of the PR 4 resume contract: kill a trial anywhere, resume
+bitwise; exploit/explore by forking BEST checkpoints (pbt.py). The
+launch-command builders and in-process search loops stay in
+``hydragnn_tpu.utils.hpo``; this package is the supervision layer that
+keeps those trials alive under preemption, hangs, and node loss.
+"""
+from .ledger import TrialLedger
+from .pbt import fork_checkpoint, perturb_params, select_fork_source
+from .process import ProcessLauncher, ProcessTrialHandle
+from .supervisor import (COMPLETED, FAILED, PENDING, PRUNED, RESUMING,
+                         RUNNING, TERMINAL_STATES, TrialHandle,
+                         TrialRecord, TrialSpec, TrialSupervisor)
+
+__all__ = [
+    "TrialLedger", "fork_checkpoint", "perturb_params",
+    "select_fork_source", "ProcessLauncher", "ProcessTrialHandle",
+    "TrialHandle", "TrialRecord", "TrialSpec", "TrialSupervisor",
+    "PENDING", "RUNNING", "RESUMING", "COMPLETED", "PRUNED", "FAILED",
+    "TERMINAL_STATES",
+]

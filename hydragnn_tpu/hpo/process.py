@@ -1,0 +1,223 @@
+"""Subprocess trial launcher (docs/hpo.md).
+
+Each launch runs ``python -m hydragnn_tpu.hpo.runner`` in the trial's
+own directory with its own process group, so a kill — the supervisor's
+watchdog, the ``trial-kill`` chaos site, or shutdown — takes the whole
+tree down with one ``killpg`` and no grandchild can outlive its trial
+still holding devices (the utils/hpo.orchestrate lesson). Progress is
+probed from the outside: the newest COMMITTED checkpoint step under the
+trial's run dirs plus the byte size of the redirected child log — the
+two signals the issue's heartbeat contract names.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.checkpoint import COMMIT_MARKER
+from .supervisor import TrialHandle, TrialSpec
+
+# run-dir basename for the checkpoint a PBT fork adopts; underscored so
+# the progress probe (which skips "_"-prefixed run dirs) never mistakes
+# the donor's copied checkpoint for child progress
+FORK_DONOR_NAME = "_fork_donor"
+FORK_META = "FORK.json"
+
+
+def _repo_root() -> str:
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def _child_env(extra: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Child-trial environment: the parent's env with the package
+    importable from the trial cwd and the parent's fault plan masked —
+    the trial sites are SUPERVISOR-side; a child training process must
+    never inherit a chaos plan meant for the scheduler above it.
+    (The one sanctioned raw-env read in this module: constructing a
+    child env, not parsing flags — hydralint loose-env-read scoped
+    allowlist.)"""
+    env = dict(os.environ)
+    root = _repo_root()
+    prev = env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = root + (os.pathsep + prev if prev else "")
+    env["HYDRAGNN_FAULT_PLAN"] = ""  # set-but-empty = explicitly none
+    if extra:
+        env.update(extra)
+    return env
+
+
+def committed_steps(trial_dir: str) -> List[int]:
+    """Sorted COMMITTED checkpoint steps across the trial's own run
+    dirs, skipping "_"-prefixed dirs (a fork-donor copy is not the
+    trial's progress). The ONE definition of "this trial has committed
+    work" — the supervisor-side progress probe, the runner's resume
+    detection, and the hang-wedge trigger all derive from it."""
+    steps: List[int] = []
+    for ckpt_dir in sorted(glob.glob(
+            os.path.join(trial_dir, "logs", "*", "checkpoint"))):
+        run_name = os.path.basename(os.path.dirname(ckpt_dir))
+        if run_name.startswith("_"):
+            continue
+        for p in sorted(os.listdir(ckpt_dir)):
+            if (p.startswith("step_") and p.split("_")[-1].isdigit()
+                    and os.path.exists(os.path.join(ckpt_dir, p,
+                                                    COMMIT_MARKER))):
+                steps.append(int(p.split("_")[-1]))
+    return sorted(steps)
+
+
+def _committed_step_under(trial_dir: str) -> Optional[int]:
+    """Newest COMMITTED checkpoint step, or None before the first."""
+    steps = committed_steps(trial_dir)
+    return steps[-1] if steps else None
+
+
+class ProcessTrialHandle(TrialHandle):
+    """One child training process (group) + its on-disk progress."""
+
+    def __init__(self, proc: subprocess.Popen, trial_dir: str,
+                 log_path: str):
+        self.proc = proc
+        self.trial_dir = trial_dir
+        self.log_path = log_path
+
+    def poll(self) -> Optional[int]:
+        return self.proc.poll()
+
+    def kill(self) -> None:
+        """SIGKILL the whole process group, then reap (idempotent).
+        killpg is attempted even when the LEADER already exited: the
+        group outlives it while any member (grandchild) survives, and a
+        crash-exited trial's stragglers must not leak into the next
+        launch (code-review round 3)."""
+        try:
+            os.killpg(self.proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            if self.proc.poll() is None:
+                self.proc.kill()
+        try:
+            self.proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:  # pragma: no cover — SIGKILL
+            # cannot be blocked; only an unkillable-state kernel bug
+            pass
+
+    def progress(self) -> Tuple[int, int]:
+        try:
+            log_size = os.path.getsize(self.log_path)
+        except OSError:
+            log_size = 0
+        step = _committed_step_under(self.trial_dir)
+        return (-1 if step is None else step, log_size)
+
+    def checkpoint_step(self) -> Optional[int]:
+        return _committed_step_under(self.trial_dir)
+
+    def result(self) -> Optional[Dict[str, Any]]:
+        path = os.path.join(self.trial_dir, "result.json")
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def group_alive(self) -> bool:
+        """True while ANY process in the trial's group survives — the
+        zero-orphans adjudication probe (BENCH_HPO)."""
+        try:
+            os.killpg(self.proc.pid, 0)
+            return True
+        except (ProcessLookupError, PermissionError):
+            return False
+
+
+class ProcessLauncher:
+    """launch_fn for TrialSupervisor: real child training processes.
+
+    ``work_dir/trial_<id>/`` holds each trial's cwd (its ./logs run
+    dirs, trial.log, result.json). Construction knobs mirror the runner
+    CLI; ``extra_env`` lets a caller pin per-trial devices
+    (TPU_VISIBLE_CHIPS) the way utils/hpo.create_launch_command does."""
+
+    def __init__(self, work_dir: str, *, num_epochs: int = 4,
+                 num_configs: int = 24, data_seed: int = 0,
+                 hang_after_epoch: int = 1,
+                 python: str = sys.executable,
+                 extra_env: Optional[Dict[str, str]] = None):
+        self.work_dir = os.path.abspath(work_dir)
+        self.num_epochs = int(num_epochs)
+        self.num_configs = int(num_configs)
+        self.data_seed = int(data_seed)
+        self.hang_after_epoch = int(hang_after_epoch)
+        self.python = python
+        self.extra_env = dict(extra_env or {})
+        self.handles: List[ProcessTrialHandle] = []
+
+    def trial_dir(self, trial_id: int) -> str:
+        return os.path.join(self.work_dir, f"trial_{int(trial_id):04d}")
+
+    def _prepare_fork(self, spec: TrialSpec, trial_dir: str) -> None:
+        """Adopt the donor's BEST checkpoint (pbt.fork_checkpoint) under
+        the ``_fork_donor`` run name; the runner turns FORK.json into
+        ``continue=1, startfrom=_fork_donor`` — weights restored, epoch
+        0 training (the reference's transfer semantics)."""
+        from .pbt import fork_checkpoint
+        donor_dir = self.trial_dir(spec.forked_from)
+        candidates = sorted(glob.glob(
+            os.path.join(donor_dir, "logs", "*", "checkpoint")))
+        candidates = [c for c in candidates
+                      if not os.path.basename(
+                          os.path.dirname(c)).startswith("_")]
+        if not candidates:
+            raise FileNotFoundError(
+                f"fork donor trial {spec.forked_from} has no run dir "
+                f"under {donor_dir}")
+        dst = os.path.join(trial_dir, "logs", FORK_DONOR_NAME,
+                           "checkpoint")
+        step, val = fork_checkpoint(candidates[-1], dst)
+        meta = {"startfrom": FORK_DONOR_NAME, "donor_step": step,
+                "donor_val": val,
+                "donor_trial": int(spec.forked_from)}
+        with open(os.path.join(trial_dir, FORK_META), "w") as f:
+            json.dump(meta, f)
+
+    def __call__(self, spec: TrialSpec, attempt: int, resume: bool,
+                 hang: bool) -> ProcessTrialHandle:
+        trial_dir = self.trial_dir(spec.trial_id)
+        os.makedirs(trial_dir, exist_ok=True)
+        if spec.forked_from is not None and not resume and \
+                not os.path.exists(os.path.join(trial_dir, FORK_META)):
+            self._prepare_fork(spec, trial_dir)
+        cmd = [self.python, "-m", "hydragnn_tpu.hpo.runner",
+               "--params", json.dumps(spec.params, sort_keys=True),
+               "--num-epochs", str(self.num_epochs),
+               "--num-configs", str(self.num_configs),
+               "--data-seed", str(self.data_seed)]
+        if resume:
+            cmd.append("--resume")
+        if hang:
+            cmd += ["--hang-after-epoch", str(self.hang_after_epoch)]
+        log_path = os.path.join(trial_dir, "trial.log")
+        # append: the log's byte size is the heartbeat token and must be
+        # monotone across relaunches
+        with open(log_path, "ab") as out:
+            proc = subprocess.Popen(
+                cmd, cwd=trial_dir, stdout=out,
+                stderr=subprocess.STDOUT,
+                env=_child_env(self.extra_env),
+                start_new_session=True)
+        handle = ProcessTrialHandle(proc, trial_dir, log_path)
+        self.handles.append(handle)
+        return handle
+
+    def live_process_groups(self) -> List[int]:
+        """pids of trial process groups still alive — must be [] after
+        supervisor shutdown (the zero-orphans contract)."""
+        return [h.proc.pid for h in self.handles if h.group_alive()]

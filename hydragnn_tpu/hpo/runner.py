@@ -1,0 +1,245 @@
+"""Child trial entry point: ``python -m hydragnn_tpu.hpo.runner``.
+
+One HPO trial as one training process (docs/hpo.md): builds a small
+deterministic config from the suggested hyperparameters, trains with
+per-epoch COMMITTED checkpoints (the PR 4 resume contract), and writes
+``result.json`` atomically on success. Killed anywhere and relaunched
+with ``--resume``, it restores from LATEST and reproduces its
+uninterrupted trajectory bitwise — the property BENCH_HPO adjudicates.
+
+``--hang-after-epoch N`` is the deterministic stand-in for a wedged
+trial (dead filesystem, stuck collective): train N epochs (checkpoints
+committed), then stop making progress forever so the supervisor's
+heartbeat watchdog must kill and resume it.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+# hyperparameter name -> config path; anything else must be an explicit
+# dotted config path (actionable error otherwise, never silent)
+PARAM_PATHS = {
+    "learning_rate": ("NeuralNetwork", "Training", "Optimizer",
+                      "learning_rate"),
+    "batch_size": ("NeuralNetwork", "Training", "batch_size"),
+    "hidden_dim": ("NeuralNetwork", "Architecture", "hidden_dim"),
+    "num_conv_layers": ("NeuralNetwork", "Architecture",
+                        "num_conv_layers"),
+    "model_type": ("NeuralNetwork", "Architecture", "model_type"),
+}
+
+
+def base_trial_config(num_epochs: int) -> Dict[str, Any]:
+    """Minimal GIN graph-head config (mirrors tests/inputs/ci.json) with
+    the fault-tolerance block the resume contract needs."""
+    return {
+        "Verbosity": {"level": 0},
+        "Dataset": {
+            "name": "hpo_synth",
+            "format": "unit_test",
+            "node_features": {"name": ["x", "x2", "x3"], "dim": [1, 1, 1],
+                              "column_index": [0, 6, 7]},
+            "graph_features": {"name": ["sum_x_x2_x3"], "dim": [1],
+                               "column_index": [0]},
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "model_type": "GIN",
+                "radius": 1.0,
+                "max_neighbours": 100,
+                "hidden_dim": 8,
+                "num_conv_layers": 2,
+                "output_heads": {
+                    "graph": {"num_sharedlayers": 2, "dim_sharedlayers": 4,
+                              "num_headlayers": 2,
+                              "dim_headlayers": [10, 10]},
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["sum_x_x2_x3"],
+                "output_index": [0],
+                "type": ["graph"],
+                "denormalize_output": False,
+            },
+            "Training": {
+                "num_epoch": int(num_epochs),
+                "perc_train": 0.7,
+                "EarlyStopping": False,
+                "patience": 10,
+                "loss_function_type": "mse",
+                "batch_size": 8,
+                "Checkpoint": True,
+                "checkpoint_every_n_epochs": 1,
+                "keep_best": True,
+                "Optimizer": {"type": "AdamW", "learning_rate": 0.005},
+            },
+        },
+    }
+
+
+def apply_params(config: Dict[str, Any],
+                 params: Dict[str, Any]) -> Dict[str, Any]:
+    """Set each suggested hyperparameter at its config path (sorted for
+    a deterministic application order)."""
+    for key in sorted(params):
+        path = PARAM_PATHS.get(key)
+        if path is None:
+            if "." not in key:
+                raise ValueError(
+                    f"unknown hyperparameter {key!r} (known: "
+                    f"{', '.join(sorted(PARAM_PATHS))}; or use a dotted "
+                    "config path like NeuralNetwork.Training.batch_size)")
+            path = tuple(key.split("."))
+        node = config
+        for part in path[:-1]:
+            node = node[part]
+        node[path[-1]] = params[key]
+    return config
+
+
+def _wedge_after_commits(trial_dir: str, n_commits: int) -> None:
+    """Chaos watcher (``--hang-after-epoch``): once `n_commits`
+    checkpoints committed, SIGSTOP our own process — wedged mid-epoch
+    with work safely on disk, exactly the shape of a stuck collective or
+    dead filesystem the heartbeat watchdog exists for."""
+    import signal
+
+    from .process import committed_steps
+    while len(committed_steps(trial_dir)) < int(n_commits):
+        time.sleep(0.001)
+    os.kill(os.getpid(), signal.SIGSTOP)
+
+
+def _has_own_checkpoint(trial_dir: str) -> bool:
+    """Any COMMITTED step dir under this trial's own run dirs (the
+    shared hpo.process.committed_steps layout contract)."""
+    from .process import committed_steps
+    return bool(committed_steps(trial_dir))
+
+
+def synthetic_dataset(num_configs: int, seed: int = 0) -> List:
+    """Deterministic BCC-lattice graph-head dataset (the
+    tests/deterministic_data.py recipe, self-contained so child trials
+    never import the test tree): nodal feature = type/num_types, graph
+    target = sum(x + x^2 + x^3)."""
+    from ..graphs import GraphSample, radius_graph
+    rng = np.random.RandomState(int(seed))
+    samples = []
+    for _ in range(int(num_configs)):
+        ucx, ucy = rng.randint(1, 4), rng.randint(1, 4)
+        ucz = rng.randint(1, 3)
+        pos = []
+        for x in range(ucx):
+            for y in range(ucy):
+                for z in range(ucz):
+                    pos.append([x, y, z])
+                    pos.append([x + 0.5, y + 0.5, z + 0.5])
+        pos = np.asarray(pos, dtype=np.float32)
+        types = np.arange(pos.shape[0]) % 3
+        x = (types.astype(np.float32) + 1.0) / 3.0
+        send, recv = radius_graph(pos, 1.0, 100)
+        y_graph = np.asarray([(x + x ** 2 + x ** 3).sum()], np.float32)
+        samples.append(GraphSample(
+            x=x[:, None], pos=pos, senders=send, receivers=recv,
+            y_graph=y_graph))
+    return samples
+
+
+def run_trial(params: Dict[str, Any], *, num_epochs: int,
+              num_configs: int, data_seed: int, resume: bool,
+              hang_after_epoch: int = 0,
+              trial_dir: str = ".") -> Dict[str, Any]:
+    """Train one trial in ``trial_dir`` (the cwd contract: run dirs land
+    under ./logs). Returns the result payload (also written to
+    result.json unless the hang phase is active)."""
+    from ..preprocess.load_data import split_dataset
+    from ..run_training import run_training
+
+    hang = int(hang_after_epoch) > 0 and not resume
+    config = apply_params(base_trial_config(num_epochs), params)
+    train_cfg = config["NeuralNetwork"]["Training"]
+    if hang:
+        # wedge mid-training once N checkpoints committed: SIGSTOP from
+        # a watcher thread freezes the process anywhere in the epoch
+        # loop — log and checkpoints stop, the supervisor's heartbeat
+        # watchdog kills the group, and the relaunch resumes from LATEST
+        # mid-trajectory (the strongest form of "kill a trial anywhere")
+        import threading
+        threading.Thread(target=_wedge_after_commits,
+                         args=(trial_dir, int(hang_after_epoch)),
+                         daemon=True).start()
+    fork_meta_path = os.path.join(trial_dir, "FORK.json")
+    if resume and _has_own_checkpoint(trial_dir):
+        train_cfg["continue"] = 1
+    elif os.path.exists(fork_meta_path):
+        # first launch of a fork, or a fork killed before its own first
+        # commit: (re-)adopt the donor checkpoint
+        with open(fork_meta_path) as f:
+            fork = json.load(f)
+        train_cfg["continue"] = 1
+        train_cfg["startfrom"] = fork["startfrom"]
+    # else: resume with nothing on disk (killed before the first commit)
+    # restarts from scratch — deterministic training makes the restarted
+    # trajectory identical to the lost one (the BENCH_FAULTS precedent)
+
+    samples = synthetic_dataset(num_configs, seed=data_seed)
+    splits = split_dataset(samples, train_cfg.get("perc_train", 0.7))
+    state, history, _, _ = run_training(config, datasets=splits,
+                                        num_shards=1)
+
+    if hang:
+        # belt-and-braces: if training somehow outran the watcher (it
+        # polls every millisecond against ~100ms epochs), still never
+        # report success from a hang-injected launch — wedge here so the
+        # watchdog path is exercised deterministically
+        while True:
+            time.sleep(3600)
+
+    result = {
+        "objective": float(min(history["val_loss"])),
+        "history": {k: history[k] for k in ("train_loss", "val_loss",
+                                            "test_loss", "lr")},
+        "step": int(state.step),
+        "params": dict(params),
+    }
+    tmp = os.path.join(trial_dir, "result.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=1, sort_keys=True)
+    os.replace(tmp, os.path.join(trial_dir, "result.json"))
+    return result
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--params", default="{}",
+                   help="JSON dict of hyperparameters")
+    p.add_argument("--num-epochs", type=int, default=4)
+    p.add_argument("--num-configs", type=int, default=24)
+    p.add_argument("--data-seed", type=int, default=0)
+    p.add_argument("--resume", action="store_true",
+                   help="continue from this trial dir's LATEST")
+    p.add_argument("--hang-after-epoch", type=int, default=0,
+                   help="chaos: train N epochs then stop progressing")
+    args = p.parse_args(argv)
+    # first heartbeat before any heavy import: the supervisor's progress
+    # token includes the log size, and jax/orbax startup is otherwise a
+    # long silent window the watchdog must not mistake for a hang
+    print(f"hpo-runner: starting (params={args.params} "
+          f"resume={args.resume})", flush=True)
+    run_trial(json.loads(args.params), num_epochs=args.num_epochs,
+              num_configs=args.num_configs, data_seed=args.data_seed,
+              resume=args.resume,
+              hang_after_epoch=args.hang_after_epoch)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

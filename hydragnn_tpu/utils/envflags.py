@@ -312,6 +312,41 @@ def resolve_pipeline(train_cfg, num_stages: int):
     return int(microbatches), schedule, policy, data_shards
 
 
+def resolve_hpo_supervisor(hpo_cfg=None) -> "tuple[int, float, float, int]":
+    """Trial-supervisor knobs (docs/hpo.md) ->
+    (max_retries, heartbeat_s, backoff_s, concurrency).
+
+    Precedence per knob: HYDRAGNN_HPO_* env over the optional config dict
+    (keys max_retries/heartbeat_s/backoff_s/concurrency) over defaults.
+    STRICT parsing — these knobs bound how hard the supervisor fights for
+    a dying trial, so a typo value must warn and fall back, never
+    silently disable recovery (the HYDRAGNN_PALLAS_NBR lesson).
+
+    Knobs:
+      HYDRAGNN_HPO_MAX_RETRIES  relaunches per trial after preemption/
+                                crash/hang before it goes FAILED
+                                (default 2, min 0)
+      HYDRAGNN_HPO_HEARTBEAT_S  progress deadline — a running trial with
+                                no checkpoint or log growth for this long
+                                is killed as hung (default 120, min 0.05)
+      HYDRAGNN_HPO_BACKOFF_S    relaunch backoff base, doubling per
+                                consecutive retry (default 1.0, min 0)
+      HYDRAGNN_HPO_CONCURRENCY  concurrent running trials (default 1,
+                                min 1)
+    """
+    cfg = hpo_cfg or {}
+    retries = env_strict_int("HYDRAGNN_HPO_MAX_RETRIES",
+                             int(cfg.get("max_retries", 2)))
+    heartbeat = env_strict_float("HYDRAGNN_HPO_HEARTBEAT_S",
+                                 float(cfg.get("heartbeat_s", 120.0)))
+    backoff = env_strict_float("HYDRAGNN_HPO_BACKOFF_S",
+                               float(cfg.get("backoff_s", 1.0)))
+    conc = env_strict_int("HYDRAGNN_HPO_CONCURRENCY",
+                          int(cfg.get("concurrency", 1)))
+    return (max(int(retries), 0), max(float(heartbeat), 0.05),
+            max(float(backoff), 0.0), max(int(conc), 1))
+
+
 def resolve_steps_per_call(train_cfg) -> int:
     """Steps-per-call dispatch batching knob: HYDRAGNN_STEPS_PER_CALL env
     overrides Training.steps_per_call (default 1). Shared by run_training

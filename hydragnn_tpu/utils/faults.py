@@ -16,6 +16,7 @@ Plan grammar (``HYDRAGNN_FAULT_PLAN`` env / ``Training.fault_plan``)::
     entry := site '@' index (',' index)*
     site  := checkpoint-write | loader-fetch | forward-step
              | serving-dispatch | replica-kill | swap-fail
+             | trial-kill | trial-hang | trial-spawn-fail
     index := non-negative int — the 0-based invocation count of that site
 
 Example: ``forward-step@7;serving-dispatch@2,5`` kills the 8th training
@@ -39,7 +40,8 @@ import threading
 from typing import Dict, FrozenSet, List, Optional, Tuple
 
 SITES = ("checkpoint-write", "loader-fetch", "forward-step",
-         "serving-dispatch", "replica-kill", "swap-fail")
+         "serving-dispatch", "replica-kill", "swap-fail",
+         "trial-kill", "trial-hang", "trial-spawn-fail")
 # Fleet-level sites (docs/fault_tolerance.md, serving/fleet.py):
 # ``replica-kill`` fires once per ReplicaRouter dispatch and abruptly
 # kills the replica the router selected for that request (its in-flight
@@ -47,6 +49,18 @@ SITES = ("checkpoint-write", "loader-fetch", "forward-step",
 # once); ``swap-fail`` fires once per InferenceEngine.swap_variables and
 # makes that hot-swap fail cleanly BEFORE any state mutated (the old
 # model version keeps serving).
+# Trial-level sites (docs/hpo.md, hpo/supervisor.py): each is consulted
+# exactly once per trial at its FIRST launch — first launches happen in
+# trial-id order and retries never consult again, so index k
+# deterministically names the k-th registered trial no matter how
+# retries interleave under concurrency. ``trial-spawn-fail`` makes
+# trial k's first launch fail before a child exists (the scheduler
+# rejected the job);
+# ``trial-hang`` makes trial k stop making progress so the heartbeat
+# watchdog must kill it; ``trial-kill`` makes the supervisor SIGKILL
+# trial k at its first committed checkpoint (preemption mid-run). All
+# three recover through the same bounded retry + resume-from-LATEST
+# path.
 
 
 class InjectedFault(RuntimeError):

@@ -23,29 +23,53 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 
+def _split_groups(nodelist: str) -> List[str]:
+    """Split a SLURM nodelist on the commas OUTSIDE brackets:
+    'frontier[001-002],borg[005]' -> ['frontier[001-002]', 'borg[005]'].
+    A naive str.split(',') also cuts inside '[001-002,007]'."""
+    groups, depth, start = [], 0, 0
+    for i, ch in enumerate(nodelist):
+        if ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth = max(depth - 1, 0)
+        elif ch == "," and depth == 0:
+            groups.append(nodelist[start:i])
+            start = i + 1
+    groups.append(nodelist[start:])
+    return [g.strip() for g in groups if g.strip()]
+
+
 def parse_slurm_nodelist(nodelist: str) -> List[str]:
-    """Expand 'frontier[00001-00003,00007]' style lists
+    """Expand 'frontier[00001-00003,00007]' style lists, including
+    comma-separated multiple bracketed groups as SLURM emits for
+    heterogeneous allocations — 'frontier[001-002],borg[005]' ->
+    ['frontier001', 'frontier002', 'borg005']. (The pre-fix single
+    trailing-bracket regex treated that whole string as one group and
+    silently returned a wrong node list.)
     (reference: distributed.py:52-83 / deephyper.py:13-46)."""
-    m = re.match(r"^([^\[]+)\[([^\]]+)\]$", nodelist.strip())
-    if not m:
-        return [n for n in nodelist.split(",") if n]
-    prefix, body = m.groups()
-    out = []
-    for part in body.split(","):
-        if "-" in part:
-            lo, hi = part.split("-")
-            width = len(lo)
-            out += [f"{prefix}{str(i).zfill(width)}"
-                    for i in range(int(lo), int(hi) + 1)]
-        else:
-            out.append(f"{prefix}{part}")
+    out: List[str] = []
+    for group in _split_groups(nodelist.strip()):
+        m = re.match(r"^([^\[]+)\[([^\]]+)\]$", group)
+        if not m:
+            out.append(group)
+            continue
+        prefix, body = m.groups()
+        for part in body.split(","):
+            if "-" in part:
+                lo, hi = part.split("-")
+                width = len(lo)
+                out += [f"{prefix}{str(i).zfill(width)}"
+                        for i in range(int(lo), int(hi) + 1)]
+            else:
+                out.append(f"{prefix}{part}")
     return out
 
 
 def read_node_list() -> List[str]:
     """reference: deephyper.py:13 — nodes of the current allocation."""
-    nl = os.environ.get("SLURM_NODELIST") or os.environ.get(
-        "SLURM_JOB_NODELIST", "")
+    from .envflags import env_str
+    nl = env_str("SLURM_NODELIST") or env_str("SLURM_JOB_NODELIST")
     return parse_slurm_nodelist(nl) if nl else []
 
 

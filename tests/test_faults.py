@@ -62,6 +62,22 @@ def test_parse_fault_plan_grammar():
     plan.fault_point("checkpoint-write")
 
 
+def test_parse_fault_plan_trial_sites():
+    """The PR 14 trial sites parse, count, and round-trip like every
+    other site (docs/hpo.md consumes them once per trial launch)."""
+    plan = parse_fault_plan(
+        "trial-kill@1;trial-hang@2;trial-spawn-fail@0")
+    assert plan.injections == {"trial-kill": frozenset({1}),
+                               "trial-hang": frozenset({2}),
+                               "trial-spawn-fail": frozenset({0})}
+    assert parse_fault_plan(plan.spec()).injections == plan.injections
+    with pytest.raises(InjectedFault, match="trial-spawn-fail@0"):
+        plan.fault_point("trial-spawn-fail")
+    plan.fault_point("trial-kill")  # idx 0: free
+    with pytest.raises(InjectedFault, match="trial-kill@1"):
+        plan.fault_point("trial-kill")
+
+
 def test_parse_fault_plan_rejects_malformed():
     for bad in ("forward-step", "warp-core@1", "forward-step@x",
                 "forward-step@-1", "forward-step@", "", ";;"):
@@ -194,6 +210,60 @@ def test_async_best_ckpt_escalates_after_3_failures(monkeypatch):
         fn(None, epoch, 1.0)  # fail,fail,ok,fail,fail — never 3 straight
     with pytest.raises(RuntimeError):
         fn(None, 5, 1.0)  # the 3rd consecutive
+
+
+def test_fork_from_corrupt_best_falls_back_to_newest_verified(tmp_path,
+                                                              caplog):
+    """PBT exploit resilience (PR 14): forking from a BEST marker whose
+    target is uncommitted/corrupt must fall back to the newest VERIFIED
+    checkpoint with a warning instead of crashing the supervisor; with
+    nothing verified it raises an actionable FileNotFoundError."""
+    from hydragnn_tpu.hpo import fork_checkpoint, select_fork_source
+
+    run = "fork_fallback_test"
+    ck.save_model(_tiny_state(step=1, scale=1.0), run, path=str(tmp_path),
+                  mark_best=True, best_val=0.5)
+    ck.save_model(_tiny_state(step=2, scale=2.0), run, path=str(tmp_path))
+    d = ck._ckpt_dir(run, path=str(tmp_path))
+
+    # corrupt the BEST target: drop its commit marker
+    os.remove(os.path.join(d, "step_1", ck.COMMIT_MARKER))
+    with caplog.at_level(logging.WARNING, logger="hydragnn_tpu"):
+        target, val = select_fork_source(d)
+    assert os.path.basename(target) == "step_2"  # newest verified
+    assert val is None  # the fallback has no recorded val to adopt
+    assert any("falling back" in r.message for r in caplog.records)
+
+    # fork_checkpoint degrades the same way end to end
+    dst = str(tmp_path / "forked" / "checkpoint")
+    step, val2 = fork_checkpoint(d, dst)
+    assert step == 2 and val2 is None
+    assert ck.verify_checkpoint(os.path.join(dst, "step_2"))
+
+    # a BEST marker pointing at a missing dir: same fallback
+    with open(os.path.join(d, "BEST"), "w") as f:
+        f.write("step_99\n0.1")
+    target, _ = select_fork_source(d)
+    assert os.path.basename(target) == "step_2"
+
+    # an EMPTY (truncated-mid-write) BEST file: fallback, not IndexError
+    with open(os.path.join(d, "BEST"), "w") as f:
+        f.write("")
+    target, _ = select_fork_source(d)
+    assert os.path.basename(target) == "step_2"
+
+    # a garbled val line on a VALID target: adopt the state, val unknown
+    with open(os.path.join(d, "BEST"), "w") as f:
+        f.write("step_2\nnot-a-float")
+    target, val3 = select_fork_source(d)
+    assert os.path.basename(target) == "step_2" and val3 is None
+
+    # nothing verified at all -> actionable error, not a crash deeper in
+    os.remove(os.path.join(d, "step_2", ck.COMMIT_MARKER))
+    with pytest.raises(FileNotFoundError, match="no verified checkpoint"):
+        select_fork_source(d)
+    with pytest.raises(FileNotFoundError):
+        select_fork_source(str(tmp_path / "does_not_exist"))
 
 
 # ----------------------------------------------------- preemption (SIGTERM)

@@ -63,6 +63,12 @@ def test_traced_env_rule_scope():
     assert rule.applies("hydragnn_tpu/telemetry/registry.py")
     assert rule.applies("hydragnn_tpu/train/precision.py")
     assert rule.applies("hydragnn_tpu/md/farm.py")  # PR 11 farm scan body
+    # PR 14: the HPO supervision layer resolves its knobs via
+    # envflags.resolve_hpo_supervisor; process.py is the documented
+    # child-env-construction exclusion
+    assert rule.applies("hydragnn_tpu/hpo/supervisor.py")
+    assert rule.applies("hydragnn_tpu/hpo/runner.py")
+    assert not rule.applies("hydragnn_tpu/hpo/process.py")
     assert not rule.applies("hydragnn_tpu/parallel/mesh.py")  # documented
     assert not rule.applies("hydragnn_tpu/train/trainer.py")  # host-side
 
@@ -81,6 +87,32 @@ def test_loose_env_rule_fixtures():
     for allowed in r_loose.ALLOWLIST:
         assert not rule.applies(allowed)
     assert "hydragnn_tpu/utils/envflags.py" in r_loose.ALLOWLIST
+
+
+def test_loose_env_scoped_allowlist_is_function_surgical():
+    """PR 14: hpo's former whole-file allowlist entry shrank to the
+    child-env-construction function(s) — a raw read anywhere ELSE in a
+    scoped file is a finding again."""
+    rule = r_loose.LooseEnvReadRule()
+    # scoped files still APPLY (unlike full-allowlist entries)
+    for rel in r_loose.SCOPED_ALLOWLIST:
+        assert rule.applies(rel)
+        assert rel not in r_loose.ALLOWLIST
+    assert "hydragnn_tpu/utils/hpo.py" in r_loose.SCOPED_ALLOWLIST
+    assert "hydragnn_tpu/hpo/process.py" in r_loose.SCOPED_ALLOWLIST
+
+    import ast as _ast
+    src = ("import os\n"
+           "def _launch(spec):\n"
+           "    return dict(os.environ)\n"   # allowed: named function
+           "def resolve_thing():\n"
+           "    return os.getenv('HYDRAGNN_X')\n")  # still a finding
+    tree = _ast.parse(src)
+    findings = rule.check(tree, src, "hydragnn_tpu/utils/hpo.py")
+    assert [f.line for f in findings] == [5]
+    # the same read outside any scoped file is fully covered
+    findings_all = rule.check(tree, src, "hydragnn_tpu/hpo/ledger.py")
+    assert [f.line for f in findings_all] == [3, 5]
 
 
 def test_assert_rule_fixtures():
@@ -138,6 +170,18 @@ def test_determinism_rule_negative_fixtures():
            "        pass\n"
            "    s = set(xs)\n")              # building a set is fine
     assert r_det.find_unsorted_iteration(src, "f.py") == []
+
+
+def test_determinism_and_lock_rule_scope_covers_hpo():
+    """PR 14: the trial supervisor promises deterministic ledgers and
+    fault-site indexing (nondeterministic-order scope) and its state
+    machine is cross-thread mutable (lock-discipline scope)."""
+    det = r_det.NondeterministicOrderRule()
+    assert det.applies("hydragnn_tpu/hpo/supervisor.py")
+    assert det.applies("hydragnn_tpu/hpo/pbt.py")
+    assert det.applies("hydragnn_tpu/hpo/process.py")
+    assert "hydragnn_tpu/hpo/" in r_det.SCOPE_DIRS
+    assert "hydragnn_tpu/hpo/supervisor.py" in r_locks.SCOPE_FILES
 
 
 def test_determinism_rule_scope_covers_md_farm():
@@ -219,7 +263,7 @@ def test_lock_rule_flags_thread_join_under_lock():
 
 
 def test_lock_rule_engaged_on_real_tree():
-    """The three audited concurrent subsystems actually declare guarded
+    """The audited concurrent subsystems actually declare guarded
     state — the rule must never become vacuously green."""
     rule = r_locks.LockDisciplineRule()
     for rel in r_locks.SCOPE_FILES:

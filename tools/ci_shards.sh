@@ -52,15 +52,20 @@ case "$shard" in
     ;;
   robust)
     # infrastructure robustness: input pipeline, packing, serving engine,
-    # fault tolerance (kill/resume + serving failure semantics), the
-    # hydralint suite + env-read shim, telemetry (registry/spans/
-    # /metrics endpoint), reference shims — files that grew after the
+    # fault tolerance (kill/resume + serving failure semantics), the HPO
+    # trial supervisor (in-process fault-site fakes), the hydralint
+    # suite + env-read shim, telemetry (registry/spans//metrics
+    # endpoint), reference shims — files that grew after the
     # original shard split and were previously in no shard
     python -m pytest -q tests/test_async_loader.py tests/test_packing.py \
       tests/test_serving.py tests/test_serving_faults.py \
       tests/test_serving_fleet.py \
       tests/test_faults.py tests/test_env_lint.py tests/test_lint.py \
       tests/test_ref_shims.py tests/test_telemetry.py
+    # the HPO supervisor suite runs its fast lane here; its slow lane is
+    # a multi-minute subprocess chaos e2e (real child training
+    # processes) covered by the nightly hpo-chaos job
+    python -m pytest -q -m "not slow" tests/test_hpo_supervisor.py
     ;;
   zoo)
     # the 13-model accuracy battery (per-model thresholds)

@@ -36,7 +36,12 @@ from ..engine import Finding, Rule
 
 SCOPE_DIRS = ("hydragnn_tpu/graphs/", "hydragnn_tpu/preprocess/",
               "hydragnn_tpu/datasets/", "hydragnn_tpu/parallel/",
-              "hydragnn_tpu/serving/", "hydragnn_tpu/md/")
+              "hydragnn_tpu/serving/", "hydragnn_tpu/md/",
+              # the trial supervisor promises deterministic ledgers and
+              # fault-site indexing: scheduling order, checkpoint-dir
+              # probes, and fork-source selection must never follow set
+              # or filesystem order (PR 14)
+              "hydragnn_tpu/hpo/")
 
 _FS_OS = ("listdir", "scandir")
 _FS_GLOB = ("glob", "iglob")

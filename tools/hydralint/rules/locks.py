@@ -25,8 +25,8 @@ rule turns both audits into structure:
 The check is lexical by design — it cannot see a lock held by a caller,
 which is what the ``holds-lock`` annotation documents. Scope:
 serving/engine.py, serving/fleet.py, datasets/async_loader.py,
-telemetry/registry.py (the concurrent subsystems with audited locking
-contracts).
+telemetry/registry.py, hpo/supervisor.py (the concurrent subsystems
+with audited locking contracts).
 """
 from __future__ import annotations
 
@@ -41,6 +41,10 @@ SCOPE_FILES = (
     "hydragnn_tpu/serving/fleet.py",
     "hydragnn_tpu/datasets/async_loader.py",
     "hydragnn_tpu/telemetry/registry.py",
+    # the trial supervisor's state machine is mutated by its run loop
+    # and read/flagged from other threads (prune/shutdown/snapshot) —
+    # the same audited-concurrency contract as the serving engine (PR 14)
+    "hydragnn_tpu/hpo/supervisor.py",
 )
 
 _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")

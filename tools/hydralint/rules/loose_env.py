@@ -12,7 +12,11 @@ to the default instead of letting the typo take effect.
 Scope: all of ``hydragnn_tpu/`` except envflags itself and a short,
 reason-documented host-side allowlist — modules whose env access is
 process-bootstrap plumbing (rendezvous addresses, SLURM probes, XLA_FLAGS
-read-modify-write, child-process env construction), not flag parsing.
+read-modify-write), not flag parsing. Files whose only legitimate raw
+access is building a CHILD process environment carry a function-scoped
+entry instead (``SCOPED_ALLOWLIST``): raw reads are exempt only inside
+the named env-construction functions, and everything else in the file
+stays covered.
 """
 from __future__ import annotations
 
@@ -38,17 +42,38 @@ ALLOWLIST = {
     # point
     "hydragnn_tpu/utils/devices.py":
         "XLA_FLAGS read-modify-write before jax init",
-    # SLURM nodelist probe + `dict(os.environ, **overrides)` when
-    # building child-trial environments — constructing an env, not
-    # parsing flags
+}
+
+# relpath -> (reason, function names whose BODIES may read env raw) —
+# the surgical form of the allowlist for files that are mostly ordinary
+# flag-parsing territory with one legitimate env-construction site.
+# Anything outside the named functions is still a finding (PR 14: the
+# former whole-file hpo.py entry hid its SLURM reads, which belonged on
+# envflags.env_str).
+SCOPED_ALLOWLIST = {
+    # `dict(os.environ, **env_over)` when building a child trial's
+    # environment — constructing an env, not parsing flags
     "hydragnn_tpu/utils/hpo.py":
-        "SLURM probe + child-process env construction",
+        ("child-process env construction in orchestrate", ("_launch",)),
+    # same contract for the trial supervisor's subprocess launcher
+    "hydragnn_tpu/hpo/process.py":
+        ("child-trial env construction", ("_child_env",)),
 }
 
 MESSAGE = ("env read outside utils/envflags.py — parse via an envflags "
            "strict helper (env_str / env_strict_flag / env_strict_choice "
            "/ env_strict_int) so a typo value warns instead of taking "
            "effect")
+
+
+def _allowed_ranges(tree: ast.AST, func_names) -> List[tuple]:
+    """(lineno, end_lineno) spans of the named (possibly nested)
+    functions — the lines a scoped allowlist entry exempts."""
+    names = set(func_names)
+    return [(node.lineno, node.end_lineno or node.lineno)
+            for node in ast.walk(tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name in names]
 
 
 class LooseEnvReadRule(Rule):
@@ -60,6 +85,9 @@ class LooseEnvReadRule(Rule):
 
     def check(self, tree: ast.AST, source: str,
               relpath: str) -> List[Finding]:
+        scoped = SCOPED_ALLOWLIST.get(relpath)
+        ranges = (_allowed_ranges(tree, scoped[1]) if scoped else ())
         return [Finding(relpath, line, self.name, f"{what}: {MESSAGE}")
                 for _, line, what in find_env_reads(source, relpath,
-                                                    tree=tree)]
+                                                    tree=tree)
+                if not any(lo <= line <= hi for lo, hi in ranges)]

@@ -54,6 +54,12 @@ TRACED_DIRS = (
     # via serving/config.resolve_md_farm at construction — an env read
     # here would be trace-time-frozen exactly like the kernels' (PR 11)
     os.path.join("hydragnn_tpu", "md"),
+    # the HPO supervision layer is host-side, but its knobs (retry/
+    # heartbeat/backoff/concurrency) must resolve through
+    # utils/envflags.resolve_hpo_supervisor at construction, never via
+    # direct reads inside the subsystem (PR 14; the telemetry rule).
+    # process.py is excluded below: its one read constructs a child env.
+    os.path.join("hydragnn_tpu", "hpo"),
 )
 
 # host-side files inside an otherwise-traced directory; every entry must
@@ -62,6 +68,9 @@ EXCLUDED_FILES = (
     os.path.join("hydragnn_tpu", "parallel", "mesh.py"),  # rendezvous/
     # SLURM env parsing at process startup (init_distributed,
     # walltime_deadline) — never traced
+    os.path.join("hydragnn_tpu", "hpo", "process.py"),  # child-trial
+    # env construction (dict(os.environ, ...)) — loose-env-read still
+    # covers the file via its function-scoped allowlist entry
 )
 TRACED_FILES = (
     os.path.join("hydragnn_tpu", "train", "train_step.py"),
