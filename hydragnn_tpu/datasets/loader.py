@@ -171,6 +171,30 @@ class GraphDataLoader:
             self._plan_cache = {key: hit}  # keep only the current epoch
         return hit
 
+    def global_plan_fingerprint(self) -> str:
+        """sha256 (first 16 hex chars) of the current epoch's GLOBAL pack
+        plan — the bin sequence BEFORE per-(rank, shard) slicing, plus
+        the budget and the global slicing geometry
+        ``num_shards * pack_nproc`` it will be sliced by.
+
+        The world-size-elastic resume contract (docs/fault_tolerance.md)
+        rests on every rank of a run, at ANY world size W' with the same
+        total shard count, deriving the same global plan: run_training
+        logs this value at startup and BENCH_ELASTIC compares it across
+        ranks and across a W -> W' restart. Packing-mode loaders only."""
+        if not self.packing:
+            raise ValueError(
+                "global_plan_fingerprint is defined for packing-mode "
+                "loaders only: fixed-shape batching slices samples per "
+                "process instead of slicing one global plan")
+        import hashlib
+        bins, _ = self._plan()
+        b = self.pack_budget
+        payload = repr((tuple(tuple(int(i) for i in bn) for bn in bins),
+                        (b.n_node, b.n_edge, b.n_graph),
+                        self.num_shards * self.pack_nproc))
+        return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
     def _flat_indices(self, sel) -> List[int]:
         """Flatten a selection to dataset indices (packed selections are
         tuples of per-shard tuples; fixed selections are flat)."""

@@ -1,0 +1,28 @@
+"""Elastic multi-process training (docs/fault_tolerance.md "Elastic
+multi-process training"; the scale-out half of the ROADMAP's open
+frontier).
+
+``JobSupervisor`` runs the W worker ranks of one multi-process
+data-parallel training job and guarantees the JOB reaches a terminal
+state no matter which rank dies, hangs, or fails to spawn: any rank
+failure triggers a *coordinated abort* (kill every rank — a hung
+collective cannot be recovered in place) and a whole-job restart from
+LATEST via the PR 4 resume contract, optionally at a different world
+size W' (``world_schedule``) — the PR 2 global pack plan and the
+global-shape checkpoint state make the W -> W' re-slice exact by
+construction. ``RankProcessLauncher`` launches real child rank
+processes with per-generation rendezvous ports and the PR 14
+zero-orphans process-group discipline; in-process fakes drive the fast
+test lane (tests/test_elastic.py)."""
+from .ledger import JOB, JobLedger
+from .process import RankProcessHandle, RankProcessLauncher, free_port
+from .supervisor import (COMPLETED, FAILED, PENDING, RESTARTING, RUNNING,
+                         TERMINAL_STATES, JobRecord, JobSupervisor,
+                         RankHandle)
+
+__all__ = [
+    "JOB", "JobLedger", "RankProcessHandle", "RankProcessLauncher",
+    "free_port", "JobRecord", "JobSupervisor", "RankHandle",
+    "PENDING", "RUNNING", "RESTARTING", "COMPLETED", "FAILED",
+    "TERMINAL_STATES",
+]

@@ -1,0 +1,195 @@
+"""Subprocess rank launcher for the elastic JobSupervisor
+(docs/fault_tolerance.md "Elastic multi-process training").
+
+Each launch runs one rank of a multi-process data-parallel training job
+as ``python -m hydragnn_tpu.elastic.runner`` in the shared job
+directory, with its own process group — a kill (the watchdog, the
+``rank-kill`` chaos site, or shutdown) takes the whole rank's tree down
+with one ``killpg`` and no grandchild can outlive it (the PR 14
+zero-orphans discipline). All ranks of a job share ONE cwd: the
+checkpoint dir is collective state (orbax save is a multihost
+collective; rank 0 writes the markers), so the progress probe and the
+resume detection read the same on-disk layout from every rank.
+
+Rendezvous: every generation gets a FRESH coordinator port — a
+coordinated abort SIGKILLs the old generation, but its coordinator
+socket can linger in TIME_WAIT, and a restarted world must never
+rendezvous with a half-dead predecessor. The world size W' of a restart
+generation may differ from W; each rank gets
+``total_shards // world_size`` virtual CPU devices so the GLOBAL mesh
+(and therefore the pack plan slicing geometry) is identical at every
+world size — the elasticity contract.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+from ..hpo.process import (ProcessTrialHandle, _committed_step_under,
+                           _repo_root)
+from .supervisor import RankHandle
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port for a generation's coordinator."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _child_env(rank: int, world_size: int, devices_per_rank: int,
+               coord_port: int, rendezvous_timeout_s: float,
+               extra: Optional[Dict[str, str]] = None
+               ) -> Dict[str, str]:
+    """Child-rank environment: the parent's env with the package
+    importable from the job cwd, localhost rendezvous coordinates, the
+    per-rank virtual device count, and the parent's fault plan masked —
+    the rank sites are SUPERVISOR-side; a child training process must
+    never inherit a chaos plan meant for the scheduler above it.
+    (The one sanctioned raw-env read in this module: constructing a
+    child env, not parsing flags — hydralint loose-env-read scoped
+    allowlist.)"""
+    env = dict(os.environ)
+    root = _repo_root()
+    prev = env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = root + (os.pathsep + prev if prev else "")
+    env["HYDRAGNN_FAULT_PLAN"] = ""  # set-but-empty = explicitly none
+    if int(world_size) > 1:
+        env["HYDRAGNN_MASTER_ADDR"] = "127.0.0.1"
+        env["HYDRAGNN_MASTER_PORT"] = str(int(coord_port))
+        env["SLURM_NPROCS"] = str(int(world_size))
+        env["SLURM_PROCID"] = str(int(rank))
+    else:
+        # a W'=1 restart generation is a plain single-process run: it
+        # must not rendezvous with (or inherit) a dead world's
+        # coordinates
+        for key in ("HYDRAGNN_MASTER_ADDR", "HYDRAGNN_MASTER_PORT",
+                    "SLURM_NPROCS", "SLURM_PROCID"):
+            env.pop(key, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count="
+                        f"{int(devices_per_rank)}")
+    # bounded rendezvous: a rank whose peers never arrive (a peer died
+    # between spawn and initialize) must die with an actionable error,
+    # not outlive the supervisor's patience wedged in the handshake
+    env["HYDRAGNN_RENDEZVOUS_TIMEOUT_S"] = f"{rendezvous_timeout_s:g}"
+    if extra:
+        env.update(extra)
+    return env
+
+
+def _job_committed_step(job_dir: str) -> Optional[int]:
+    """Newest COMMITTED checkpoint step under the shared job dir, or
+    None before the first commit — the hpo.process layout contract, one
+    definition shared by the progress probe and the runner's resume
+    detection."""
+    return _committed_step_under(job_dir)
+
+
+class RankProcessHandle(ProcessTrialHandle, RankHandle):
+    """One child rank process (group) + the job's on-disk progress.
+
+    Reuses the PR 14 process-group handle wholesale: the kill()/reap
+    discipline (killpg even when the leader already exited), the
+    (newest committed step, own log byte size) progress token, the
+    result.json reader, and the zero-orphans group_alive probe are
+    byte-for-byte the contract the TrialSupervisor hardened — the only
+    semantic difference is that the probed directory is the job dir
+    SHARED by every rank (a rank wedged in a collective stops growing
+    both signals: its own log stalls even while a healthy peer's
+    grows). ``job_dir`` aliases the inherited ``trial_dir``."""
+
+    def __init__(self, proc: subprocess.Popen, job_dir: str,
+                 log_path: str):
+        super().__init__(proc, job_dir, log_path)
+
+    @property
+    def job_dir(self) -> str:
+        return self.trial_dir
+
+
+class RankProcessLauncher:
+    """launch_fn for JobSupervisor: real child rank processes.
+
+    ``job_dir`` is the shared cwd of every rank (its ./logs run dirs,
+    rank_<r>.log files, result.json). ``total_shards`` is the GLOBAL
+    data-shard count — constant across world sizes; each rank gets
+    ``total_shards // world_size`` virtual devices, so the global mesh
+    and the pack-plan slicing geometry are world-size-invariant.
+    Construction knobs mirror the runner CLI; ``extra_env`` lets a
+    caller pin per-rank devices the way real pod launchers do."""
+
+    def __init__(self, job_dir: str, *, total_shards: int = 4,
+                 num_epochs: int = 4, num_configs: int = 24,
+                 data_seed: int = 0, batch_size: int = 8,
+                 hang_after_epoch: int = 1,
+                 rendezvous_timeout_s: float = 240.0,
+                 python: str = sys.executable,
+                 extra_env: Optional[Dict[str, str]] = None):
+        self.job_dir = os.path.abspath(job_dir)
+        self.total_shards = int(total_shards)
+        self.num_epochs = int(num_epochs)
+        self.num_configs = int(num_configs)
+        self.data_seed = int(data_seed)
+        self.batch_size = int(batch_size)
+        self.hang_after_epoch = int(hang_after_epoch)
+        self.rendezvous_timeout_s = float(rendezvous_timeout_s)
+        self.python = python
+        self.extra_env = dict(extra_env or {})
+        self.handles: List[RankProcessHandle] = []
+        self._gen_ports: Dict[int, int] = {}
+
+    def _port_for(self, generation: int) -> int:
+        """One fresh coordinator port per generation (rank 0 launches
+        first within a generation, so the port is chosen exactly once)."""
+        port = self._gen_ports.get(int(generation))
+        if port is None:
+            port = free_port()
+            self._gen_ports[int(generation)] = port
+        return port
+
+    def __call__(self, generation: int, world_size: int, rank: int,
+                 resume: bool, hang: bool) -> RankProcessHandle:
+        if self.total_shards % int(world_size):
+            raise ValueError(
+                f"total_shards={self.total_shards} must divide evenly "
+                f"over world_size={world_size}: the global mesh (and the "
+                "pack-plan slicing geometry) must be identical at every "
+                "world size for the elastic resume contract")
+        os.makedirs(self.job_dir, exist_ok=True)
+        devices = self.total_shards // int(world_size)
+        cmd = [self.python, "-m", "hydragnn_tpu.elastic.runner",
+               "--rank", str(int(rank)),
+               "--world", str(int(world_size)),
+               "--total-shards", str(self.total_shards),
+               "--num-epochs", str(self.num_epochs),
+               "--num-configs", str(self.num_configs),
+               "--data-seed", str(self.data_seed),
+               "--batch-size", str(self.batch_size)]
+        if resume:
+            cmd.append("--resume")
+        if hang:
+            cmd += ["--hang-after-epoch", str(self.hang_after_epoch)]
+        log_path = os.path.join(self.job_dir, f"rank_{int(rank)}.log")
+        # append: the log's byte size is the heartbeat token and must be
+        # monotone across generations
+        with open(log_path, "ab") as out:
+            proc = subprocess.Popen(
+                cmd, cwd=self.job_dir, stdout=out,
+                stderr=subprocess.STDOUT,
+                env=_child_env(rank, world_size, devices,
+                               self._port_for(generation),
+                               self.rendezvous_timeout_s,
+                               self.extra_env),
+                start_new_session=True)
+        handle = RankProcessHandle(proc, self.job_dir, log_path)
+        self.handles.append(handle)
+        return handle
+
+    def live_process_groups(self) -> List[int]:
+        """pids of rank process groups still alive — must be [] after
+        supervisor shutdown (the zero-orphans contract)."""
+        return [h.proc.pid for h in self.handles if h.group_alive()]

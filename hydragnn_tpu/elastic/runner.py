@@ -1,0 +1,228 @@
+"""Child rank entry point: ``python -m hydragnn_tpu.elastic.runner``.
+
+One rank of a multi-process data-parallel training job
+(docs/fault_tolerance.md "Elastic multi-process training"): rendezvous
+over the launcher-provided coordinator, train a small deterministic
+packed GIN config with per-epoch COMMITTED checkpoints (the PR 4 resume
+contract over the PR 2 global pack plan), and — rank 0 only — write
+``result.json`` atomically on success, carrying the history, the final
+step, and a params sha256 digest (the BENCH_ELASTIC adjudication
+breadcrumbs). Killed anywhere and relaunched with ``--resume`` at ANY
+world size W' dividing ``--total-shards``, every rank restores from
+LATEST, re-slices the same global pack plan, and the job completes with
+equal step counts — bitwise-identical trajectory at the same W,
+measured-and-pinned tolerance across W -> W'.
+
+``--hang-after-epoch N`` is the deterministic stand-in for a wedged
+rank (dead NIC, stuck collective): train until N checkpoints committed,
+then SIGSTOP this rank — every peer then blocks inside the next
+collective, the supervisor's heartbeat watchdog fires, and only a
+COORDINATED abort recovers the job.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict
+
+
+def base_job_config(num_epochs: int, batch_size: int) -> Dict[str, Any]:
+    """The HPO trial config (hpo/runner.base_trial_config) with the
+    elastic-job extras: budget-packed batching (the global-pack-plan
+    data distribution every world size re-slices) and ZeRO optimizer-
+    state sharding (so the W -> W' restore exercises
+    parallel/mesh.param_sharding_zero under the new mesh)."""
+    from ..hpo.runner import base_trial_config
+    config = base_trial_config(num_epochs)
+    config["Dataset"]["name"] = "elastic_synth"
+    tcfg = config["NeuralNetwork"]["Training"]
+    tcfg["batch_size"] = int(batch_size)
+    tcfg["batch_packing"] = True
+    tcfg["Optimizer"]["use_zero_redundancy"] = True
+    # tiny-model floor: the default 2^14 min shard size would leave every
+    # leaf replicated and the resharded-restore path vacuously untested
+    tcfg["Optimizer"]["zero_min_shard_size"] = 8
+    return config
+
+
+def _wedge_after_commits(job_dir: str, n_commits: int,
+                         base_commits: int = 0) -> None:
+    """Chaos watcher (``--hang-after-epoch``): once `n_commits` NEW
+    checkpoints committed past `base_commits` (the count at this
+    launch's start — a resume generation already has commits on disk),
+    SIGSTOP our own process — this rank wedges mid-epoch with work
+    safely on disk, every peer blocks inside the next collective, and
+    the supervisor must perform a coordinated abort (the shape of a
+    dead NIC or a stuck allreduce)."""
+    import signal
+    while len(_committed(job_dir)) < int(base_commits) + int(n_commits):
+        time.sleep(0.001)
+    os.kill(os.getpid(), signal.SIGSTOP)
+
+
+def _committed(job_dir: str):
+    from ..hpo.process import committed_steps
+    return committed_steps(job_dir)
+
+
+def _start_alive_ticker(period_s: float = 5.0) -> None:
+    """Daemon thread printing one line per period: non-zero ranks log
+    nothing to their own stdout between the banner and exit (the run-dir
+    logger's console handler is rank 0 only), so on a cold contended
+    box their heartbeat token would otherwise freeze for the whole
+    jax-import/compile/first-epoch window and the watchdog would kill a
+    healthy generation (the BENCH_HPO heartbeat lesson, squared by W
+    ranks competing for the host). The ticker is the liveness signal —
+    and an honest one: SIGSTOP (the injected hang) freezes every thread
+    including this one, so a genuinely wedged rank still goes stale."""
+    import threading
+
+    def _tick():
+        n = 0
+        while True:
+            time.sleep(period_s)
+            n += 1
+            print(f"elastic-runner: alive t+{n * period_s:g}s",
+                  flush=True)
+
+    threading.Thread(target=_tick, daemon=True).start()
+
+
+def _param_digest(state) -> Dict[str, Any]:
+    """Deterministic fingerprint of the final params: sha256 over the
+    sorted-path leaf bytes (bitwise adjudication across runs and world
+    sizes) plus a float norm (the documented-tolerance adjudication when
+    cross-world psum reassociation moves the last ulp)."""
+    import jax
+    import numpy as np
+    leaves = jax.tree_util.tree_flatten_with_path(state.params)[0]
+    h = hashlib.sha256()
+    sq = 0.0
+    for path, leaf in sorted(leaves, key=lambda kv: str(kv[0])):
+        arr = np.asarray(jax.device_get(leaf))
+        h.update(str(path).encode())
+        h.update(arr.tobytes())
+        sq += float((arr.astype(np.float64) ** 2).sum())
+    return {"param_digest": h.hexdigest(),
+            "param_norm": float(np.sqrt(sq))}
+
+
+def run_rank(*, rank: int, world: int, total_shards: int,
+             num_epochs: int, num_configs: int, data_seed: int,
+             batch_size: int, resume: bool, hang_after_epoch: int = 0,
+             job_dir: str = ".") -> int:
+    """Train this rank in ``job_dir`` (the shared cwd contract: run dirs
+    land under ./logs, rank 0 writes ./result.json)."""
+    from ..hpo.runner import synthetic_dataset
+    from ..preprocess.load_data import split_dataset
+    from ..run_training import run_training
+
+    # unlike the HPO trial sites (first-launch-only), the rank sites are
+    # consulted on EVERY launch — a hang injected into a resume
+    # generation must still wedge, counting NEW commits from this
+    # launch's baseline
+    hang = int(hang_after_epoch) > 0
+    config = base_job_config(num_epochs, batch_size)
+    train_cfg = config["NeuralNetwork"]["Training"]
+    if hang:
+        import threading
+        threading.Thread(target=_wedge_after_commits,
+                         args=(job_dir, int(hang_after_epoch),
+                               len(_committed(job_dir))),
+                         daemon=True).start()
+    if resume and _committed(job_dir):
+        train_cfg["continue"] = 1
+    # else: resume with nothing on disk (the whole generation died
+    # before the first commit) restarts from scratch — deterministic
+    # training makes the restarted trajectory identical to the lost one
+
+    samples = synthetic_dataset(num_configs, seed=data_seed)
+    splits = split_dataset(samples, train_cfg.get("perc_train", 0.7))
+    state, history, _, _ = run_training(config, datasets=splits,
+                                        num_shards=int(total_shards))
+
+    if hang:
+        # belt-and-braces: never report success from a hang-injected
+        # launch — SIGSTOP (not sleep: the alive-ticker thread would
+        # keep the heartbeat flowing through a sleep) so the watchdog
+        # path runs deterministically even when training outran the
+        # commit-counting watcher
+        import signal
+        os.kill(os.getpid(), signal.SIGSTOP)
+        while True:  # pragma: no cover — unreachable past the STOP
+            time.sleep(3600)
+
+    import jax
+    if jax.process_index() == 0:
+        committed = _committed(job_dir)
+        result = {
+            "objective": float(min(history["val_loss"])),
+            "history": {k: history[k] for k in ("train_loss", "val_loss",
+                                                "test_loss", "lr")},
+            # keep_best returns the BEST state, whose step is the best
+            # epoch's — final_step is the run's last committed step (the
+            # equal-step-counts adjudication and the recovered-fraction
+            # denominator)
+            "step": int(state.step),
+            "final_step": int(committed[-1]) if committed
+            else int(state.step),
+            "world_size": int(world),
+            "total_shards": int(total_shards),
+            **_param_digest(state),
+        }
+        tmp = os.path.join(job_dir, "result.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+        os.replace(tmp, os.path.join(job_dir, "result.json"))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--rank", type=int, required=True)
+    p.add_argument("--world", type=int, required=True)
+    p.add_argument("--total-shards", type=int, default=4,
+                   help="GLOBAL data-shard count — constant across "
+                        "world sizes (each rank gets total/world "
+                        "virtual devices)")
+    p.add_argument("--num-epochs", type=int, default=4)
+    p.add_argument("--num-configs", type=int, default=24)
+    p.add_argument("--data-seed", type=int, default=0)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--resume", action="store_true",
+                   help="continue from this job dir's LATEST")
+    p.add_argument("--hang-after-epoch", type=int, default=0,
+                   help="chaos: train N epochs then SIGSTOP this rank")
+    args = p.parse_args(argv)
+    if args.total_shards % args.world:
+        p.error(f"--total-shards {args.total_shards} must divide evenly "
+                f"over --world {args.world}")
+    # first heartbeat before any heavy import: the supervisor's progress
+    # token includes the log size, and jax/orbax startup is otherwise a
+    # long silent window the watchdog must not mistake for a hang
+    print(f"elastic-runner: starting (rank={args.rank} "
+          f"world={args.world} total_shards={args.total_shards} "
+          f"resume={args.resume})", flush=True)
+    _start_alive_ticker()
+    if args.world > 1:
+        from ..utils.envflags import env_str
+        if env_str("JAX_PLATFORMS", "").lower() == "cpu":
+            # XLA CPU refuses cross-process computations unless a
+            # collectives layer is selected, and only before backend init
+            from ..utils.devices import enable_cpu_gloo_collectives
+            enable_cpu_gloo_collectives()
+    return run_rank(rank=args.rank, world=args.world,
+                    total_shards=args.total_shards,
+                    num_epochs=args.num_epochs,
+                    num_configs=args.num_configs,
+                    data_seed=args.data_seed,
+                    batch_size=args.batch_size,
+                    resume=args.resume,
+                    hang_after_epoch=args.hang_after_epoch)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

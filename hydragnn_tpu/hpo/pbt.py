@@ -83,6 +83,12 @@ def fork_checkpoint(src_ckpt_dir: str,
     stale_meta = os.path.join(dst, ck.RESUME_META)
     if os.path.exists(stale_meta):
         os.remove(stale_meta)
+        # the copied COMMITTED marker's integrity manifest (PR 15)
+        # still lists the dropped resume.json — re-commit the copy so
+        # the marker describes the files actually present, or the deep
+        # restore-side verification would reject the fork as corrupt
+        ck._write_marker(dst, ck.COMMIT_MARKER, "\n".join(
+            [os.path.basename(dst)] + ck._manifest_lines(dst)))
     ck._write_latest(dst)
     return step, val
 

@@ -27,12 +27,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 def init_distributed(coordinator: Optional[str] = None,
                      num_processes: Optional[int] = None,
-                     process_id: Optional[int] = None) -> Tuple[int, int]:
+                     process_id: Optional[int] = None,
+                     timeout_s: Optional[float] = None) -> Tuple[int, int]:
     """Multi-host rendezvous (reference setup_ddp, distributed.py:119-188).
 
     On TPU pods jax.distributed.initialize discovers everything from the
     runtime metadata; env overrides mirror HYDRAGNN_MASTER_ADDR/PORT
     (reference: distributed.py:139-141). Returns (world_size, rank).
+
+    ``timeout_s`` (default: HYDRAGNN_RENDEZVOUS_TIMEOUT_S, strict-parsed
+    — docs/fault_tolerance.md) bounds the rendezvous: a peer rank that
+    never arrives turns into an actionable RuntimeError naming this
+    process, the expected world, and the coordinator, instead of wedging
+    the job forever (the elastic supervisor relies on a bounded child
+    startup so a half-spawned generation self-destructs).
     """
     # must not touch the XLA backend before jax.distributed.initialize
     # (jax.process_count() would initialise it), so probe the distributed
@@ -46,10 +54,52 @@ def init_distributed(coordinator: Optional[str] = None,
         coord = coordinator or (
             os.environ["HYDRAGNN_MASTER_ADDR"] + ":" +
             os.environ.get("HYDRAGNN_MASTER_PORT", "12355"))
-        jax.distributed.initialize(
-            coordinator_address=coord,
-            num_processes=num_processes or int(os.environ.get("SLURM_NPROCS", 1)),
-            process_id=process_id or int(os.environ.get("SLURM_PROCID", 0)))
+        nproc = num_processes or int(os.environ.get("SLURM_NPROCS", 1))
+        pid = process_id or int(os.environ.get("SLURM_PROCID", 0))
+        if timeout_s is None:
+            from ..utils.envflags import resolve_rendezvous_timeout
+            timeout_s = resolve_rendezvous_timeout()
+        kwargs = {}
+        if timeout_s:
+            kwargs["initialization_timeout"] = max(int(timeout_s), 1)
+        # NOTE: on some jaxlib paths the distributed client LOG(FATAL)s
+        # the process on a coordination deadline before Python sees an
+        # exception — the rank still dies within the bound (the
+        # contract: never wedge an allocation on a missing peer), it
+        # just skips the prettier message below
+        try:
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coord, num_processes=nproc,
+                    process_id=pid, **kwargs)
+            except TypeError:
+                if not kwargs:
+                    raise
+                # this jax predates initialization_timeout: fall back to
+                # the unbounded rendezvous rather than failing a run
+                # whose peers may be perfectly healthy
+                import logging
+                logging.getLogger("hydragnn_tpu").warning(
+                    "this jax does not support a rendezvous "
+                    "initialization timeout; HYDRAGNN_RENDEZVOUS_"
+                    "TIMEOUT_S=%g is ignored for initialize()",
+                    timeout_s)
+                jax.distributed.initialize(
+                    coordinator_address=coord, num_processes=nproc,
+                    process_id=pid)
+        except Exception as exc:  # noqa: BLE001 — re-raise actionable
+            msg = str(exc).lower()
+            if timeout_s and ("deadline" in msg or "timed out" in msg):
+                raise RuntimeError(
+                    f"multi-process rendezvous timed out after "
+                    f"{timeout_s:g}s: this is process {pid} of {nproc} "
+                    f"(coordinator {coord}) — at least one rank in "
+                    f"0..{nproc - 1} besides {pid} never reached the "
+                    "coordinator (died before init, wrong address, or "
+                    "still spawning). Restart the whole job — a partial "
+                    "world cannot proceed (docs/fault_tolerance.md "
+                    "'Elastic multi-process training')") from exc
+            raise
     return jax.process_count(), jax.process_index()
 
 

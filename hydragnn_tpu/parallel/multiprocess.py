@@ -24,6 +24,7 @@ Used by run_training when jax.process_count() > 1 on the plain-SPMD path:
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Optional
 
 import numpy as np
@@ -31,6 +32,51 @@ import numpy as np
 import jax
 
 _LOG = logging.getLogger("hydragnn_tpu")
+
+
+class RendezvousTimeoutError(RuntimeError):
+    """A bounded cross-process collective expired: a peer never arrived."""
+
+
+def _run_bounded(fn, timeout_s: Optional[float], what: str):
+    """Run a blocking cross-process collective with a wall-clock bound.
+
+    jax collectives block in C with no cancellation hook, so the bound is
+    a watcher: the collective runs on a daemon thread and expiry raises
+    ``RendezvousTimeoutError`` in the caller. The daemon thread stays
+    blocked until process exit — callers are expected to abort (the
+    elastic supervisor's coordinated restart; a CLI run dying with an
+    actionable error instead of wedging a whole allocation forever).
+    ``timeout_s`` None/<=0 = unbounded (today's behavior)."""
+    if not timeout_s or timeout_s <= 0:
+        return fn()
+    box: dict = {}
+    done = threading.Event()
+
+    def _run():
+        try:
+            box["value"] = fn()
+        except BaseException as exc:  # noqa: BLE001 — re-raised below
+            box["error"] = exc
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_run, daemon=True,
+                         name=f"bounded-collective:{what}")
+    t.start()
+    if not done.wait(timeout=float(timeout_s)):
+        rank, nproc = jax.process_index(), jax.process_count()
+        raise RendezvousTimeoutError(
+            f"{what}: cross-process collective timed out after "
+            f"{timeout_s:g}s — at least one of the {nproc} processes "
+            f"(a rank in 0..{nproc - 1} other than this process, rank "
+            f"{rank}) never reached it. A dead or wedged peer rank "
+            "cannot be recovered in place: abort every rank and restart "
+            "the job from LATEST (docs/fault_tolerance.md 'Elastic "
+            "multi-process training')")
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
 
 
 def is_multiprocess() -> bool:
@@ -89,15 +135,73 @@ def allreduce_max_int(*vals: int):
         jax.process_count(), len(vals)).max(axis=0))
 
 
-def assert_equal_across_processes(value: int, what: str):
+def assert_equal_across_processes(value: int, what: str,
+                                  timeout_s: Optional[float] = None):
+    """Allgather-and-compare a per-process scalar; raises when it differs.
+
+    ``timeout_s`` (default: HYDRAGNN_RENDEZVOUS_TIMEOUT_S via
+    envflags.resolve_rendezvous_timeout — unset keeps the unbounded
+    behavior) bounds the allgather so a peer that died before reaching
+    it surfaces as an actionable RendezvousTimeoutError instead of
+    wedging every surviving rank forever."""
     from jax.experimental import multihost_utils
-    arr = np.asarray(multihost_utils.process_allgather(
-        np.asarray([value], np.int64))).reshape(-1)
+    if timeout_s is None:
+        from ..utils.envflags import resolve_rendezvous_timeout
+        timeout_s = resolve_rendezvous_timeout()
+    arr = np.asarray(_run_bounded(
+        lambda: multihost_utils.process_allgather(
+            np.asarray([value], np.int64)),
+        timeout_s, what)).reshape(-1)
     if not (arr == arr[0]).all():
         raise ValueError(
             f"{what} differs across processes ({arr.tolist()}): every "
             "process must run the same number of steps or the collectives "
             "deadlock — equalize the per-host dataset shards")
+
+
+def host_replicated_copy(tree):
+    """Host copy of a state pytree that is safe in multi-process runs.
+
+    ``jax.device_get`` fetches a fully-replicated global array from the
+    local replica, but a leaf SHARDED across processes (ZeRO optimizer
+    state, ``mesh.param_sharding_zero``) spans non-addressable devices
+    and raises. Such leaves are allgathered back to a replicated value
+    first — a COLLECTIVE: every process must call this with the same
+    tree in the same order, which the checkpoint/best-state snapshot
+    sites satisfy (all ranks run the same program; orbax save is
+    already a collective for the same reason). Single-process trees hit
+    the plain device_get path unchanged.
+
+    This is also what makes checkpoints WORLD-SIZE-AGNOSTIC: the saved
+    arrays carry global logical shapes, so a restart at W' != W simply
+    re-places them under the new mesh's shardings
+    (docs/fault_tolerance.md "Elastic multi-process training")."""
+    def fetch(a):
+        if a is None:
+            return None
+        if (isinstance(a, jax.Array) and not a.is_fully_addressable
+                and not a.sharding.is_fully_replicated):
+            a = _replicate_fn(a.sharding.mesh)(a)
+        return jax.device_get(a)
+    return jax.tree_util.tree_map(fetch, tree)
+
+
+# one jitted allgather-identity per mesh: a fresh jax.jit(lambda ...)
+# per leaf per call would defeat the jit cache and re-trace/compile on
+# every checkpoint/best-state snapshot (callers are the single-threaded
+# trainer/save paths, so a plain dict suffices)
+_REPLICATE_FNS: dict = {}
+
+
+def _replicate_fn(mesh):
+    fn = _REPLICATE_FNS.get(mesh)
+    if fn is None:
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        fn = jax.jit(lambda x: x,
+                     out_shardings=NamedSharding(mesh, P()))
+        _REPLICATE_FNS[mesh] = fn
+    return fn
 
 
 def sync_config_stats(config: dict) -> dict:

@@ -361,8 +361,14 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
         **mp_loader_kwargs)
     if packing:
         b = train_loader.pack_budget
+        # plan_fp: fingerprint of the epoch-0 GLOBAL pack plan (computed
+        # before per-process slicing) — every rank of a run, and a
+        # world-size-elastic restart at W' != W, must log the SAME value
+        # or the data-distribution contract is broken (BENCH_ELASTIC
+        # greps it per rank as the cross-world adjudication breadcrumb)
         log(f"batch_packing: budget n_node={b.n_node} n_edge={b.n_edge} "
             f"n_graph={b.n_graph} lookahead={b.lookahead} "
+            f"plan_fp={train_loader.global_plan_fingerprint()} "
             f"(fixed-shape batching would pad every batch to the "
             f"worst case)")
 
@@ -421,12 +427,27 @@ def run_training(config_or_path, datasets: Optional[Tuple] = None,
             raise ValueError(
                 f"Training.continue is set but run '{start_name}' has no "
                 "checkpoint under ./logs")
-        state = restored
+        # orbax hands back leaves COMMITTED to its restore placement
+        # (single-device) — a committed leaf clashes in jit with a batch
+        # sharded over this run's mesh. Hand the step factories HOST
+        # arrays instead: the compiled step's shardings then place them
+        # under THIS run's mesh, which may have a different world size /
+        # device count than the writer's (the elastic W -> W' restore,
+        # docs/fault_tolerance.md — checkpointed shapes are global, so
+        # placement is the only thing that changes)
+        import numpy as _np
+        state = jax.tree_util.tree_map(_np.asarray, restored)
         # resume metadata (epoch/step/scheduler counters/history) only
         # applies when continuing the SAME run: a startfrom transfer from
         # another run seeds weights but trains from epoch 0, the
         # reference's transfer-learning semantics
         if ckpt_meta and start_name == log_name:
+            # schema gate (docs/fault_tolerance.md): unknown keys pass
+            # through (elastic world_size and whatever comes next);
+            # missing REQUIRED keys raise naming the key instead of
+            # silently resuming from epoch 0
+            from .utils.checkpoint import validate_resume_meta
+            validate_resume_meta(ckpt_meta)
             start_epoch = int(ckpt_meta.get("next_epoch", 0))
             resume_trainer = ckpt_meta.get("trainer")
             if bool(train_cfg.get("keep_best", True)):

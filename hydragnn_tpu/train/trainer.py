@@ -18,6 +18,7 @@ import jax
 import numpy as np
 
 from ..datasets.loader import prefetch_to_device
+from ..parallel.multiprocess import host_replicated_copy
 from ..telemetry import spans as _spans
 from ..utils.faults import fault_point
 from ..utils.print_utils import iterate_tqdm, log, print_distributed
@@ -258,6 +259,13 @@ def train_validate_test(
             # explicitly so external tooling can reconstruct the exact
             # resumed data stream from the metadata alone
             "loader_epoch": int(next_epoch),
+            # elastic metadata (docs/fault_tolerance.md): the world size
+            # that WROTE this checkpoint. Purely informational — the
+            # resume contract is world-size-agnostic (global pack plan +
+            # global-shape state), so a restart at W' != world_size is
+            # legitimate; readers predating this key ignore it (the
+            # resume.json forward-compat contract)
+            "world_size": int(jax.process_count()),
             "trainer": {
                 "history": {k: list(v) for k, v in history.items()},
                 "plateau": {"best": plateau.best, "count": plateau.count},
@@ -332,7 +340,7 @@ def train_validate_test(
         # the previous boundary's periodic checkpoint doesn't already
         # hold this exact state (then LATEST is the resume point and the
         # copy would be pure waste).
-        epoch_start_state = (jax.device_get(state)
+        epoch_start_state = (host_replicated_copy(state)
                              if (preempt_save_fn is not None
                                  and not prev_boundary_committed)
                              else None)
@@ -482,7 +490,7 @@ def train_validate_test(
 
         if keep_best and val_loss == val_loss and val_loss < best_val:
             best_val = val_loss
-            best_state = jax.device_get(state)
+            best_state = host_replicated_copy(state)
 
         # ---- LR plateau schedule ----
         if supports_lr_schedule(state.opt_state):

@@ -28,11 +28,12 @@ deterministically in tests rather than hoped-for.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import shutil
 import threading
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
@@ -75,7 +76,12 @@ def save_model(state: TrainState, log_name: str, path: str = "./logs",
     fault_point("checkpoint-write")
     d = _ckpt_dir(log_name, path)
     target = os.path.join(d, f"step_{int(state.step)}")
-    host_state = jax.device_get(state)
+    # multi-process-safe host copy: ZeRO-sharded opt leaves span
+    # processes and must be allgathered (a collective — save_model runs
+    # on every rank); the saved arrays carry GLOBAL shapes, which is
+    # what makes the checkpoint restorable at a different world size
+    from ..parallel.multiprocess import host_replicated_copy
+    host_state = host_replicated_copy(state)
     if use_async:
         if "ckptr" not in _ASYNC_STATE:  # setdefault would rebuild (and
             # leak) the checkpointer's thread machinery on every call
@@ -161,19 +167,84 @@ def _write_latest(target: str) -> None:
                   os.path.basename(target))
 
 
+def _manifest_lines(target: str) -> List[str]:
+    """Integrity manifest for a finalized step dir: one
+    ``<sha256> <size> <relpath>`` line per payload file (sorted walk, the
+    marker itself excluded). Written into the COMMITTED marker so the
+    restore side can detect a silently-corrupted payload file — the
+    structural check only catches missing/truncated metadata, not a
+    flipped byte inside an array shard."""
+    lines: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(target):
+        dirnames.sort()
+        for name in sorted(filenames):
+            if name in (COMMIT_MARKER, COMMIT_MARKER + ".tmp"):
+                continue
+            full = os.path.join(dirpath, name)
+            h = hashlib.sha256()
+            try:
+                with open(full, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        h.update(chunk)
+                size = os.path.getsize(full)
+            except OSError:
+                continue  # vanished mid-walk (orbax scratch): not payload
+            rel = os.path.relpath(full, target).replace(os.sep, "/")
+            lines.append(f"{h.hexdigest()} {size} {rel}")
+    return lines
+
+
+def verify_manifest(target: str) -> Optional[str]:
+    """Check the COMMITTED marker's integrity manifest against the files
+    on disk. Returns None when every manifested file verifies (or the
+    marker predates the manifest — line 1 only, pre-manifest saves stay
+    restorable), else a human-readable description naming the FIRST bad
+    file (missing / size mismatch / sha256 mismatch)."""
+    try:
+        with open(os.path.join(target, COMMIT_MARKER)) as f:
+            lines = f.read().splitlines()
+    except OSError as exc:
+        return f"COMMITTED marker unreadable ({exc})"
+    for line in lines[1:]:
+        parts = line.split(" ", 2)
+        if len(parts) != 3:
+            continue  # forward compat: unknown trailing marker content
+        digest, size_s, rel = parts
+        full = os.path.join(target, rel.replace("/", os.sep))
+        try:
+            actual_size = os.path.getsize(full)
+        except OSError:
+            return f"payload file {rel!r} is missing"
+        if str(actual_size) != size_s:
+            return (f"payload file {rel!r} has size {actual_size}, "
+                    f"manifest says {size_s}")
+        h = hashlib.sha256()
+        try:
+            with open(full, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+        except OSError as exc:
+            return f"payload file {rel!r} is unreadable ({exc})"
+        if h.hexdigest() != digest:
+            return f"payload file {rel!r} fails its sha256 check"
+    return None
+
+
 def _finalize_commit(target: str, metadata: Optional[Dict[str, Any]] = None,
                      mark_best: bool = False,
                      keep_last_k: Optional[int] = None,
                      best_val: Optional[float] = None) -> None:
     """Post-save commit sequence (rank 0): resume metadata, then the
-    COMMITTED marker, then the LATEST/BEST pointers, then retention GC.
-    Ordering is the crash-safety contract — a dir only becomes COMMITTED
-    once everything a restore needs is on disk, and pointers only ever
-    name committed dirs."""
+    COMMITTED marker (line 1: the step-dir basename; lines 2+: the
+    per-file sha256 integrity manifest), then the LATEST/BEST pointers,
+    then retention GC. Ordering is the crash-safety contract — a dir
+    only becomes COMMITTED once everything a restore needs is on disk,
+    and pointers only ever name committed dirs."""
     d = os.path.dirname(target)
     if metadata is not None:
         _write_marker(target, RESUME_META, json.dumps(metadata))
-    _write_marker(target, COMMIT_MARKER, os.path.basename(target))
+    _write_marker(target, COMMIT_MARKER, "\n".join(
+        [os.path.basename(target)] + _manifest_lines(target)))
     _write_latest(target)
     if mark_best:
         # line 2 records the marked save's OWN val loss (repr round-trips
@@ -188,15 +259,31 @@ def _finalize_commit(target: str, metadata: Optional[Dict[str, Any]] = None,
         gc_checkpoints(d, keep_last_k)
 
 
-def verify_checkpoint(target: str) -> bool:
+def verify_checkpoint(target: str, deep: bool = False) -> bool:
     """A step dir is restorable when our COMMITTED marker AND orbax's own
     checkpoint metadata are both present — the marker is written strictly
-    after the orbax finalize, so its presence implies a complete save."""
+    after the orbax finalize, so its presence implies a complete save.
+
+    ``deep=True`` additionally re-hashes every payload file against the
+    marker's sha256 manifest (silent corruption — a flipped byte inside
+    an array shard — passes the structural check). Restore paths run the
+    deep check once per candidate; cheap enumeration (GC, progress
+    probes, candidate listing) keeps the marker-existence semantics."""
     if not os.path.isdir(target):
         return False
     if not os.path.exists(os.path.join(target, COMMIT_MARKER)):
         return False
-    return _orbax_complete(target)
+    if not _orbax_complete(target):
+        return False
+    if deep:
+        bad = verify_manifest(target)
+        if bad is not None:
+            import logging
+            logging.getLogger("hydragnn_tpu").warning(
+                "checkpoint %s fails its integrity manifest (%s); "
+                "treating as corrupt", target, bad)
+            return False
+    return True
 
 
 def _orbax_complete(target: str) -> bool:
@@ -218,6 +305,30 @@ def load_checkpoint_metadata(target: str) -> Optional[Dict[str, Any]]:
             return json.load(f)
     except (OSError, json.JSONDecodeError):
         return None
+
+
+# resume.json schema tolerance (docs/fault_tolerance.md): UNKNOWN keys
+# are ignored — newer writers (the elastic layer's world_size, whatever
+# comes next) must not break older readers — while the keys a resume
+# cannot proceed without are validated with an actionable error naming
+# the missing key. A resume.json written before the manifest/elastic PRs
+# carries exactly these required keys, so it still restores.
+RESUME_REQUIRED_KEYS = ("next_epoch", "step")
+
+
+def validate_resume_meta(meta: Dict[str, Any]) -> Dict[str, Any]:
+    """Schema gate for a restored resume.json: raises ValueError naming
+    the first missing required key; unknown keys pass through untouched
+    (forward compatibility is the contract, not strictness)."""
+    for key in RESUME_REQUIRED_KEYS:
+        if key not in meta:
+            raise ValueError(
+                f"resume.json is missing required key {key!r} (has: "
+                f"{sorted(meta)}): the checkpoint's resume metadata is "
+                "incomplete or from an incompatible writer — delete the "
+                "step dir's resume.json to restore weights without "
+                "trainer state, or re-save the checkpoint")
+    return meta
 
 
 def _step_dirs(d: str):
@@ -405,6 +516,14 @@ def load_existing_model(state_like: TrainState, log_name: str,
     logger = logging.getLogger("hydragnn_tpu")
     ckptr = ocp.StandardCheckpointer()
     for target in _restore_candidates(d):
+        if (os.path.exists(os.path.join(target, COMMIT_MARKER))
+                and not verify_checkpoint(target, deep=True)):
+            # deep check failed (warning above names the bad file):
+            # a silently-corrupted payload would restore garbage weights
+            # without an error — fall back to the next-newest verified
+            # save instead (legacy pre-manifest dirs pass the deep check
+            # vacuously; uncommitted legacy candidates skip it)
+            continue
         try:
             restored = ckptr.restore(target, state_like)
         except Exception as exc:  # noqa: BLE001 — corrupt/mismatched dir:
@@ -436,7 +555,7 @@ def load_best_model(state_like: TrainState, log_name: str,
         lines = f.read().splitlines()
     target = os.path.join(d, lines[0].strip())
     val = float(lines[1]) if len(lines) > 1 else None
-    if not verify_checkpoint(target):
+    if not verify_checkpoint(target, deep=True):
         return none
     try:
         restored = ocp.StandardCheckpointer().restore(target, state_like)

@@ -59,6 +59,30 @@ def probe_backend(timeout_s: int = 60, attempts: int = 1,
     return result
 
 
+def enable_cpu_gloo_collectives() -> bool:
+    """Select gloo as the CPU backend's cross-process collectives
+    implementation (docs/fault_tolerance.md "Elastic multi-process
+    training"). XLA CPU refuses multiprocess computations outright
+    unless a collectives layer is chosen, and the knob has no effect
+    once the backend client exists — so multi-rank CPU jobs (the
+    elastic chaos runs, the 2-process CI pass) must call this BEFORE
+    any device op, after jax.distributed.initialize's config is known.
+    Returns False (with a warning) when this jaxlib lacks the option
+    instead of raising — a rank must die with the real rendezvous or
+    compute error, not a bootstrap AttributeError."""
+    import jax
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        return True
+    except Exception as exc:  # noqa: BLE001 — unknown-config fallback
+        import logging
+        logging.getLogger("hydragnn_tpu").warning(
+            "could not select gloo CPU collectives (%s: %s) — "
+            "multi-process CPU computations will fail on this jaxlib",
+            type(exc).__name__, exc)
+        return False
+
+
 def force_cpu_platform(min_devices: int = 1) -> None:
     """Reconfigure this process onto the CPU platform with at least
     `min_devices` devices. XLA_FLAGS' --xla_force_host_platform_device_count

@@ -347,6 +347,61 @@ def resolve_hpo_supervisor(hpo_cfg=None) -> "tuple[int, float, float, int]":
             max(float(backoff), 0.0), max(int(conc), 1))
 
 
+def resolve_elastic(cfg=None) -> "tuple[float, float, float]":
+    """Elastic job-supervisor knobs (docs/fault_tolerance.md "Elastic
+    multi-process training") -> (max_restarts, heartbeat_s, backoff_s).
+
+    Precedence per knob: HYDRAGNN_ELASTIC_* env over the optional config
+    dict (keys max_restarts/heartbeat_s/backoff_s) over defaults. STRICT
+    parsing — these knobs bound how hard the supervisor fights for a
+    dying job, so a typo value must warn and fall back, never silently
+    disable recovery (the HYDRAGNN_PALLAS_NBR lesson).
+
+    Knobs:
+      HYDRAGNN_ELASTIC_MAX_RESTARTS  coordinated restarts after a rank
+                                     death/hang/spawn failure before the
+                                     job goes FAILED (default 2, min 0)
+      HYDRAGNN_ELASTIC_HEARTBEAT_S   progress deadline — a generation
+                                     where ANY rank shows no checkpoint
+                                     or log growth for this long is
+                                     aborted as hung (default 120,
+                                     min 0.05; must cover the silent
+                                     jax-import/compile window of a
+                                     cold rank, the BENCH_HPO lesson)
+      HYDRAGNN_ELASTIC_BACKOFF_S     restart backoff base, doubling per
+                                     consecutive restart (default 1.0,
+                                     min 0)
+    """
+    cfg = cfg or {}
+    restarts = env_strict_int("HYDRAGNN_ELASTIC_MAX_RESTARTS",
+                              int(cfg.get("max_restarts", 2)))
+    heartbeat = env_strict_float("HYDRAGNN_ELASTIC_HEARTBEAT_S",
+                                 float(cfg.get("heartbeat_s", 120.0)))
+    backoff = env_strict_float("HYDRAGNN_ELASTIC_BACKOFF_S",
+                               float(cfg.get("backoff_s", 1.0)))
+    return (max(int(restarts), 0), max(float(heartbeat), 0.05),
+            max(float(backoff), 0.0))
+
+
+def resolve_rendezvous_timeout() -> "float | None":
+    """Bounded multi-process rendezvous (docs/fault_tolerance.md):
+    HYDRAGNN_RENDEZVOUS_TIMEOUT_S bounds how long
+    ``parallel.mesh.init_distributed`` and
+    ``parallel.multiprocess.assert_equal_across_processes`` wait for
+    peer processes before raising an actionable error instead of
+    wedging forever on a rank that never arrives. Strict parsing; unset
+    or <= 0 keeps today's unbounded behavior (the jax built-in 300 s
+    initialize timeout still applies to the rendezvous itself). The
+    elastic launcher sets this in every child rank's env so a
+    half-spawned generation self-destructs instead of outliving its
+    supervisor's patience."""
+    t = env_strict_float("HYDRAGNN_RENDEZVOUS_TIMEOUT_S")
+    if t is None:
+        return None
+    t = float(t)
+    return t if t > 0 else None
+
+
 def resolve_steps_per_call(train_cfg) -> int:
     """Steps-per-call dispatch batching knob: HYDRAGNN_STEPS_PER_CALL env
     overrides Training.steps_per_call (default 1). Shared by run_training

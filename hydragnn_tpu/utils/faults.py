@@ -17,6 +17,7 @@ Plan grammar (``HYDRAGNN_FAULT_PLAN`` env / ``Training.fault_plan``)::
     site  := checkpoint-write | loader-fetch | forward-step
              | serving-dispatch | replica-kill | swap-fail
              | trial-kill | trial-hang | trial-spawn-fail
+             | rank-kill | rank-hang | rank-spawn-fail
     index := non-negative int — the 0-based invocation count of that site
 
 Example: ``forward-step@7;serving-dispatch@2,5`` kills the 8th training
@@ -41,7 +42,8 @@ from typing import Dict, FrozenSet, List, Optional, Tuple
 
 SITES = ("checkpoint-write", "loader-fetch", "forward-step",
          "serving-dispatch", "replica-kill", "swap-fail",
-         "trial-kill", "trial-hang", "trial-spawn-fail")
+         "trial-kill", "trial-hang", "trial-spawn-fail",
+         "rank-kill", "rank-hang", "rank-spawn-fail")
 # Fleet-level sites (docs/fault_tolerance.md, serving/fleet.py):
 # ``replica-kill`` fires once per ReplicaRouter dispatch and abruptly
 # kills the replica the router selected for that request (its in-flight
@@ -61,6 +63,19 @@ SITES = ("checkpoint-write", "loader-fetch", "forward-step",
 # trial k at its first committed checkpoint (preemption mid-run). All
 # three recover through the same bounded retry + resume-from-LATEST
 # path.
+# Rank-level sites (docs/fault_tolerance.md "Elastic multi-process
+# training", elastic/supervisor.py): each is consulted exactly once per
+# RANK LAUNCH — the JobSupervisor launches generations sequentially and
+# the ranks of a generation in rank order, so consultation index k
+# deterministically names the k-th rank launch of the whole job (gen 0
+# consumes indices 0..W-1 for ranks 0..W-1, the first restart consumes
+# the next W' indices, and so on). ``rank-spawn-fail`` makes that rank's
+# launch fail before a child exists; ``rank-hang`` makes that rank stop
+# progressing mid-training (every peer then wedges in the next
+# collective — the shape only a COORDINATED abort recovers);
+# ``rank-kill`` makes the supervisor SIGKILL that rank at its first
+# committed checkpoint of the generation. All three recover through the
+# same coordinated-abort + whole-job restart-from-LATEST path.
 
 
 class InjectedFault(RuntimeError):

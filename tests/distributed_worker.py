@@ -16,6 +16,11 @@ import sys
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 import jax
 jax.config.update("jax_platforms", "cpu")
+# XLA CPU refuses multiprocess computations unless a collectives layer
+# is selected before backend init (gloo ships in the jaxlib wheel) —
+# without this every cross-process collective dies with
+# "Multiprocess computations aren't implemented on the CPU backend"
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

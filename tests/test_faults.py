@@ -154,6 +154,81 @@ def test_restore_skips_uncommitted_and_corrupt(tmp_path, caplog):
     assert ck.load_checkpoint_metadata(t2) == meta
 
 
+def test_manifest_detects_silently_corrupted_payload(tmp_path, caplog):
+    """The COMMITTED marker's sha256 manifest (PR 15): flipping ONE byte
+    inside a committed payload file passes the structural check but
+    fails the deep verification, and restore falls back to the newest
+    verified save with a warning naming the bad file."""
+    run = "manifest_test"
+    s0 = _tiny_state(step=0, scale=1.0)
+    ck.save_model(s0, run, path=str(tmp_path))
+    t1 = ck.save_model(_tiny_state(step=1, scale=2.0), run,
+                       path=str(tmp_path))
+    with open(os.path.join(t1, ck.COMMIT_MARKER)) as f:
+        lines = f.read().splitlines()
+    assert lines[0] == os.path.basename(t1)
+    manifest = [ln.split(" ", 2) for ln in lines[1:]]
+    assert manifest and all(len(m) == 3 for m in manifest)
+    assert ck.verify_manifest(t1) is None  # pristine save verifies
+    # flip one byte in the LARGEST manifested payload (the array data)
+    digest, size, rel = max(manifest, key=lambda m: int(m[1]))
+    victim = os.path.join(t1, rel)
+    with open(victim, "r+b") as f:
+        f.seek(int(size) // 2)
+        byte = f.read(1)
+        f.seek(int(size) // 2)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    # structural check still passes; the deep check names the file
+    assert ck.verify_checkpoint(t1)
+    bad = ck.verify_manifest(t1)
+    assert bad is not None and rel in bad and "sha256" in bad
+    with caplog.at_level(logging.WARNING, logger="hydragnn_tpu"):
+        assert not ck.verify_checkpoint(t1, deep=True)
+        restored = ck.load_existing_model(s0, run, path=str(tmp_path))
+    assert any(rel in r.message for r in caplog.records)
+    # fell back to the newest VERIFIED save instead of restoring garbage
+    assert int(restored.step) == 0
+    np.testing.assert_array_equal(np.asarray(restored.params["w"]),
+                                  np.ones((3,), np.float32))
+    # size mismatch is named too
+    with open(victim, "ab") as f:
+        f.write(b"x")
+    assert "size" in (ck.verify_manifest(t1) or "")
+
+
+def test_pre_manifest_checkpoint_still_restores(tmp_path):
+    """A COMMITTED marker written before the manifest existed (line 1
+    only) must keep restoring — the deep check is vacuous for it."""
+    run = "legacy_marker_test"
+    t = ck.save_model(_tiny_state(step=3, scale=3.0), run,
+                      path=str(tmp_path),
+                      metadata={"next_epoch": 2, "step": 3})
+    # rewrite the marker to the pre-PR single-line form
+    with open(os.path.join(t, ck.COMMIT_MARKER), "w") as f:
+        f.write(os.path.basename(t))
+    assert ck.verify_manifest(t) is None
+    assert ck.verify_checkpoint(t, deep=True)
+    restored, meta = ck.load_existing_model(_tiny_state(), run,
+                                            path=str(tmp_path),
+                                            with_metadata=True)
+    assert int(restored.step) == 3
+    # a pre-elastic resume.json passes the schema gate unchanged
+    assert ck.validate_resume_meta(meta) == meta
+
+
+def test_resume_meta_schema_tolerance():
+    """resume.json schema gate: unknown keys are ignored (forward
+    compat for the elastic world_size metadata and whatever comes
+    next); missing REQUIRED keys raise naming the key."""
+    meta = {"next_epoch": 2, "step": 10, "loader_epoch": 2,
+            "world_size": 4, "some_future_key": {"x": 1}}
+    assert ck.validate_resume_meta(meta) is meta
+    with pytest.raises(ValueError, match="'next_epoch'"):
+        ck.validate_resume_meta({"step": 1})
+    with pytest.raises(ValueError, match="'step'"):
+        ck.validate_resume_meta({"next_epoch": 1, "extra": True})
+
+
 def test_retention_gc_keeps_best_and_last_k(tmp_path):
     run = "retention_test"
     for step in range(1, 6):

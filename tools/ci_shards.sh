@@ -66,6 +66,10 @@ case "$shard" in
     # a multi-minute subprocess chaos e2e (real child training
     # processes) covered by the nightly hpo-chaos job
     python -m pytest -q -m "not slow" tests/test_hpo_supervisor.py
+    # same split for the elastic job supervisor: in-process fakes here;
+    # the multi-rank subprocess chaos e2e runs in the nightly
+    # elastic-chaos job
+    python -m pytest -q -m "not slow" tests/test_elastic.py
     ;;
   zoo)
     # the 13-model accuracy battery (per-model thresholds)

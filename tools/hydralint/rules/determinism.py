@@ -41,7 +41,11 @@ SCOPE_DIRS = ("hydragnn_tpu/graphs/", "hydragnn_tpu/preprocess/",
               # fault-site indexing: scheduling order, checkpoint-dir
               # probes, and fork-source selection must never follow set
               # or filesystem order (PR 14)
-              "hydragnn_tpu/hpo/")
+              "hydragnn_tpu/hpo/",
+              # the elastic job supervisor makes the same promise for
+              # rank launches, generation ledgers, and the shared
+              # checkpoint-dir progress probe
+              "hydragnn_tpu/elastic/")
 
 _FS_OS = ("listdir", "scandir")
 _FS_GLOB = ("glob", "iglob")

@@ -45,6 +45,10 @@ SCOPE_FILES = (
     # and read/flagged from other threads (prune/shutdown/snapshot) —
     # the same audited-concurrency contract as the serving engine (PR 14)
     "hydragnn_tpu/hpo/supervisor.py",
+    # the elastic job supervisor carries the same contract: the run loop
+    # mutates rank/generation state that shutdown()/snapshot() read from
+    # other threads, and the ledger is single-writer under the same lock
+    "hydragnn_tpu/elastic/supervisor.py",
 )
 
 _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")

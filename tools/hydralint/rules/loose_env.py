@@ -58,6 +58,10 @@ SCOPED_ALLOWLIST = {
     # same contract for the trial supervisor's subprocess launcher
     "hydragnn_tpu/hpo/process.py":
         ("child-trial env construction", ("_child_env",)),
+    # and for the elastic rank launcher: rendezvous coordinates,
+    # per-rank virtual device counts, fault-plan masking
+    "hydragnn_tpu/elastic/process.py":
+        ("child-rank env construction", ("_child_env",)),
 }
 
 MESSAGE = ("env read outside utils/envflags.py — parse via an envflags "

@@ -60,6 +60,14 @@ TRACED_DIRS = (
     # direct reads inside the subsystem (PR 14; the telemetry rule).
     # process.py is excluded below: its one read constructs a child env.
     os.path.join("hydragnn_tpu", "hpo"),
+    # the elastic job-supervision layer is host-side, but its knobs
+    # (restarts/heartbeat/backoff, rendezvous timeout) must resolve
+    # through utils/envflags.resolve_elastic /
+    # resolve_rendezvous_timeout at construction, never via direct
+    # reads inside the subsystem (the PR 14 rule, applied to the rank
+    # supervisor). process.py is excluded below: child-rank env
+    # construction.
+    os.path.join("hydragnn_tpu", "elastic"),
 )
 
 # host-side files inside an otherwise-traced directory; every entry must
@@ -71,6 +79,10 @@ EXCLUDED_FILES = (
     os.path.join("hydragnn_tpu", "hpo", "process.py"),  # child-trial
     # env construction (dict(os.environ, ...)) — loose-env-read still
     # covers the file via its function-scoped allowlist entry
+    os.path.join("hydragnn_tpu", "elastic", "process.py"),  # child-rank
+    # env construction (rendezvous coordinates, per-rank device counts)
+    # — loose-env-read still covers the file via its function-scoped
+    # allowlist entry
 )
 TRACED_FILES = (
     os.path.join("hydragnn_tpu", "train", "train_step.py"),
