@@ -916,7 +916,15 @@ def run_bench_serve_fleet(backend=None):
     futures (exactly-once resolution), a mid-stream hot-swap from a
     BEST checkpoint with no request failures, and a warm restart of the
     killed replica. The aggregate p99 is computed from the raw request
-    latencies pooled across every replica."""
+    latencies pooled across every replica.
+
+    A mixed-tier phase (docs/serving.md "Tiered fleets") then serves an
+    fp32 teacher and int8 distilled-student replicas behind one
+    TierPolicy router: priority requests route to the teacher, bulk to
+    the student, both tiers echo their version + tier on every future,
+    no future is lost, and restarting a replica of EITHER tier warms
+    from the shared compile store with zero fresh compiles (int8 keys
+    carry the calibration digest, so the ladders cannot collide)."""
     import shutil
     import tempfile
     import threading
@@ -1059,6 +1067,76 @@ def run_bench_serve_fleet(backend=None):
         restart_report = (router.restart_replica(dead[0])
                           if dead else {})
         router.shutdown()
+
+        # --- mixed-tier phase (docs/serving.md "Tiered fleets"): one
+        # fp32 TEACHER replica + int8 distilled-STUDENT replicas behind
+        # one router with a TierPolicy — priority routes to the
+        # teacher, bulk traffic to the student, both tiers share the
+        # compile store, and restarting EITHER tier is zero fresh
+        # compiles (int8 keys carry the calibration digest, so the two
+        # ladders cannot collide)
+        from hydragnn_tpu.quant import calibrate as quant_calibrate
+        from hydragnn_tpu.quant import distill_heads
+        from hydragnn_tpu.serving.fleet import TierPolicy
+        calibration = quant_calibrate(model, variables, mcfg, samples,
+                                      num_samples=16)
+        student_vars, distill_report = distill_heads(
+            model, variables, mcfg, calibration, samples,
+            steps=8, num_samples=16)
+
+        def tier_factory(idx):
+            if idx == 0:
+                return InferenceEngine(
+                    model, variables, mcfg, reference_samples=samples,
+                    max_batch_size=8, max_wait_ms=1.0,
+                    neighbor_format=use_nbr, compute_dtype="float32",
+                    compile_store=store, model_version="teacher-v1",
+                    breaker_threshold=3, breaker_reset_s=0.3)
+            return InferenceEngine(
+                model, student_vars, mcfg, reference_samples=samples,
+                max_batch_size=8, max_wait_ms=1.0,
+                neighbor_format=use_nbr, compute_dtype="int8",
+                quant_calibration=calibration, compile_store=store,
+                model_version="student-v1",
+                breaker_threshold=3, breaker_reset_s=0.3)
+
+        n_tier_req = min(n_req, 96)
+        tier_router = ReplicaRouter(
+            tier_factory, n_rep,
+            tier_policy=TierPolicy(fast="int8", accurate="float32",
+                                   priority_min=5, quota=0.5))
+        tier_warm_reports = tier_router.warmup()
+        t0 = time.perf_counter()
+        tier_prios = [9 if i % 4 == 0 else 0 for i in range(n_tier_req)]
+        tier_futs = [tier_router.submit(s, priority=p)
+                     for s, p in zip(samples[:n_tier_req], tier_prios)]
+        tier_unresolved = 0
+        for f in tier_futs:
+            try:
+                f.exception(timeout=300)
+            except FutTimeout:
+                tier_unresolved += 1
+        tier_dt = time.perf_counter() - t0
+        tier_failures = [f for f in tier_futs
+                         if f.done()
+                         and f.exception(timeout=0) is not None]
+        ok_futs = [(f, p) for f, p in zip(tier_futs, tier_prios)
+                   if f.done() and f.exception(timeout=0) is None]
+        hi_tiers = sorted({f.tier for f, p in ok_futs if p >= 5})
+        lo_tiers = sorted({f.tier for f, p in ok_futs if p < 5})
+        tier_versions = sorted({f.model_version for f, _ in ok_futs})
+        routed_by_priority = (hi_tiers == ["float32"]
+                              and lo_tiers == ["int8"])
+        tier_stats = tier_router.stats()
+        # restart one replica of EACH tier: both ladders must warm from
+        # the shared store with zero fresh compiles
+        tier_restarts = [tier_router.restart_replica(0),
+                         tier_router.restart_replica(1)]
+        tier_restart_warm = all(r["fresh"] == 0 for r in tier_restarts)
+        tier_router.shutdown()
+        tier_ok = (not tier_failures and tier_unresolved == 0
+                   and routed_by_priority and len(tier_versions) == 2
+                   and tier_restart_warm)
     finally:
         shutil.rmtree(work, ignore_errors=True)
 
@@ -1072,7 +1150,8 @@ def run_bench_serve_fleet(backend=None):
               and unresolved == 0 and len(versions) == 2
               and not swap_err and not swap_report.get("failed")
               and stats["kills"] >= 1
-              and (not restart_report or restart_report["fresh"] == 0))
+              and (not restart_report or restart_report["fresh"] == 0)
+              and tier_ok)
     out = {
         "metric": "serve_fleet_open_loop_p99_ms",
         "value": round(stats.get("p99_ms", 0.0), 3),
@@ -1118,6 +1197,34 @@ def run_bench_serve_fleet(backend=None):
             "warm_replicas_zero_fresh": store_warm_ok,
             "restart_report": restart_report,
             "restart_fresh_compiles": restart_report.get("fresh"),
+        },
+        "mixed_tier": {
+            "passed": tier_ok,
+            "requests": n_tier_req,
+            "throughput_gps": round(n_tier_req / tier_dt, 2),
+            "priority_min": 5,
+            "quota": 0.5,
+            "routed_by_priority": routed_by_priority,
+            "high_priority_tiers": hi_tiers,
+            "low_priority_tiers": lo_tiers,
+            "versions_echoed_on_futures": tier_versions,
+            "request_failures": len(tier_failures),
+            "unresolved_futures": tier_unresolved,
+            "tier_dispatches": tier_stats["tier_dispatches"],
+            "tier_fallbacks": tier_stats["tier_fallbacks"],
+            "tier_downgrades": tier_stats["tier_downgrades"],
+            "warmup_reports": tier_warm_reports,
+            "restart_reports": tier_restarts,
+            "restarts_zero_fresh_compiles": tier_restart_warm,
+            "distill": {
+                "improved": distill_report["improved"],
+                "best_step": distill_report["best_step"],
+                "head_mse_vs_teacher_pre":
+                    distill_report["head_mse_vs_teacher_pre"],
+                "head_mse_vs_teacher_post":
+                    distill_report["head_mse_vs_teacher_post"],
+            },
+            "calibration_digest": calibration.digest[:12],
         },
     }
     out_path = os.environ.get("BENCH_SERVE_FLEET_OUT", "").strip()
@@ -2294,15 +2401,20 @@ def run_bench_kernels(backend=None):
     exactly like the sized mode), every point reports the forward
     max-abs-diff against the unfused fp32 reference, and the fused fp32
     point's parity against the unfused path is the tier-1 kernel
-    contract re-checked at bench scale. A serving leg then runs a bf16
-    engine and an fp32 engine over identical samples/buckets and
-    adjudicates the bf16 outputs against the documented tolerance bound
-    (serving/engine.py SERVE_REDUCED_RTOL/ATOL).
+    contract re-checked at bench scale. An int8 leg times the PTQ
+    serving forward (quant/ptq.py — calibrated per-channel int8
+    conv-stack matmuls, forward-only because int8 is serving-only)
+    against the fp32 forward per model. A serving leg then runs fp32,
+    bf16, and int8 engines over identical samples/buckets and
+    adjudicates each reduced-precision output against its documented
+    tolerance bound (serving/engine.py SERVE_REDUCED_RTOL/ATOL;
+    SERVE_INT8_RTOL/ATOL).
 
-    The fused points are honest about the backend: on CPU the Pallas
-    kernels run in interpret mode and are expected to be far slower than
-    XLA (the r3 HYDRAGNN_USE_PALLAS lesson) — the CPU numbers guard
-    correctness and wiring; the speedup question is answered on-chip."""
+    The fused and int8 points are honest about the backend: on CPU the
+    Pallas kernels run in interpret mode, and XLA CPU emulates int8
+    matmuls rather than accelerating them — the CPU numbers guard
+    correctness and wiring; the speedup question is answered on-chip
+    (the r3 HYDRAGNN_USE_PALLAS lesson, the PR 6 bf16 precedent)."""
     import jax
     from hydragnn_tpu.config import build_model_config, update_config
     from hydragnn_tpu.graphs.batch import collate
@@ -2406,9 +2518,69 @@ def run_bench_kernels(backend=None):
                     if (p["model"], p["fused"], p["dtype"])
                     == (model, fused, dtype))
 
-    # serving leg: bf16 vs fp32 engines on identical samples + explicit
-    # shared buckets — the tolerance-bound adjudication
-    from hydragnn_tpu.serving.engine import (SERVE_REDUCED_ATOL,
+    # int8 leg: the calibrated PTQ forward (quant/ptq.py) vs the fp32
+    # forward on the same batch, per model — forward-only rows (int8 is
+    # a serving-only mode; the train-side factories reject it)
+    from hydragnn_tpu.quant import calibrate as quant_calibrate
+    from hydragnn_tpu.quant import make_quantized_forward
+
+    def _masked_head_diff(mcfg, outs_a, outs_b):
+        # compare REAL rows only: padding rows carry garbage on both
+        # sides by contract (engine serving unpads them before the
+        # caller ever sees a result), and fp32 garbage vs int8-clipped
+        # garbage diffs are meaningless
+        worst = 0.0
+        for ih, head in enumerate(mcfg.heads):
+            m = np.asarray(batch.node_mask if head.head_type == "node"
+                           else batch.graph_mask, bool)
+            a = np.asarray(outs_a[ih], np.float32)[m]
+            b = np.asarray(outs_b[ih], np.float32)[m]
+            worst = max(worst, float(np.abs(a - b).max()))
+        return worst
+
+    int8_rows = []
+    for model_type in ("SchNet", "PNA"):
+        cfg = make_config(model_type, heads=("node",), hidden_dim=hidden,
+                          num_conv_layers=2, radius=6.0)
+        cfg = update_config(cfg, samples)
+        mcfg = build_model_config(cfg)
+        model = create_model(mcfg)
+        variables = init_params(model, batch)
+        calibration = quant_calibrate(model, variables, mcfg, samples,
+                                      num_samples=min(len(samples), 8))
+        fwd32 = make_forward_fn(model, mcfg, compute_dtype="float32")
+        fwd8 = make_quantized_forward(model, mcfg, calibration)
+        j32 = jax.jit(lambda v, b, _f=fwd32: _f(v, b, train=False))
+        j8 = jax.jit(lambda v, b, _f=fwd8: _f(v, b, train=False))
+        out32, _ = j32(variables, batch)   # warmup/compile
+        out8, _ = j8(variables, batch)
+        jax.block_until_ready((out32, out8))
+
+        def _time_fwd(fn):
+            def reps():
+                o = None
+                for _ in range(steps):
+                    o, _ = fn(variables, batch)
+                jax.block_until_ready(o)
+            return _best_of(2, reps)
+        dt32 = _time_fwd(j32)
+        dt8 = _time_fwd(j8)
+        diff = _masked_head_diff(mcfg, out8, out32)
+        int8_rows.append({
+            "model": model_type,
+            "fp32_fwd_graphs_per_s": round(real_graphs * steps / dt32, 2),
+            "int8_fwd_graphs_per_s": round(real_graphs * steps / dt8, 2),
+            "int8_speedup_vs_fp32": round(dt32 / dt8, 3),
+            "fwd_max_abs_diff_vs_fp32": diff,
+            "calibrated_layers": len(calibration.scales),
+            "calibration_digest": calibration.digest[:12],
+        })
+
+    # serving leg: fp32 vs bf16 vs int8 engines on identical samples +
+    # explicit shared buckets — the tolerance-bound adjudications
+    from hydragnn_tpu.serving.engine import (SERVE_INT8_ATOL,
+                                             SERVE_INT8_RTOL,
+                                             SERVE_REDUCED_ATOL,
                                              SERVE_REDUCED_RTOL,
                                              InferenceEngine)
     cfg = make_config("PNA", heads=("node",), hidden_dim=hidden,
@@ -2421,7 +2593,10 @@ def run_bench_kernels(backend=None):
     engines = {}
     serve_out = {}
     try:
-        for dtype in ("float32", "bfloat16"):
+        for dtype in ("float32", "bfloat16", "int8"):
+            # the int8 engine auto-calibrates from reference_samples
+            # (engine ctor -> quant/calibrate.py) — the same path
+            # run_prediction's fleet wiring exercises
             engines[dtype] = InferenceEngine(
                 model, variables, mcfg, reference_samples=samples,
                 max_batch_size=4, max_wait_ms=1.0, num_buckets=1,
@@ -2430,26 +2605,41 @@ def run_bench_kernels(backend=None):
             serve_out[dtype] = engines[dtype].predict(samples[:serve_n],
                                                       timeout=600)
             serve_out[dtype + "_dt"] = time.perf_counter() - t0
-        worst = -np.inf   # most-positive |diff| - bound; negative = inside
-        within = True
-        for res32, res16 in zip(serve_out["float32"],
-                                serve_out["bfloat16"]):
-            for a, b in zip(res32, res16):
-                a = np.asarray(a, np.float32)
-                b = np.asarray(b, np.float32)
-                bound = SERVE_REDUCED_ATOL + SERVE_REDUCED_RTOL * np.abs(a)
-                worst = max(worst, float((np.abs(b - a) - bound).max()))
-                within = within and bool((np.abs(b - a) <= bound).all())
+
+        def _adjudicate(results, rtol, atol):
+            # most-positive |diff| - bound; negative = inside the bound
+            worst = -np.inf
+            within = True
+            for ref_res, res in zip(serve_out["float32"], results):
+                for a, b in zip(ref_res, res):
+                    a = np.asarray(a, np.float32)
+                    b = np.asarray(b, np.float32)
+                    bound = atol + rtol * np.abs(a)
+                    worst = max(worst, float((np.abs(b - a) - bound).max()))
+                    within = within and bool(
+                        (np.abs(b - a) <= bound).all())
+            return within, worst
+        bf16_within, bf16_worst = _adjudicate(
+            serve_out["bfloat16"], SERVE_REDUCED_RTOL, SERVE_REDUCED_ATOL)
+        int8_within, int8_worst = _adjudicate(
+            serve_out["int8"], SERVE_INT8_RTOL, SERVE_INT8_ATOL)
         serving = {
             "requests": serve_n,
             "fp32_gps": round(serve_n / serve_out["float32_dt"], 2),
             "bf16_gps": round(serve_n / serve_out["bfloat16_dt"], 2),
+            "int8_gps": round(serve_n / serve_out["int8_dt"], 2),
             "tolerance_rtol": SERVE_REDUCED_RTOL,
             "tolerance_atol": SERVE_REDUCED_ATOL,
-            "bf16_within_bound": within,
-            "worst_margin_to_bound": worst,   # <= 0 means inside
+            "bf16_within_bound": bf16_within,
+            "worst_margin_to_bound": bf16_worst,   # <= 0 means inside
+            "int8_tolerance_rtol": SERVE_INT8_RTOL,
+            "int8_tolerance_atol": SERVE_INT8_ATOL,
+            "int8_within_bound": int8_within,
+            "int8_worst_margin_to_bound": int8_worst,
             "fp32_parity": engines["float32"].parity,
             "bf16_parity": engines["bfloat16"].parity,
+            "int8_parity": engines["int8"].parity,
+            "int8_tier": engines["int8"].tier,
         }
     finally:
         for eng in engines.values():
@@ -2479,6 +2669,9 @@ def run_bench_kernels(backend=None):
             m: round(_gps(m, False, "bfloat16")
                      / _gps(m, False, "float32"), 3)
             for m in ("SchNet", "PNA")},
+        "int8_fwd_speedup": {row["model"]: row["int8_speedup_vs_fp32"]
+                             for row in int8_rows},
+        "int8_forward": int8_rows,
         "grid": grid,
         "serving": serving,
     }

@@ -220,6 +220,18 @@ def _predict_with_engine(model, state, mcfg, testset, serving, num_shards,
         from .utils.devices import CompileStore
         compile_store = CompileStore(fleet.compile_store)
 
+    quant_calibration = None
+    if serving.precision == "int8":
+        # calibrate ONCE and share the scales across every replica:
+        # identical scales -> identical traced programs -> identical
+        # compile-store keys, so a fleet of int8 replicas warms from one
+        # store entry per bucket (quant/calibrate.py; docs/serving.md)
+        from .quant import calibrate
+        quant_calibration = calibrate(
+            model, variables, mcfg, testset,
+            num_samples=serving.quant_calib_samples,
+            batch_transform=None)
+
     def make_engine(replica_idx=0):
         return InferenceEngine(
             model, variables, mcfg, reference_samples=testset,
@@ -233,6 +245,8 @@ def _predict_with_engine(model, state, mcfg, testset, serving, num_shards,
             # HYDRAGNN_SERVE_PRECISION, docs/kernels_mixed_precision.md);
             # None inherits the train-side policy
             compute_dtype=serving.precision,
+            quant_calibration=quant_calibration,
+            quant_calib_samples=serving.quant_calib_samples,
             # the failure-semantics knobs (max_queue/deadline_ms/breaker_*)
             # deliberately stay at their permissive defaults here: this is
             # the OFFLINE batch-predict path, which submits the whole
@@ -252,11 +266,23 @@ def _predict_with_engine(model, state, mcfg, testset, serving, num_shards,
             model_version=f"step_{int(state.step)}")
 
     if fleet.replicas > 1:
-        from .serving.fleet import ReplicaRouter
+        from .serving.fleet import ReplicaRouter, TierPolicy
+        tier_policy = None
+        if fleet.tier_priority_min > 0:
+            # Serving.fleet.tier_* / HYDRAGNN_FLEET_TIER_*: priority/
+            # quota routing across engine tiers (docs/serving.md
+            # "Tiered fleets"); the offline predict below submits at
+            # priority 0, so the policy only matters for live traffic
+            # sharing this router
+            tier_policy = TierPolicy(
+                fast=fleet.tier_fast, accurate=fleet.tier_accurate,
+                priority_min=fleet.tier_priority_min,
+                quota=fleet.tier_quota)
         server = ReplicaRouter(
             make_engine, fleet.replicas,
             max_redispatch=fleet.redispatch_max or None,
-            drain_timeout_s=fleet.drain_timeout_s)
+            drain_timeout_s=fleet.drain_timeout_s,
+            tier_policy=tier_policy)
     else:
         server = make_engine()
     try:

@@ -20,6 +20,8 @@ Config schema (top-level block, alongside "Dataset"/"NeuralNetwork"):
         "breaker_threshold": 5,    # consecutive batch failures to trip
         "breaker_reset_s": 30.0,   # open -> half-open probe window
         "precision": null,         # serve-side compute dtype override
+        "quant_calib_samples": 32, # int8 calibration-set size
+                                   # (precision="int8" only; quant/)
         "metrics_port": 0,         # /healthz + /metrics HTTP port
                                    # (0 = off; see docs/observability.md)
         "structure": false,        # raw-structure serving (submit_structure)
@@ -41,7 +43,14 @@ Config schema (top-level block, alongside "Dataset"/"NeuralNetwork"):
                                        # null/"" = off
             "redispatch_max": 0,       # re-dispatch budget per request
                                        # (0 = one try per replica)
-            "drain_timeout_s": 30.0    # hot-swap per-replica drain bound
+            "drain_timeout_s": 30.0,   # hot-swap per-replica drain bound
+            "tier_priority_min": 0,    # priority threshold for the
+                                       # accurate tier (0 = tier routing
+                                       # off; fleet.TierPolicy)
+            "tier_quota": 0.0,         # max accurate-tier dispatch
+                                       # fraction (0 = no cap)
+            "tier_fast": "int8",       # fast-tier engine tag
+            "tier_accurate": "float32" # accurate-tier engine tag
         }
     }
 
@@ -49,12 +58,17 @@ The queue/deadline/breaker knobs are the failure-semantics layer
 (docs/fault_tolerance.md): QueueFullError backpressure,
 DeadlineExceededError expiry, and the dispatcher circuit breaker.
 
-`precision` (env: HYDRAGNN_SERVE_PRECISION; "float32" | "bfloat16") is
-the serve-side compute-dtype override (docs/kernels_mixed_precision.md):
-unset, the engine inherits the train-side policy (HYDRAGNN_PRECISION /
-Architecture.dtype). A reduced-precision engine relaxes the PR 3
-bitwise-parity adjudication to the documented tolerance bound — each
-resolved future carries the bound (engine.py SERVE_REDUCED_RTOL/ATOL).
+`precision` (env: HYDRAGNN_SERVE_PRECISION; "float32" | "bfloat16" |
+"int8") is the serve-side compute-dtype override
+(docs/kernels_mixed_precision.md): unset, the engine inherits the
+train-side policy (HYDRAGNN_PRECISION / Architecture.dtype). A
+reduced-precision engine relaxes the PR 3 bitwise-parity adjudication
+to the documented tolerance bound — each resolved future carries the
+bound (engine.py SERVE_REDUCED_RTOL/ATOL; SERVE_INT8_RTOL/ATOL for the
+quantized tier). "int8" is the post-training-quantization serving tier
+(quant/): run_prediction calibrates activation scales on the first
+`quant_calib_samples` test samples (env: HYDRAGNN_QUANT_CALIB_SAMPLES,
+strict int) and every engine serves the quantized conv stack.
 
 `structure` (env: HYDRAGNN_SERVE_STRUCTURE) enables the raw-structure
 serving path (docs/serving.md): run_prediction hands the engine the full
@@ -72,6 +86,15 @@ that many engines (least-queue-depth dispatch, per-replica breaker
 isolation, re-dispatch off dead replicas); `compile_store` points every
 replica at one persistent AOT executable store so warmups load the
 bucket ladder from disk.
+
+The `tier_*` fleet knobs (env: HYDRAGNN_FLEET_TIER_PRIORITY_MIN /
+HYDRAGNN_FLEET_TIER_QUOTA, strict parsing; HYDRAGNN_FLEET_TIER_FAST /
+HYDRAGNN_FLEET_TIER_ACCURATE, plain strings) configure priority/quota
+tier routing (docs/serving.md "Tiered fleets"; fleet.TierPolicy):
+`tier_priority_min` > 0 installs a TierPolicy — requests submitted at
+or above that priority prefer the `tier_accurate` replicas, the rest
+prefer `tier_fast`, and `tier_quota` caps the accurate tier's dispatch
+share. 0 (the default) keeps the fleet tier-blind.
 
 `md_farm` (env: HYDRAGNN_MD_FARM_STEPS_PER_DISPATCH /
 HYDRAGNN_MD_FARM_CAND_HEADROOM, strict parsing) tunes the trajectory
@@ -148,6 +171,12 @@ class FleetConfig:
     compile_store: Optional[str] = None  # persistent AOT store dir
     redispatch_max: int = 0       # 0 = one try per replica
     drain_timeout_s: float = 30.0
+    tier_priority_min: int = 0    # 0 = tier routing off; > 0 installs a
+    # TierPolicy with this priority threshold (fleet.TierPolicy)
+    tier_quota: float = 0.0       # max accurate-tier dispatch fraction
+    # (0 = no cap)
+    tier_fast: str = "int8"       # fast-tier engine tag
+    tier_accurate: str = "float32"  # accurate-tier engine tag
 
 
 def resolve_fleet(config: Optional[Dict[str, Any]] = None) -> FleetConfig:
@@ -163,6 +192,11 @@ def resolve_fleet(config: Optional[Dict[str, Any]] = None) -> FleetConfig:
                        if block.get("compile_store") else None),
         redispatch_max=int(block.get("redispatch_max", 0) or 0),
         drain_timeout_s=float(block.get("drain_timeout_s", 30.0) or 30.0),
+        tier_priority_min=int(block.get("tier_priority_min", 0) or 0),
+        tier_quota=float(block.get("tier_quota", 0.0) or 0.0),
+        tier_fast=str(block.get("tier_fast", "int8") or "int8"),
+        tier_accurate=str(block.get("tier_accurate", "float32")
+                          or "float32"),
     )
     return FleetConfig(
         replicas=env_strict_int("HYDRAGNN_FLEET_REPLICAS", base.replicas),
@@ -172,6 +206,13 @@ def resolve_fleet(config: Optional[Dict[str, Any]] = None) -> FleetConfig:
                                       base.redispatch_max),
         drain_timeout_s=env_strict_float("HYDRAGNN_FLEET_DRAIN_TIMEOUT_S",
                                          base.drain_timeout_s),
+        tier_priority_min=env_strict_int("HYDRAGNN_FLEET_TIER_PRIORITY_MIN",
+                                         base.tier_priority_min),
+        tier_quota=env_strict_float("HYDRAGNN_FLEET_TIER_QUOTA",
+                                    base.tier_quota),
+        tier_fast=env_str("HYDRAGNN_FLEET_TIER_FAST", base.tier_fast),
+        tier_accurate=env_str("HYDRAGNN_FLEET_TIER_ACCURATE",
+                              base.tier_accurate),
     )
 
 
@@ -187,6 +228,8 @@ class ServingConfig:
     breaker_threshold: int = 5    # 0 disables the circuit breaker
     breaker_reset_s: float = 30.0
     precision: Optional[str] = None  # None = inherit the train-side policy
+    quant_calib_samples: int = 32  # int8 calibration-set size (the first
+    # N test samples; precision="int8" only — see quant/calibrate.py)
     metrics_port: int = 0         # 0 = no HTTP endpoint; > 0 = bind that
     # port on loopback for /healthz + /metrics (telemetry/http.py)
     structure: bool = False       # raw-structure serving (submit_structure)
@@ -213,6 +256,8 @@ def resolve_serving(config: Optional[Dict[str, Any]]) -> ServingConfig:
         breaker_threshold=int(block.get("breaker_threshold", 5)),
         breaker_reset_s=float(block.get("breaker_reset_s", 30.0)),
         precision=canonical_precision(block.get("precision")),
+        quant_calib_samples=int(block.get("quant_calib_samples", 32)
+                                or 32),
         metrics_port=int(block.get("metrics_port", 0) or 0),
         structure=bool(block.get("structure", False)),
         md_skin=float(block.get("md_skin", 0.3)),
@@ -237,6 +282,8 @@ def resolve_serving(config: Optional[Dict[str, Any]]) -> ServingConfig:
                                          base.breaker_reset_s),
         precision=env_strict_choice("HYDRAGNN_SERVE_PRECISION",
                                     PRECISION_CHOICES, base.precision),
+        quant_calib_samples=env_strict_int("HYDRAGNN_QUANT_CALIB_SAMPLES",
+                                           base.quant_calib_samples),
         metrics_port=env_strict_int("HYDRAGNN_SERVE_METRICS_PORT",
                                     base.metrics_port),
         structure=env_strict_flag("HYDRAGNN_SERVE_STRUCTURE",

@@ -127,6 +127,30 @@ _SHUTDOWN = object()
 SERVE_REDUCED_RTOL = 2.0 ** -5
 SERVE_REDUCED_ATOL = 2.0 ** -5
 
+# int8 serving parity contract (docs/kernels_mixed_precision.md
+# "int8"). An int8 engine (compute_dtype "int8": calibrated per-channel
+# PTQ over the conv-stack matmuls, quant/ptq.py) keeps the same-bucket
+# batched-vs-single BITWISE guarantee — identical compiled program,
+# row-independent math, exact int32 accumulation — and adjudicates
+# against fp32 with
+#
+#     |int8_out - fp32_out| <= SERVE_INT8_ATOL
+#                              + SERVE_INT8_RTOL * |fp32_out|
+#
+# 2^-3 is the symmetric-127-level budget: one quantized matmul's output
+# error is bounded by the input rounding (<= s_x/2 per channel, i.e.
+# 2^-8 of the calibrated range) plus the weight rounding (<= s_w/2,
+# another 2^-8 relative), amplified through the <= 8
+# rounding-dominated stages of the deepest model-zoo conv stacks and
+# the nonlinearities between them — 8 stages x ~2^-7 per stage lands
+# within 2^-3 at unit scale, with the int32 accumulation contributing
+# exactly zero (no swamping term, unlike bf16). Every resolved future
+# carries the bound as `.parity`/`.parity_rtol`/`.parity_atol`
+# (tests/test_quant.py pins it; BENCH_KERNELS adjudicates it at bench
+# scale).
+SERVE_INT8_RTOL = 2.0 ** -3
+SERVE_INT8_ATOL = 2.0 ** -3
+
 
 class ServingError(RuntimeError):
     """Base of the engine's failure-semantics errors."""
@@ -234,7 +258,10 @@ class InferenceEngine:
                  md_skin: float = 0.3,
                  ef_forward: bool = False,
                  compile_store=None,
-                 model_version: str = "v0"):
+                 model_version: str = "v0",
+                 tier: Optional[str] = None,
+                 quant_calibration=None,
+                 quant_calib_samples: int = 32):
         import jax
         from ..train.precision import resolve_precision
         from ..train.train_step import make_forward_fn
@@ -247,13 +274,42 @@ class InferenceEngine:
         self.compute_dtype = resolve_precision(
             getattr(mcfg, "dtype", None), compute_dtype)
         compute_dtype = self.compute_dtype
-        reduced = self.compute_dtype != "float32"
-        self.parity = "tolerance" if reduced else "bitwise"
-        self.parity_rtol = SERVE_REDUCED_RTOL if reduced else 0.0
-        self.parity_atol = SERVE_REDUCED_ATOL if reduced else 0.0
+        # three rungs of the precision ladder
+        # (docs/kernels_mixed_precision.md): fp32 = bitwise parity, bf16
+        # = the reduced tolerance bound, int8 = calibrated PTQ
+        # (quant/ptq.py) under its own documented bound
+        self.quantized = self.compute_dtype == "int8"
+        if self.quantized:
+            self.parity = "tolerance"
+            self.parity_rtol = SERVE_INT8_RTOL
+            self.parity_atol = SERVE_INT8_ATOL
+        elif self.compute_dtype != "float32":
+            self.parity = "tolerance"
+            self.parity_rtol = SERVE_REDUCED_RTOL
+            self.parity_atol = SERVE_REDUCED_ATOL
+        else:
+            self.parity = "bitwise"
+            self.parity_rtol = 0.0
+            self.parity_atol = 0.0
+        # the fleet tier this engine serves under (serving/fleet.py
+        # TierPolicy): defaults to the compute dtype name, so a mixed
+        # int8/fp32 fleet tiers itself without extra wiring; echoed on
+        # every resolved future next to `.bucket`/`.model_version`
+        self.tier = str(tier) if tier is not None else self.compute_dtype
         self.max_batch_size = max(int(max_batch_size), 1)
         self.max_wait_s = max(float(max_wait_ms), 0.0) / 1e3
         self.num_shards = max(int(num_shards), 1)
+        if self.quantized and self.num_shards > 1:
+            raise ValueError(
+                "int8 serving is single-shard for now — run one int8 "
+                "engine per device (a fleet tier of them, "
+                "serving/fleet.py) instead of num_shards > 1")
+        if self.quantized and ef_forward:
+            raise ValueError(
+                "ef_forward needs exact gradients (forces = -dE/dpos) "
+                "and the int8 round/clip has a zero gradient almost "
+                "everywhere — serve EF from the fp32/bf16 tier and keep "
+                "int8 for the plain forward tiers")
         # failure-semantics knobs (docs/fault_tolerance.md): 0 disables
         # the bound / deadline / breaker respectively
         self.max_queue = max(int(max_queue), 0)
@@ -353,6 +409,31 @@ class InferenceEngine:
         self._started_at = time.monotonic()
         self._model = model  # retained for trajectory_farm (the farm
         # builds its own vmapped EF forward from the same model/config)
+
+        # int8 calibration (quant/calibrate.py): explicit scales win
+        # (run_prediction calibrates ONCE and shares them across
+        # replicas so every replica compiles identical programs);
+        # otherwise the engine calibrates itself from the reference
+        # samples. The scale digest goes into the compile-store key —
+        # the activation scales are trace-time constants inside the
+        # compiled artifact (_store_key).
+        self.quant_calibration = None
+        self._quant_digest = None
+        if self.quantized:
+            if quant_calibration is None:
+                if not reference_samples:
+                    raise ValueError(
+                        "int8 serving needs calibration: pass "
+                        "quant_calibration (quant.calibrate) or "
+                        "reference_samples for the engine to calibrate "
+                        "from (docs/kernels_mixed_precision.md)")
+                from ..quant.calibrate import calibrate
+                quant_calibration = calibrate(
+                    model, self._variables, mcfg, reference_samples,
+                    num_samples=quant_calib_samples,
+                    batch_transform=self.batch_transform)
+            self.quant_calibration = quant_calibration
+            self._quant_digest = quant_calibration.digest
         if self.num_shards > 1:
             from ..parallel.mesh import make_mesh
             from ..parallel.spmd import make_spmd_forward
@@ -360,7 +441,17 @@ class InferenceEngine:
             self._jit_forward = make_spmd_forward(model, mesh, mcfg,
                                                   compute_dtype)
         else:
-            forward = make_forward_fn(model, mcfg, compute_dtype)
+            if self.quantized:
+                # the quantized forward is f32-in/f32-out with the
+                # conv-stack matmuls rerouted through int8 kernels; it
+                # replaces make_forward_fn's cast policy wholesale (an
+                # int8 _cast_floats would destroy the params — the
+                # train-side guard rejects exactly that)
+                from ..quant.ptq import make_quantized_forward
+                forward = make_quantized_forward(model, mcfg,
+                                                 self.quant_calibration)
+            else:
+                forward = make_forward_fn(model, mcfg, compute_dtype)
 
             if self.ef_forward:
                 from ..train.loss import energy_forces_from_node_head
@@ -695,6 +786,7 @@ class InferenceEngine:
                 "state": ("shutdown" if self._closed
                           else self._breaker_state),
                 "model_version": self.model_version,
+                "tier": self.tier,
                 "uptime_s": time.monotonic() - self._started_at,
                 "swap_count": self.swap_count,
                 "queue_depth": self._queue.qsize(),
@@ -906,6 +998,7 @@ class InferenceEngine:
                 "num_buckets": len(self.buckets),
                 "compute_dtype": self.compute_dtype,
                 "parity": self.parity,
+                "tier": self.tier,
                 "model_version": self.model_version,
                 "swap_count": self.swap_count,
                 "probe_count": self.probe_count,
@@ -1001,8 +1094,14 @@ class InferenceEngine:
     def _store_key(self, bucket: PackBudget) -> str:
         """Compile-store fingerprint for one bucket's program: model
         config + bucket shape + everything else that changes the
-        compiled artifact (dtype, shard count, schema layout). The
-        store itself folds in the jax version and backend platform."""
+        compiled artifact (shard count, schema layout). The store
+        itself folds in the jax version and backend platform; the
+        precision MODE — compute dtype plus the int8 calibration-scale
+        digest — rides the store's labeled `precision` field, so an
+        int8 and an fp32 executable for the same bucket can never
+        collide on a warm restart, and two int8 programs baked from
+        different calibration scales cannot either (the scales are
+        constants inside the compiled artifact)."""
         p = self._proto
         schema = tuple(
             (name, None if getattr(p, name) is None
@@ -1011,8 +1110,9 @@ class InferenceEngine:
         from ..utils.devices import CompileStore
         return CompileStore.fingerprint(
             self.mcfg, (bucket.n_node, bucket.n_edge, bucket.n_graph),
-            self.compute_dtype, self.num_shards, self.neighbor_k,
-            self.ef_forward, schema)
+            self.num_shards, self.neighbor_k,
+            self.ef_forward, schema,
+            precision=(self.compute_dtype, self._quant_digest))
 
     def _get_compiled(self, bucket: PackBudget, proto_batch: GraphBatch):
         with self._lock:
@@ -1197,6 +1297,8 @@ class InferenceEngine:
                 req.future.parity_atol = self.parity_atol  # parity bound
                 req.future.model_version = version  # + the hot-swap tag:
                 # which weights actually served this request
+                req.future.tier = self.tier  # + the fleet tier that
+                # served it (int8 fast vs fp32 accurate; serving/fleet.py)
                 req.future.set_result(res)
         except BaseException as e:  # noqa: BLE001 — must reach the callers
             # dispatcher supervision: a failed batch resolves only ITS OWN

@@ -42,6 +42,19 @@ survives replica death and model upgrades with zero lost futures
   in ``/healthz``. The ``swap-fail`` fault site makes a swap fail
   cleanly BEFORE mutation: the old version keeps serving, no request
   fails.
+* ``TierPolicy`` routes by REQUEST PRIORITY across serving tiers
+  (docs/serving.md "Tiered fleets"): every engine carries a ``tier``
+  tag (the int8 fast students vs the fp32 accurate teacher,
+  serving/engine.py), and a request submitted at or above
+  ``priority_min`` prefers the accurate tier — bounded by ``quota``,
+  the max fraction of total dispatches the accurate tier may absorb
+  (exceeding it downgrades the request to the fast tier, counted in
+  ``tier_downgrades``). Availability beats affinity: when the
+  preferred tier has no routable replica the request falls back
+  cross-tier (``tier_fallbacks``) instead of failing — zero lost
+  futures is the fleet invariant, tiers only bias placement. The tier
+  that actually served is echoed on every future (``.tier``) next to
+  ``.bucket``/``.model_version``.
 * ``kill_replica`` is the deterministic stand-in for process death
   (driven by the ``replica-kill`` fault site): the replica leaves
   rotation immediately, its in-flight requests re-dispatch, and
@@ -62,6 +75,7 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import Future
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence
 
 from ..telemetry.registry import get_registry
@@ -80,19 +94,56 @@ class SwapFailedError(ServingError):
     them); the failed replicas keep serving the OLD version."""
 
 
+@dataclass(frozen=True)
+class TierPolicy:
+    """Priority/quota routing between serving tiers (docs/serving.md
+    "Tiered fleets").
+
+    `fast`/`accurate` name the two engine tier tags (the engine's
+    ``tier`` ctor arg, defaulting to its compute dtype — so an
+    int8-quantized student replica is tier "int8" and the fp32 teacher
+    is "float32" out of the box). A request with
+    ``priority >= priority_min`` prefers the accurate tier; everything
+    else prefers the fast tier. ``quota`` in (0, 1] caps the fraction
+    of TOTAL fleet dispatches the accurate tier may absorb — a
+    priority request over quota is downgraded to the fast tier
+    (counted) rather than queued, so a burst of "important" traffic
+    cannot starve the teacher replicas into a latency cliff. quota=0
+    disables the cap. The policy only BIASES placement: when the
+    preferred tier has no routable replica the router falls back
+    cross-tier (counted) — availability beats affinity."""
+
+    fast: str = "int8"
+    accurate: str = "float32"
+    priority_min: int = 1
+    quota: float = 0.0
+
+    def __post_init__(self):
+        if not (0.0 <= float(self.quota) <= 1.0):
+            raise ValueError(
+                f"TierPolicy.quota={self.quota!r} must be in [0, 1] — "
+                "it is the max fraction of dispatches the accurate "
+                "tier may absorb (0 disables the cap)")
+        if str(self.fast) == str(self.accurate):
+            raise ValueError(
+                f"TierPolicy fast and accurate tiers are both "
+                f"{self.fast!r} — a one-tier fleet needs no policy")
+
+
 class _RouterRequest:
     """One router-level request: the caller's future plus the
     re-dispatch bookkeeping. `resolved` flips exactly once under the
     router lock — the idempotency point for late results from killed
     replicas."""
 
-    __slots__ = ("sample", "future", "deadline_ms", "attempts", "tried",
-                 "resolved", "wait_deadline")
+    __slots__ = ("sample", "future", "deadline_ms", "priority",
+                 "attempts", "tried", "resolved", "wait_deadline")
 
-    def __init__(self, sample, deadline_ms):
+    def __init__(self, sample, deadline_ms, priority=0):
         self.sample = sample
         self.future: Future = Future()
         self.deadline_ms = deadline_ms
+        self.priority = int(priority)
         self.attempts = 0   # dispatches consumed (first + re-dispatches)
         self.tried = set()  # replica idxs that failed this request
         #                     (membership only — never iterated)
@@ -135,10 +186,12 @@ class ReplicaRouter:
                  num_replicas: int, *,
                  max_redispatch: Optional[int] = None,
                  drain_timeout_s: float = 30.0,
-                 unavailable_wait_s: float = 5.0):
+                 unavailable_wait_s: float = 5.0,
+                 tier_policy: Optional[TierPolicy] = None):
         if num_replicas < 1:
             raise ValueError("ReplicaRouter needs num_replicas >= 1")
         self._factory = engine_factory
+        self.tier_policy = tier_policy  # immutable after construction
         self._replicas: List[_Replica] = [
             _Replica(i, engine_factory(i)) for i in range(num_replicas)]
         # one try per replica by default: N replicas = N total dispatch
@@ -166,19 +219,31 @@ class ReplicaRouter:
         self.restart_count = 0  # guarded-by: _lock
         self.swap_attempts = 0  # guarded-by: _lock
         self.swap_failures = 0  # guarded-by: _lock
+        self.tier_fallbacks = 0  # guarded-by: _lock — requests placed on
+        #   the NON-preferred tier because the preferred one had no
+        #   routable replica (availability beats affinity)
+        self.tier_downgrades = 0  # guarded-by: _lock — priority requests
+        #   routed to the fast tier because the accurate tier was over
+        #   its dispatch quota
+        self._tier_dispatches: Dict[str, int] = {}  # guarded-by: _lock —
+        #   dispatch counts per engine tier tag (the quota denominator)
         self._metrics_server = None
 
     # ------------------------------------------------------------ client API
 
-    def submit(self, sample, deadline_ms: Optional[float] = None) -> Future:
+    def submit(self, sample, deadline_ms: Optional[float] = None,
+               priority: int = 0) -> Future:
         """Route one request to the best replica; returns a Future that
         resolves exactly once — with the result of whichever replica
         finally served it (re-dispatched transparently across replica
         death / breaker rejection / batch failure), or with the terminal
         error. The resolved future carries the serving replica's
-        breadcrumbs (`.bucket`, `.parity*`, `.model_version`) plus
-        `.replica` (its index)."""
-        rr = _RouterRequest(sample, deadline_ms)
+        breadcrumbs (`.bucket`, `.parity*`, `.model_version`, `.tier`)
+        plus `.replica` (its index). `priority` only matters under a
+        `tier_policy`: at or above its `priority_min` the request
+        prefers the accurate tier (subject to quota), below it the fast
+        tier — with cross-tier fallback either way."""
+        rr = _RouterRequest(sample, deadline_ms, priority=priority)
         self._dispatch(rr)
         return rr.future
 
@@ -227,6 +292,11 @@ class ReplicaRouter:
                 "restarts": self.restart_count,
                 "swap_attempts": self.swap_attempts,
                 "swap_failures": self.swap_failures,
+                "tier_fallbacks": self.tier_fallbacks,
+                "tier_downgrades": self.tier_downgrades,
+                "tier_dispatches": {
+                    t: self._tier_dispatches[t]
+                    for t in sorted(self._tier_dispatches)},
             }
         replicas = {}
         routable = 0
@@ -266,6 +336,11 @@ class ReplicaRouter:
                 "stale_failures": self.stale_failures,
                 "kills": self.kill_count,
                 "restarts": self.restart_count,
+                "tier_fallbacks": self.tier_fallbacks,
+                "tier_downgrades": self.tier_downgrades,
+                "tier_dispatches": {
+                    t: self._tier_dispatches[t]
+                    for t in sorted(self._tier_dispatches)},
             }
         latencies: List[float] = []
         per_replica = {}
@@ -526,13 +601,41 @@ class ReplicaRouter:
         replica's capacity; the engine admits exactly one probe), then
         the closed-breaker replica with the smallest queue depth, ties
         by index. Replicas this request already failed on are avoided
-        until only they remain."""
+        until only they remain. Under a `tier_policy` the candidate set
+        is first narrowed to the request's preferred tier; only when
+        that tier has no routable replica does the scan widen to the
+        rest of the fleet (a counted fallback) — a tier preference must
+        never turn a servable request into a FleetUnavailableError."""
         with self._lock:
             candidates = [r for r in self._replicas
                           if r.alive and not r.draining]
         untried = [r for r in candidates if r.idx not in rr.tried]
         if untried:
             candidates = untried
+        preferred = self._preferred_tier(rr)
+        if preferred is None:
+            return self._pick_from(candidates)
+        pref = [r for r in candidates
+                if getattr(r.engine, "tier", None) == preferred]
+        chosen = self._pick_from(pref) if pref else None
+        if chosen is not None:
+            return chosen
+        rest = [r for r in candidates if r not in pref]
+        chosen = self._pick_from(rest)
+        if chosen is not None:
+            with self._lock:
+                self.tier_fallbacks += 1
+            get_registry().counter_inc(
+                "serve.fleet_tier_fallbacks_total",
+                help="requests served by the non-preferred tier because "
+                     "the preferred tier had no routable replica")
+        return chosen
+
+    def _pick_from(self, candidates: List[_Replica]
+                   ) -> Optional[_Replica]:
+        """Probe-due first, then min-queue-depth closed, ties by index,
+        over an explicit candidate list (dead replicas found during the
+        health scan are marked dead as a side effect)."""
         closed = []
         probe_due = []
         for rep in candidates:
@@ -549,6 +652,33 @@ class ReplicaRouter:
         if closed:
             return min(closed)[2]
         return None
+
+    def _preferred_tier(self, rr: _RouterRequest) -> Optional[str]:
+        """The tier tag this request should land on, or None when no
+        policy is installed. A priority request over the accurate
+        tier's dispatch quota is DOWNGRADED here — it prefers the fast
+        tier for its whole lifetime rather than queueing on the
+        teacher, and `tier_downgrades` counts the decision once per
+        pick so operators can see quota pressure."""
+        pol = self.tier_policy
+        if pol is None:
+            return None
+        if rr.priority < pol.priority_min:
+            return pol.fast
+        if pol.quota > 0.0:
+            with self._lock:
+                acc = self._tier_dispatches.get(pol.accurate, 0)
+                total = sum(self._tier_dispatches.values())
+            # would THIS dispatch push the accurate share over quota?
+            if total > 0 and (acc + 1) / (total + 1) > pol.quota:
+                with self._lock:
+                    self.tier_downgrades += 1
+                get_registry().counter_inc(
+                    "serve.fleet_tier_downgrades_total",
+                    help="priority requests routed to the fast tier "
+                         "because the accurate tier was over quota")
+                return pol.fast
+        return pol.accurate
 
     def _mark_dead(self, rep: _Replica) -> None:
         with self._lock:
@@ -589,6 +719,7 @@ class ReplicaRouter:
                 # just re-picks (it was never registered there)
                 self.kill_replica(rep.idx)
                 continue
+            tier = getattr(rep.engine, "tier", None)
             with self._lock:
                 if not rep.alive:  # killed between _pick and here
                     continue
@@ -597,6 +728,12 @@ class ReplicaRouter:
                 # instead of stranding it on the dead engine
                 rep.dispatched += 1
                 rr.attempts += 1
+                if tier is not None:  # the quota denominator counts
+                    # REGISTERED dispatches, not completions — quota
+                    # bounds load placed on the tier, including load
+                    # still in its queue
+                    self._tier_dispatches[tier] = (
+                        self._tier_dispatches.get(tier, 0) + 1)
             try:
                 fut = rep.engine.submit(rr.sample,
                                         deadline_ms=rr.deadline_ms)
@@ -724,7 +861,8 @@ class ReplicaRouter:
         if source is not None:
             # carry the serving engine's breadcrumbs out to the caller
             for attr in ("bucket", "parity", "parity_rtol", "parity_atol",
-                         "model_version", "rebuilt", "graph_build_ms"):
+                         "model_version", "tier", "rebuilt",
+                         "graph_build_ms"):
                 if hasattr(source, attr):
                     setattr(rr.future, attr, getattr(source, attr))
         if replica is not None:

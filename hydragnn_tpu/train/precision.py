@@ -28,13 +28,18 @@ from typing import Optional
 
 import jax.numpy as jnp
 
-# accepted spellings -> canonical dtype name. bf16 and f32 are the two
+# accepted spellings -> canonical dtype name. bf16 and f32 are the
 # dtypes the policy layer supports end to end (f32 accumulation, serving
-# tolerance bound); other valid jnp dtype strings in Architecture.dtype
-# pass through unchanged for forward compatibility.
+# tolerance bound); int8 is the SERVING-ONLY post-training-quantization
+# mode (docs/kernels_mixed_precision.md "int8") — the serving engine
+# handles it via quant/ptq.py and the train-side step factories reject
+# it with an actionable error (train_step._resolve_compute_dtype). Other
+# valid jnp dtype strings in Architecture.dtype pass through unchanged
+# for forward compatibility.
 PRECISION_CHOICES = {
     "float32": "float32", "f32": "float32", "fp32": "float32",
     "bfloat16": "bfloat16", "bf16": "bfloat16",
+    "int8": "int8", "i8": "int8",
 }
 
 
@@ -65,6 +70,18 @@ def canonical_or_f32(name, what: str = "Architecture.dtype") -> str:
         logging.getLogger("hydragnn_tpu").warning(
             "%s %r is not a recognized precision; using float32",
             what, name)
+        return "float32"
+    if canon == "int8":
+        # int8 is post-training quantization, a serving-side mode: a
+        # TRAIN-side config asking for it would cast the float params to
+        # int8 and destroy them. Warn-and-f32 here (the config-side
+        # fallback); the serve-side override path accepts int8.
+        import logging
+        logging.getLogger("hydragnn_tpu").warning(
+            "%s 'int8' is serving-only (post-training quantization, "
+            "docs/kernels_mixed_precision.md) — the train-side policy "
+            "uses float32; serve with Serving.precision='int8' / "
+            "HYDRAGNN_SERVE_PRECISION=int8 instead", what)
         return "float32"
     return canon
 

@@ -84,8 +84,16 @@ def _resolve_compute_dtype(cfg: ModelConfig, compute_dtype):
     parsing), then Architecture.dtype, then float32 — resolved HERE at
     construction time, never in trace."""
     from .precision import resolve_precision
-    return jnp.dtype(resolve_precision(getattr(cfg, "dtype", None),
-                                       compute_dtype))
+    name = resolve_precision(getattr(cfg, "dtype", None), compute_dtype)
+    if name == "int8":
+        raise ValueError(
+            "int8 is a serving-only precision (post-training "
+            "quantization, docs/kernels_mixed_precision.md): casting "
+            "float params/activations to int8 in a train/eval step "
+            "would destroy them. Train in float32/bfloat16 and serve "
+            "int8 via Serving.precision='int8' / "
+            "HYDRAGNN_SERVE_PRECISION=int8 (serving/engine.py)")
+    return jnp.dtype(name)
 
 
 def make_loss_fn(model, cfg: ModelConfig, loss_name: str = "mse",

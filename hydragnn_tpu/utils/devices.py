@@ -172,14 +172,25 @@ class CompileStore:
         self.errors = 0  # guarded-by: _lock
 
     @staticmethod
-    def fingerprint(*parts) -> str:
+    def fingerprint(*parts, precision=None) -> str:
         """Stable key from repr()s of the parts + jax version + backend
         platform (an artifact compiled for another runtime must never be
-        a hit)."""
+        a hit).
+
+        `precision` is the LABELED precision-mode field: the engine
+        passes its (compute_dtype, quantization-scale digest) pair here
+        so an int8 and an fp32 executable for the same (mcfg, bucket,
+        schema) can never collide on a warm restart — and two int8
+        programs baked from different calibration scales cannot either
+        (the scales are trace-time constants inside the artifact). The
+        field is folded for every key, including the default None, so
+        precision-less and precision-labeled keys share one keyspace
+        with no ambiguity."""
         import jax
         h = hashlib.sha256()
         h.update(f"jax={jax.__version__}".encode())
         h.update(f";backend={jax.devices()[0].platform}".encode())
+        h.update(f";precision={precision!r}".encode())
         for p in parts:
             h.update(b";")
             h.update(repr(p).encode())

@@ -45,7 +45,12 @@ SCOPE_DIRS = ("hydragnn_tpu/graphs/", "hydragnn_tpu/preprocess/",
               # the elastic job supervisor makes the same promise for
               # rank launches, generation ledgers, and the shared
               # checkpoint-dir progress probe
-              "hydragnn_tpu/elastic/")
+              "hydragnn_tpu/elastic/",
+              # int8 calibration promises bitwise-identical scales for
+              # the same calibration set (the compile-store identity):
+              # layer-key iteration and amax accumulation must never
+              # follow set or dict-insertion order
+              "hydragnn_tpu/quant/")
 
 _FS_OS = ("listdir", "scandir")
 _FS_GLOB = ("glob", "iglob")

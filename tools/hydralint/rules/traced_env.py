@@ -68,6 +68,12 @@ TRACED_DIRS = (
     # supervisor). process.py is excluded below: child-rank env
     # construction.
     os.path.join("hydragnn_tpu", "elastic"),
+    # the int8 PTQ layer builds TRACED programs (quant/ptq.py's
+    # interceptor runs under the engine's jit) and trace-time constants
+    # (activation scales): every knob — calibration-set size, serve
+    # precision — resolves through serving/config.py at construction,
+    # never via env reads that would silently fork compiled programs
+    os.path.join("hydragnn_tpu", "quant"),
 )
 
 # host-side files inside an otherwise-traced directory; every entry must
