@@ -172,6 +172,41 @@ Env knobs:
                        per-job bound (default 1800)
   BENCH_ELASTIC_OUT    also write the JSON to this path (the nightly
                        elastic-chaos job emits BENCH_ELASTIC.json)
+  BENCH_SAMPLE         =1: giant-graph sampled training
+                       (docs/sampling.md) — three phases on the
+                       synthetic ogbn-arxiv-style graph: the exact
+                       fixed-shape fanout pipeline (graphs/s,
+                       input_bound_frac, sampler_overlap_frac, a ONE
+                       jit-compile contract for the whole multi-epoch
+                       run, and a bitwise oracle: a naive independent
+                       batch construction through the SAME jitted
+                       forward); staleness arms K in BENCH_SAMPLE_KS
+                       whose exact-eval accuracy must land within
+                       BENCH_SAMPLE_ACC_BAND of K=0 while the
+                       cross-partition fetch bytes/batch drop; and an
+                       elastic leg running examples.ogbn.train_ogbn
+                       under the JobSupervisor with an injected
+                       rank-kill — resumed history + final params must
+                       equal an uninterrupted twin bitwise, plan
+                       fingerprints agree across generations, zero
+                       orphaned process groups
+  BENCH_SAMPLE_NODES / BENCH_SAMPLE_BATCH / BENCH_SAMPLE_EPOCHS
+                       synthetic graph size, seed batch size, epochs
+                       per arm (default 1200 / 64 / 3)
+  BENCH_SAMPLE_FANOUTS per-hop fanout table (default "8,4")
+  BENCH_SAMPLE_PARTITIONS
+                       feature-store partitions (default 4)
+  BENCH_SAMPLE_KS      staleness arms (default "0,8,32"; 0 is always
+                       run first as the exact baseline)
+  BENCH_SAMPLE_ACC_BAND
+                       max allowed final-accuracy drop vs K=0
+                       (default 0.05)
+  BENCH_SAMPLE_ELASTIC_EPOCHS
+                       elastic-leg epochs (default 3)
+  BENCH_SAMPLE_DEADLINE_S
+                       per-job bound on the elastic leg (default 900)
+  BENCH_SAMPLE_OUT     also write the JSON to this path (the nightly
+                       sample-bench job emits BENCH_SAMPLE.json)
   BENCH_PREPROC        =1: preprocessing mode (docs/preprocessing.md) —
                        vectorized neighbor-construction throughput
                        (atoms/s, edges/s, speedup vs the embedded seed
@@ -2167,6 +2202,431 @@ def run_bench_elastic(backend=None):
     return out
 
 
+def _oracle_sampled_batch(graph, loader, epoch, gb):
+    """Independent naive reconstruction of global batch `gb` — the
+    BENCH_SAMPLE bitwise oracle.
+
+    Re-derives the sampled subgraph and the padded batch layout from the
+    raw edge lists with dict-of-lists adjacency and plain Python loops —
+    none of CSRGraph / sample_khop_subgraph / build_sampled_batch is
+    called. Only the PLAN primitives (seed_plan / _batch_rng) are shared:
+    they define WHICH batch this is; everything about HOW it is built is
+    re-implemented. jit vs eager is not bitwise-guaranteed, so the
+    adjudication feeds both constructions through the SAME jitted
+    forward — identical inputs through one compiled program is the
+    bitwise claim the pipeline makes."""
+    import numpy as np
+
+    from hydragnn_tpu.graphs.batch import GraphBatch
+    from hydragnn_tpu.preprocess.sampling import _batch_rng
+
+    # in-neighbor lists in stable edge order (the CSR layout contract:
+    # stable sort by receiver preserves original edge order per node)
+    nbrs = {}
+    for s, r in zip(graph.senders.tolist(), graph.receivers.tolist()):
+        nbrs.setdefault(r, []).append(s)
+
+    order = loader.epoch_order(epoch)
+    B = loader.batch_size
+    seeds = [int(n) for n in order[gb * B:(gb + 1) * B]]
+    rng = _batch_rng(loader.seed, epoch, gb)
+
+    frontiers, picks = [seeds], []
+    for f in loader.fanouts:
+        cur = frontiers[-1]
+        rows = []
+        for n in cur:
+            lst = nbrs.get(n, [])
+            if len(lst) <= f:
+                take = list(lst)
+            else:
+                take = [lst[i] for i in rng.choice(len(lst), f,
+                                                   replace=False)]
+            rows.append(take)
+        picks.append(rows)
+        frontiers.append([v for row in rows
+                          for v in row + [0] * (f - len(row))])
+    node_ids = [v for fr in frontiers for v in fr]
+    n_total = len(node_ids)
+    N = n_total + 1
+    offsets = [0]
+    for fr in frontiers:
+        offsets.append(offsets[-1] + len(fr))
+
+    senders, receivers, emask = [], [], []
+    for h, rows in enumerate(picks):
+        f = loader.fanouts[h]
+        for i, row in enumerate(rows):
+            for k in range(f):
+                if k < len(row):
+                    senders.append(offsets[h + 1] + i * f + k)
+                    receivers.append(offsets[h] + i)
+                    emask.append(True)
+                else:
+                    senders.append(N - 1)
+                    receivers.append(N - 1)
+                    emask.append(False)
+    senders.append(N - 1)
+    receivers.append(N - 1)
+    emask.append(False)
+
+    x = np.zeros((N, graph.x.shape[1]), np.float32)
+    x[:n_total] = graph.x[node_ids]
+    C = graph.num_classes
+    y_node = np.zeros((N, C), np.float32)
+    y_node[:B] = np.eye(C, dtype=np.float32)[graph.label[seeds]]
+    node_mask = np.ones(N, bool)
+    node_mask[N - 1] = False
+    seed_mask = np.zeros(N, bool)
+    seed_mask[:B] = True
+    node_graph = np.zeros(N, np.int32)
+    node_graph[N - 1] = 1
+    return GraphBatch(
+        x=x, pos=np.zeros((N, 3), np.float32),
+        senders=np.asarray(senders, np.int32),
+        receivers=np.asarray(receivers, np.int32),
+        node_graph=node_graph, node_mask=node_mask,
+        edge_mask=np.asarray(emask), graph_mask=np.asarray([True, False]),
+        y_node=y_node, seed_mask=seed_mask,
+        node_global=np.asarray(node_ids + [graph.num_nodes], np.int32))
+
+
+def run_bench_sample(backend=None):
+    """BENCH_SAMPLE: giant-graph sampled training (docs/sampling.md).
+
+    Three phases over the synthetic ogbn-arxiv-style graph
+    (examples/ogbn/ogbn_data.py — the example's own generator, so the
+    bench adjudicates exactly what ``examples.ogbn.train_ogbn`` runs):
+
+      * EXACT (K=0): the fixed-shape fanout pipeline through the real
+        SAGE stack — graphs/s (seed nodes trained per second),
+        `input_bound_frac` (host blocked on sampling vs step dispatch),
+        `sampler_overlap_frac` (batches already waiting in the
+        background queue), and the ONE-COMPILE contract: the jitted
+        train step's cache must hold exactly 1 entry after the whole
+        multi-epoch run (`jit_recompiles_total`). A bitwise oracle
+        rebuilds one batch naively (dict adjacency + Python loops,
+        sharing only the plan RNG) and both constructions go through
+        the SAME jitted forward: outputs must be bitwise equal.
+      * STALENESS: K in BENCH_SAMPLE_KS arms train from identical
+        params; every arm's final exact-eval accuracy must land within
+        BENCH_SAMPLE_ACC_BAND of the K=0 arm while `remote_bytes_per_
+        batch` (cross-partition feature fetch volume) drops — the
+        historical-embedding cache trades bounded staleness for fetch
+        traffic.
+      * ELASTIC: the example runs as a supervised job (JobSupervisor +
+        real child processes), an injected rank-kill lands at its first
+        committed checkpoint, and the resumed run must match an
+        uninterrupted twin BITWISE (history AND final-params sha256);
+        plan fingerprints agree across every generation; zero orphaned
+        process groups."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from examples.ogbn.ogbn_data import synthetic_arxiv
+    from hydragnn_tpu.config.config import HeadConfig, ModelConfig
+    from hydragnn_tpu.models import create_model, init_params
+    from hydragnn_tpu.preprocess.sampling import (NeighborSamplingLoader,
+                                                  init_hist_tables)
+    from hydragnn_tpu.train.train_step import (TrainState,
+                                               make_sampled_eval_step,
+                                               make_sampled_train_step)
+    from hydragnn_tpu.utils.envflags import (env_str, env_strict_float,
+                                             env_strict_int,
+                                             resolve_elastic)
+    from hydragnn_tpu.utils.profiling import HostStallMonitor
+
+    if backend is None:
+        backend = _resolve_backend_and_cache()
+    num_nodes = env_strict_int("BENCH_SAMPLE_NODES", 1200)
+    batch_size = env_strict_int("BENCH_SAMPLE_BATCH", 64)
+    num_epochs = env_strict_int("BENCH_SAMPLE_EPOCHS", 3)
+    partitions = env_strict_int("BENCH_SAMPLE_PARTITIONS", 4)
+    hidden = env_strict_int("BENCH_SAMPLE_HIDDEN", 32)
+    acc_band = env_strict_float("BENCH_SAMPLE_ACC_BAND", 0.05)
+    deadline_s = env_strict_float("BENCH_SAMPLE_DEADLINE_S", 900.0)
+    fanouts = tuple(int(v) for v in
+                    env_str("BENCH_SAMPLE_FANOUTS", "8,4").split(","))
+    ks = tuple(int(v) for v in
+               env_str("BENCH_SAMPLE_KS", "0,8,32").split(","))
+    if ks[0] != 0:
+        ks = (0,) + tuple(k for k in ks if k != 0)
+
+    graph = synthetic_arxiv(num_nodes=num_nodes, seed=0)
+    F, C, L = graph.x.shape[1], graph.num_classes, len(fanouts)
+    cfg = ModelConfig(
+        model_type="SAGE", input_dim=F, hidden_dim=hidden,
+        num_conv_layers=L,
+        heads=(HeadConfig(head_type="node", output_dim=C, offset=0,
+                          dim_headlayers=(hidden, hidden),
+                          node_arch="mlp"),),
+        output_dim=(C,), output_type=("node",), task_weights=(1.0,))
+    model = create_model(cfg)
+    tx = optax.adam(3e-3)
+    y = graph.y_onehot
+    common = dict(senders=graph.senders, receivers=graph.receivers,
+                  batch_size=batch_size, fanouts=fanouts, seed=0,
+                  num_partitions=partitions, num_layers=L)
+    val_nodes = graph.val_idx[:max(len(graph.val_idx) // batch_size, 1)
+                              * batch_size]
+    val_loader = NeighborSamplingLoader(
+        x=graph.x, y_node=y, train_nodes=val_nodes, shuffle=False,
+        staleness_k=0, async_workers=0, **common)
+    eval_step = make_sampled_eval_step(model, cfg, loss_name="ce")
+
+    def _run_arm(k):
+        """Train num_epochs at staleness K from identical init params;
+        returns per-arm metrics + the final state (the K=0 arm's feeds
+        the oracle forward)."""
+        loader = NeighborSamplingLoader(
+            x=graph.x, y_node=y, train_nodes=graph.train_idx,
+            staleness_k=k, async_workers=2, **common)
+        loader.set_epoch(0)
+        first = next(iter(loader))
+        init_b = first
+        if k > 0:
+            init_b = first.replace(hist_states=jnp.zeros(
+                (max(L - 1, 0), first.x.shape[0], hidden)))
+        variables = init_params(model, init_b, seed=0)
+        # TrainState.create pins step to a strong int32 — a Python-int
+        # step would weak-type the first trace and recompile on call 2
+        state = TrainState.create(variables, tx)
+        step = make_sampled_train_step(model, cfg, tx, loss_name="ce",
+                                       staleness_k=k)
+        tables = (init_hist_tables(graph.x, hidden, L) if k > 0
+                  else None)
+        mon = HostStallMonitor()
+        spe = len(loader)
+        t0 = time.perf_counter()
+        for epoch in range(num_epochs):
+            loader.set_epoch(epoch)
+            stream = mon.wrap(iter(loader))
+            for i, b in enumerate(stream):
+                with mon.step_timer():
+                    if k > 0:
+                        gstep = epoch * spe + i
+                        do_ref = jnp.asarray(gstep % k == 0)
+                        state, tables, m = step(state, b, tables, do_ref)
+                    else:
+                        state, m = step(state, b)
+                    jax.block_until_ready(m["loss"])
+        train_s = time.perf_counter() - t0
+        corr = cnt = 0.0
+        for b in val_loader:
+            m, _ = eval_step(state, b)
+            corr += float(m["correct"])
+            cnt += float(m["count"])
+        fetch = loader.fetch_stats()
+        return {
+            "staleness_k": k,
+            "val_acc": corr / max(cnt, 1.0),
+            "graphs_per_s": num_epochs * spe * batch_size
+            / max(train_s, 1e-9),
+            "input_bound_frac": round(mon.input_bound_frac(), 4),
+            "sampler_overlap_frac": round(
+                fetch["sampler_overlap_frac"], 4),
+            "remote_bytes_per_batch": fetch["remote_bytes_per_batch"],
+            "local_bytes_per_batch": fetch["local_bytes_per_batch"],
+            "jit_recompiles_total": _jit_cache(step),
+        }, state, loader
+
+    t_all = time.perf_counter()
+    arms, states = [], {}
+    for k in ks:
+        arm, st, loader0 = _run_arm(k)
+        arms.append(arm)
+        states[k] = st
+        if k == 0:
+            exact_loader = loader0
+
+    # ---- bitwise oracle: independent construction, same jitted forward
+    exact_loader.set_epoch(0)
+    gb = exact_loader.rank_batches()[0]
+    lib_b = exact_loader._build_batch(exact_loader.epoch_order(0), gb)
+    ora_b = _oracle_sampled_batch(graph, exact_loader, 0, gb)
+    fields = ("x", "senders", "receivers", "edge_mask", "node_mask",
+              "seed_mask", "node_graph", "graph_mask", "y_node",
+              "node_global")
+    arrays_equal = all(
+        np.array_equal(np.asarray(getattr(lib_b, f)),
+                       np.asarray(getattr(ora_b, f))) for f in fields)
+    _, out_lib = eval_step(states[0], lib_b)
+    _, out_ora = eval_step(states[0], ora_b)
+    oracle_bitwise = bool(arrays_equal) and all(
+        bool(jnp.array_equal(a, b))
+        for a, b in zip(out_lib, out_ora))
+
+    # ---- staleness adjudication: accuracy within band, fetch smaller
+    acc0 = arms[0]["val_acc"]
+    rb0 = arms[0]["remote_bytes_per_batch"]
+    acc_within_band = all(a["val_acc"] >= acc0 - acc_band for a in arms)
+    fetch_reduced = all(a["remote_bytes_per_batch"] < rb0
+                        for a in arms if a["staleness_k"] > 0)
+    one_compile = arms[0]["jit_recompiles_total"] == 1
+
+    # ---- elastic leg: the example as a supervised job, kill vs twin --
+    from hydragnn_tpu.elastic import (COMPLETED, JobLedger, JobSupervisor)
+    from hydragnn_tpu.elastic.process import (RankProcessHandle,
+                                              _child_env, free_port)
+    from hydragnn_tpu.utils.faults import (install_fault_plan,
+                                           parse_fault_plan)
+
+    elastic_epochs = env_strict_int("BENCH_SAMPLE_ELASTIC_EPOCHS", 3)
+    max_restarts, heartbeat_s, backoff_s = resolve_elastic(
+        {"max_restarts": 3, "heartbeat_s": 60.0, "backoff_s": 0.2})
+
+    class SampledJobLauncher:
+        """launch_fn for JobSupervisor: examples.ogbn.train_ogbn as the
+        child rank — the elastic leg runs the REAL example (K=0: exact
+        mode keeps no hist tables, so resume needs only the train
+        state and must be bitwise)."""
+
+        def __init__(self, job_dir):
+            self.job_dir = os.path.abspath(job_dir)
+            self.handles = []
+
+        def __call__(self, generation, world_size, rank, resume, hang):
+            os.makedirs(self.job_dir, exist_ok=True)
+            cmd = [sys.executable, "-m", "examples.ogbn.train_ogbn",
+                   "--rank", str(int(rank)),
+                   "--world", str(int(world_size)),
+                   "--num-epochs", str(elastic_epochs),
+                   "--num-nodes", str(num_nodes),
+                   "--batch-size", str(batch_size),
+                   "--staleness-k", "0",
+                   "--job-dir", self.job_dir]
+            if resume:
+                cmd.append("--resume")
+            log_path = os.path.join(self.job_dir,
+                                    f"rank_{int(rank)}.log")
+            with open(log_path, "ab") as out:
+                proc = subprocess.Popen(
+                    cmd, cwd=self.job_dir, stdout=out,
+                    stderr=subprocess.STDOUT,
+                    env=_child_env(rank, world_size, 1, free_port(),
+                                   120.0),
+                    start_new_session=True)
+            handle = RankProcessHandle(proc, self.job_dir, log_path)
+            self.handles.append(handle)
+            return handle
+
+        def live_process_groups(self):
+            return [h.proc.pid for h in self.handles if h.group_alive()]
+
+    def _plan_fps(job_dir):
+        fps = []
+        for name in sorted(os.listdir(job_dir)):
+            if not name.startswith("rank_"):
+                continue
+            try:
+                with open(os.path.join(job_dir, name)) as f:
+                    for line in f:
+                        if "plan_fp=" in line:
+                            fps.append(
+                                line.split("plan_fp=")[1].split()[0])
+            except OSError:
+                continue
+        return fps
+
+    def _run_job(job_dir, plan_spec, schedule):
+        launcher = SampledJobLauncher(job_dir)
+        install_fault_plan(parse_fault_plan(plan_spec)
+                           if plan_spec else None)
+        ledger = JobLedger()
+        sup = JobSupervisor(
+            launcher, world_size=schedule[0], world_schedule=schedule,
+            max_restarts=max_restarts, heartbeat_s=heartbeat_s,
+            backoff_s=backoff_s, poll_interval_s=0.2, ledger=ledger)
+        rec = sup.run(deadline_s=deadline_s)
+        install_fault_plan(None)
+        return rec, ledger, launcher.live_process_groups()
+
+    dirs = {name: tempfile.mkdtemp(prefix=f"bench_sample_{name}_")
+            for name in ("kill", "twin")}
+    try:
+        kill_rec, kill_led, kill_orphans = _run_job(
+            dirs["kill"], "rank-kill@0", [1, 1])
+        twin_rec, _, twin_orphans = _run_job(dirs["twin"], "", [1])
+        results = {}
+        for name, d in dirs.items():
+            try:
+                with open(os.path.join(d, "result.json")) as f:
+                    results[name] = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                results[name] = None
+        fps = {name: _plan_fps(d) for name, d in dirs.items()}
+    finally:
+        install_fault_plan(None)
+        for d in dirs.values():
+            shutil.rmtree(d, ignore_errors=True)
+    elapsed = time.perf_counter() - t_all
+
+    r_kill, r_twin = results["kill"], results["twin"]
+    kill_landed = len([e for e in kill_led.data_view()
+                       if e["event"] == "killed"])
+    elastic_bitwise = (
+        r_kill is not None and r_twin is not None
+        and r_kill["history"] == r_twin["history"]
+        and r_kill["param_digest"] == r_twin["param_digest"])
+    all_fps = sorted({fp for f in fps.values() for fp in f})
+    plan_fp_consistent = (len(all_fps) == 1
+                          and all(len(f) >= 1 for f in fps.values()))
+    orphans = kill_orphans + twin_orphans
+
+    passed = (bool(one_compile) and bool(oracle_bitwise)
+              and bool(acc_within_band) and bool(fetch_reduced)
+              and kill_rec.state == COMPLETED and kill_rec.restarts >= 1
+              and kill_landed >= 1 and twin_rec.state == COMPLETED
+              and bool(elastic_bitwise) and plan_fp_consistent
+              and not orphans)
+    out = {
+        "metric": "sampled_training",
+        "value": 1.0 if passed else 0.0,
+        "unit": "pass",
+        "vs_baseline": None,
+        "backend": backend,
+        "num_nodes": num_nodes,
+        "batch_size": batch_size,
+        "fanouts": list(fanouts),
+        "partitions": partitions,
+        "epochs": num_epochs,
+        "graphs_per_s": round(arms[0]["graphs_per_s"], 1),
+        "input_bound_frac": arms[0]["input_bound_frac"],
+        "sampler_overlap_frac": arms[0]["sampler_overlap_frac"],
+        "jit_recompiles_total": arms[0]["jit_recompiles_total"],
+        "one_compile": bool(one_compile),
+        "oracle_arrays_equal": bool(arrays_equal),
+        "oracle_forward_bitwise": bool(oracle_bitwise),
+        "staleness_arms": [
+            {k: (round(v, 4) if isinstance(v, float) else v)
+             for k, v in a.items()} for a in arms],
+        "acc_band": acc_band,
+        "acc_within_band": bool(acc_within_band),
+        "remote_fetch_reduced": bool(fetch_reduced),
+        "elastic_job": {
+            "kill_state": kill_rec.state,
+            "kill_restarts": kill_rec.restarts,
+            "injected_kills_landed": kill_landed,
+            "twin_state": twin_rec.state,
+            "trajectory_bitwise_equal": bool(elastic_bitwise),
+            "plan_fp_consistent": plan_fp_consistent,
+            "plan_fps": fps,
+            "zero_orphans": not orphans,
+        },
+        "elapsed_s": round(elapsed, 2),
+    }
+    out_path = os.environ.get("BENCH_SAMPLE_OUT", "").strip()
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1)
+    return out
+
+
 # ---- seed neighbor-construction implementations (pre-fast-path), kept
 # here verbatim as the BENCH_PREPROC baseline so the reported speedup is
 # measured against the exact code this PR replaced, not a strawman ----
@@ -3054,6 +3514,8 @@ def main():
         out = run_bench_hpo()
     elif os.environ.get("BENCH_ELASTIC") == "1":
         out = run_bench_elastic()
+    elif os.environ.get("BENCH_SAMPLE") == "1":
+        out = run_bench_sample()
     elif os.environ.get("BENCH_MD") == "1":
         _pin_cpu_host_threads()
         out = run_bench_md()

@@ -313,15 +313,29 @@ def iterate_async(loader, selections: Sequence[Tuple[int, ...]],
 _SENTINEL = object()
 
 
-def background_iterate(iterable, depth: int = 2) -> Iterator:
+def background_iterate(iterable, depth: int = 2,
+                       stats: Optional[Dict[str, float]] = None) -> Iterator:
     """Pipeline an arbitrary iterator through one producer thread and a
     bounded queue: the producer builds item k+1..k+depth while the consumer
     holds item k. Order is trivially preserved (single producer); producer
     exceptions are re-raised on the consumer; abandoning the generator
     stops the producer promptly (the bounded queue is drained, then the
-    stop flag is seen)."""
+    stop flag is seen).
+
+    `stats` (optional dict, mutated in place) accumulates the overlap
+    accounting the sampled-training bench reports (docs/sampling.md):
+    ``items`` consumed, ``ready_items`` that were already waiting in the
+    queue when the consumer asked (the producer was ahead — full
+    overlap), and ``consumer_wait_s`` blocked on the queue. The overlap
+    fraction ``ready_items / items`` is 1.0 when sampling fully hides
+    behind the step and 0.0 when every batch is built while the device
+    waits."""
     q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
     stop = threading.Event()
+    if stats is not None:
+        stats.setdefault("items", 0)
+        stats.setdefault("ready_items", 0)
+        stats.setdefault("consumer_wait_s", 0.0)
 
     def put_until_stopped(entry):
         # block until the consumer takes it or abandons the stream — a
@@ -350,7 +364,17 @@ def background_iterate(iterable, depth: int = 2) -> Iterator:
     t.start()
     try:
         while True:
-            item, exc = q.get()
+            if stats is None:
+                item, exc = q.get()
+            else:
+                import time as _time
+                ready = not q.empty()
+                t0 = _time.perf_counter()
+                item, exc = q.get()
+                stats["consumer_wait_s"] += _time.perf_counter() - t0
+                if item is not _SENTINEL:
+                    stats["items"] += 1
+                    stats["ready_items"] += int(ready)
             if item is _SENTINEL:
                 if exc is not None:
                     raise exc

@@ -18,6 +18,13 @@ and the custom thread-pool `HydraDataLoader` :93-203). TPU-first differences:
   so the consumer thread — and therefore the accelerator — does not stall
   on Python array packing; the async stream is bitwise-identical to the
   synchronous one (HYDRAGNN_ASYNC_LOADER=0 restores the synchronous path).
+
+This loader batches whole (small) graphs. Node-level tasks on ONE giant
+graph that cannot fit a chip use the sampled pipeline instead
+(preprocess/sampling.NeighborSamplingLoader, docs/sampling.md) — same
+``set_epoch`` / iteration / background-worker contract, but minibatches
+are fixed-shape k-hop subgraphs around seed nodes; ``prefetch_to_device``
+below composes with it unchanged.
 """
 from __future__ import annotations
 
@@ -124,6 +131,10 @@ class GraphDataLoader:
                             else None)
 
     def set_epoch(self, epoch: int):
+        """Reseed the epoch's shuffle — the shared loader contract
+        (NeighborSamplingLoader.set_epoch honors the same one): the
+        epoch's order is a pure function of (seed, epoch), identical on
+        every process, so elastic resume replays it exactly."""
         self.epoch = epoch
 
     def __len__(self):

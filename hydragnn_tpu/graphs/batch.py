@@ -62,6 +62,18 @@ class GraphBatch:
     nbr: Optional[jnp.ndarray] = None        # [N, K] int32 sender of slot k
     nbr_edge: Optional[jnp.ndarray] = None   # [N, K] int32 edge id of slot k
     nbr_mask: Optional[jnp.ndarray] = None   # [N, K] bool
+    # sampled giant-graph training (preprocess/sampling.py,
+    # docs/sampling.md): node slots are one k-hop computation graph laid
+    # out [seeds | hop1 | ... | padding]; the loss is taken over seeds
+    # only, and slots served from the historical-embedding cache carry
+    # stale per-layer states instead of expanding further
+    seed_mask: Optional[jnp.ndarray] = None     # [N] bool, loss mask
+    node_global: Optional[jnp.ndarray] = None   # [N] int32 global node id
+    hist_mask: Optional[jnp.ndarray] = None     # [N] bool, hist-served slot
+    refresh_upto: Optional[jnp.ndarray] = None  # [N] int32, deepest hist
+    # layer this slot may refresh (-1 = none; loader-deduplicated so at
+    # most one slot per global id qualifies — scatter stays deterministic)
+    hist_states: Optional[jnp.ndarray] = None   # [L-1, N, H] stale states
 
     @property
     def num_nodes(self) -> int:

@@ -105,6 +105,14 @@ class BaseStack(nn.Module):
         x = self.initial_node_features(batch, cargs)
         pos = batch.pos
         in_dim = x.shape[-1]
+        # sampled giant-graph batches (docs/sampling.md): slots served
+        # from the historical-embedding cache are stale constants, not
+        # fresh computations — they override each layer's output and are
+        # excluded from the batch-norm statistics (their stale scale
+        # would skew the running moments the fresh nodes train under)
+        stats_mask = batch.node_mask
+        if batch.hist_states is not None and batch.hist_mask is not None:
+            stats_mask = stats_mask & ~batch.hist_mask
         for i in range(cfg.num_conv_layers):
             conv = self.make_conv(in_dim, cfg.hidden_dim, i,
                                   final=(i == cfg.num_conv_layers - 1))
@@ -114,8 +122,16 @@ class BaseStack(nn.Module):
                 x, pos = conv(x, pos, batch, cargs)
             if self.use_batch_norm:
                 x = MaskedBatchNorm(name=f"feature_norm_{i}")(
-                    x, batch.node_mask, use_running_average=not train)
+                    x, stats_mask, use_running_average=not train)
             x = act(x)
+            if (batch.hist_states is not None
+                    and i < cfg.num_conv_layers - 1):
+                x = jnp.where(batch.hist_mask[:, None],
+                              batch.hist_states[i], x)
+            # fresh post-layer states for the historical-cache refresh
+            # (train_step.make_sampled_train_step applies them with
+            # "intermediates" mutable; a no-op sow otherwise)
+            self.sow("intermediates", f"encoder_h{i}", x)
             in_dim = cfg.hidden_dim
         return x, pos
 

@@ -92,4 +92,15 @@ def masked_loss(name: str, pred, target, mask, var=None):
         v = jnp.maximum(var, 1e-6)
         nll = 0.5 * (jnp.log(v) + (pred - target) ** 2 / v)
         return jnp.sum(mask_f * nll) / count
+    if name == "ce":
+        # softmax cross-entropy over the last axis against one-hot (or
+        # soft) targets — the node-classification loss of the sampled
+        # giant-graph workload (docs/sampling.md). Masked mean over
+        # ROWS: each real entry contributes one CE term, not one per
+        # class, matching torch CrossEntropyLoss's mean reduction.
+        row = -jnp.sum(target * jax.nn.log_softmax(pred, axis=-1),
+                       axis=-1)
+        rmask = mask.reshape(mask.shape + (1,) * (row.ndim - mask.ndim))
+        rows = jnp.maximum(jnp.sum(rmask * jnp.ones_like(row)), 1.0)
+        return jnp.sum(rmask * row) / rows
     raise ValueError(f"unknown loss '{name}'")

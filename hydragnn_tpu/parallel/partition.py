@@ -1,0 +1,93 @@
+"""Deterministic METIS-free node partitioning for giant-graph sampled
+training (docs/sampling.md).
+
+DistGNN (PAPERS.md) partitions the node set across ranks so each rank
+owns its partition's features and embeddings; cross-partition neighbor
+access is the comm cost the historical-embedding cache amortizes. A
+METIS-quality edge cut is NOT required for that contract to hold — what
+IS required is that every rank derives the SAME owner map from pure
+inputs, at any world size, with zero coordination (the PR 2 global-plan
+discipline). Two deterministic schemes:
+
+* ``range``  — owner(i) = i * P // N: contiguous id ranges. Graphs whose
+  id order carries locality (ogbn-arxiv's time order, sorted spatial
+  ids) get a meaningful cut for free.
+* ``hash``   — owner(i) = splitmix64(i ^ seed) % P: load-balanced and
+  id-order-independent, for adversarially ordered graphs.
+
+The owner map is a pure function of (num_nodes, num_partitions, mode,
+seed); ``partition_fingerprint`` hashes exactly those inputs, and the
+feature-store cache key folds it in so a re-partition can never serve
+stale shards (preprocess/cache.feature_store_key).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+
+import numpy as np
+
+PARTITION_MODES = ("range", "hash")
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer — platform-stable uint64 mixing
+    (the same construction the pack-plan hashing uses: no Python hash(),
+    no per-process salt)."""
+    with np.errstate(over="ignore"):
+        z = x.astype(np.uint64) + np.uint64(0x9E3779B97F4A7C15)
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return z ^ (z >> np.uint64(31))
+
+
+def partition_nodes(num_nodes: int, num_partitions: int,
+                    mode: str = "range", seed: int = 0) -> np.ndarray:
+    """[num_nodes] int32 owner rank per node — pure, coordination-free.
+
+    Every rank calls this with identical arguments and gets an identical
+    map; changing the world size only changes how partitions map to
+    ranks, never which nodes share a partition (partitions == world by
+    default in the sampling loader)."""
+    num_nodes = int(num_nodes)
+    num_partitions = int(num_partitions)
+    if num_nodes < 0:
+        raise ValueError(f"num_nodes must be >= 0, got {num_nodes}")
+    if num_partitions < 1:
+        raise ValueError(
+            f"num_partitions must be >= 1, got {num_partitions}")
+    if mode not in PARTITION_MODES:
+        raise ValueError(f"unknown partition mode '{mode}'; "
+                         f"known: {PARTITION_MODES}")
+    ids = np.arange(num_nodes, dtype=np.int64)
+    if mode == "range":
+        owner = (ids * num_partitions) // max(num_nodes, 1)
+    else:
+        mixed = _splitmix64(ids.astype(np.uint64)
+                            ^ np.uint64(np.int64(seed) & 0x7FFFFFFFFFFFFFFF))
+        owner = (mixed % np.uint64(num_partitions)).astype(np.int64)
+    return owner.astype(np.int32)
+
+
+def partition_fingerprint(num_nodes: int, num_partitions: int,
+                          mode: str = "range", seed: int = 0) -> str:
+    """sha256 over the pure inputs of `partition_nodes` — the partition
+    map's identity for cache keys and cross-rank plan checks."""
+    blob = json.dumps({"num_nodes": int(num_nodes),
+                       "num_partitions": int(num_partitions),
+                       "mode": str(mode), "seed": int(seed),
+                       "scheme": "partition-v1"}, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:32]
+
+
+def cut_fraction(senders: np.ndarray, receivers: np.ndarray,
+                 owner: np.ndarray) -> float:
+    """Fraction of edges whose endpoints live in different partitions —
+    the boundary size the historical cache amortizes (reported by
+    BENCH_SAMPLE; 0.0 for an empty edge list)."""
+    senders = np.asarray(senders, np.int64).reshape(-1)
+    receivers = np.asarray(receivers, np.int64).reshape(-1)
+    if senders.size == 0:
+        return 0.0
+    owner = np.asarray(owner)
+    return float(np.mean(owner[senders] != owner[receivers]))

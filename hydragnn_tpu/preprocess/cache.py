@@ -273,6 +273,142 @@ def load_shard(cache_dir: str, key: str, verify: bool = True,
     return samples, _decode_meta(meta.get("extra"))
 
 
+# ------------------------------------------------------- array shard I/O --
+# the giant-graph feature store (preprocess/sampling.NodeFeatureStore,
+# docs/sampling.md) persists a dict of named arrays — node features,
+# labels, the partition owner map — in the same packed/mmap'd/atomic
+# shard discipline as the sample shards, under its own prefix so the two
+# namespaces can never collide on a key
+def _array_shard_dir(cache_dir: str, key: str) -> str:
+    return os.path.join(cache_dir, f"featstore-{key}")
+
+
+def feature_store_key(graph_fingerprint, partition_fingerprint,
+                      extra=None) -> str:
+    """Content address for one partitioned feature store: sha256 over
+    (graph identity, partition-map identity[, extra]) — re-partitioning
+    or regenerating the graph lands on a new key, so stale shards are
+    simply never addressed."""
+    blob = json.dumps({"graph": graph_fingerprint,
+                       "partition": partition_fingerprint,
+                       "extra": extra, "schema": CACHE_SCHEMA_VERSION},
+                      sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()[:32]
+
+
+def save_array_shard(cache_dir: str, key: str,
+                     arrays: Dict[str, np.ndarray],
+                     extra_meta: Optional[Dict] = None) -> str:
+    """Write named arrays as one packed shard (16-byte aligned data.bin,
+    sha256 in meta.json, atomic rename — the save_shard discipline)."""
+    os.makedirs(cache_dir, exist_ok=True)
+    tmp = tempfile.mkdtemp(prefix=f".featstore-{key}-", dir=cache_dir)
+    try:
+        index = {}
+        h = hashlib.sha256()
+        offset = 0
+        with open(os.path.join(tmp, "data.bin"), "wb") as f:
+            for name in sorted(arrays):
+                arr = np.ascontiguousarray(arrays[name])
+                pad = (-offset) % _ALIGN
+                if pad:
+                    f.write(b"\0" * pad)
+                    h.update(b"\0" * pad)
+                    offset += pad
+                buf = arr.tobytes()
+                f.write(buf)
+                h.update(buf)
+                index[name] = [str(arr.dtype), list(arr.shape), offset]
+                offset += len(buf)
+        with open(os.path.join(tmp, "index.json"), "w") as f:
+            json.dump({"arrays": index}, f)
+        meta = {
+            "schema": CACHE_SCHEMA_VERSION,
+            "key": key,
+            "num_arrays": len(index),
+            "data_size": offset,
+            "data_sha256": h.hexdigest(),
+            "extra": _encode_meta(extra_meta),
+        }
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        dst = _array_shard_dir(cache_dir, key)
+        if os.path.exists(dst):
+            trash = tempfile.mkdtemp(prefix=".featstore-trash-",
+                                     dir=cache_dir)
+            os.replace(dst, os.path.join(trash, "old"))
+            shutil.rmtree(trash, ignore_errors=True)
+        try:
+            os.replace(tmp, dst)
+        except OSError:
+            # concurrent writer won the rename — identical content by
+            # construction (content-addressed key), keep theirs
+            shutil.rmtree(tmp, ignore_errors=True)
+        return dst
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def load_array_shard(cache_dir: str, key: str, verify: bool = True
+                     ) -> Tuple[Dict[str, np.ndarray], Optional[Dict]]:
+    """Memory-map one array shard back (zero-copy, read-only views).
+    FileNotFoundError on a plain miss, `CacheInvalid` on anything
+    unservable — the load_shard contract."""
+    path = _array_shard_dir(cache_dir, key)
+    if not os.path.isdir(path):
+        raise FileNotFoundError(path)
+    try:
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        with open(os.path.join(path, "index.json")) as f:
+            index = json.load(f)["arrays"]
+    except (OSError, ValueError, KeyError) as exc:
+        raise CacheInvalid(f"{path}: unreadable shard metadata "
+                           f"({type(exc).__name__}: {exc})") from exc
+    if meta.get("schema") != CACHE_SCHEMA_VERSION:
+        raise CacheInvalid(
+            f"{path}: shard schema {meta.get('schema')} != "
+            f"{CACHE_SCHEMA_VERSION}")
+    if meta.get("key") != key:
+        raise CacheInvalid(f"{path}: shard was built for key "
+                           f"{meta.get('key')}, not {key}")
+    if len(index) != meta.get("num_arrays"):
+        raise CacheInvalid(f"{path}: index lists {len(index)} arrays, "
+                           f"meta says {meta.get('num_arrays')}")
+    data_path = os.path.join(path, "data.bin")
+    try:
+        size = os.path.getsize(data_path)
+    except OSError as exc:
+        raise CacheInvalid(f"{path}: missing data.bin") from exc
+    if size != meta.get("data_size"):
+        raise CacheInvalid(f"{path}: data.bin is {size} bytes, meta "
+                           f"says {meta.get('data_size')}")
+    mm = (np.memmap(data_path, dtype=np.uint8, mode="r") if size
+          else np.empty(0, np.uint8))
+    if verify and size:
+        digest = hashlib.sha256(mm).hexdigest()
+        if digest != meta.get("data_sha256"):
+            raise CacheInvalid(f"{path}: data.bin checksum mismatch "
+                               "(corrupted shard)")
+    arrays: Dict[str, np.ndarray] = {}
+    try:
+        for name in sorted(index):
+            dtype, shape, offset = index[name]
+            dt = np.dtype(dtype)
+            count = int(np.prod(shape, dtype=np.int64))
+            if count == 0:
+                arrays[name] = np.empty(shape, dt)
+            else:
+                arrays[name] = np.frombuffer(
+                    mm, dtype=dt, count=count,
+                    offset=int(offset)).reshape(shape)
+    except (TypeError, ValueError, KeyError) as exc:
+        raise CacheInvalid(f"{path}: malformed array index "
+                           f"({type(exc).__name__}: {exc})") from exc
+    return arrays, _decode_meta(meta.get("extra"))
+
+
 # ------------------------------------------------------------ high level --
 class PreprocessedCache:
     """Lookup/store wrapper with hit/miss/corrupt counters (surfaced in

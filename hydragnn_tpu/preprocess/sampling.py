@@ -1,49 +1,116 @@
-"""Fixed-fanout neighbor sampling — minibatch training on one large graph.
+"""Fixed-shape sampled training on one giant graph (docs/sampling.md).
 
 Technique from the retrieved scalable-GNN-training work (PAPERS.md: "The
-Case for Sampling", DistGNN); the reference has no analogue (its graphs are
-small molecules/supercells — SURVEY.md §5.7). For node-level tasks on a
-graph with millions of nodes, full-graph message passing cannot fit one
-chip; GraphSAGE-style sampling trains on k-hop subgraphs around seed nodes.
+Case for Sampling", DistGNN); the reference has no analogue (its graphs
+are small molecules/supercells — SURVEY.md §5.7). For node-level tasks
+on a graph with millions of nodes, full-graph message passing cannot fit
+one chip; GraphSAGE-style sampling trains on k-hop subgraphs around seed
+nodes.
 
-TPU-first property: the fanout is FIXED per hop, so every sampled subgraph
-has identical array shapes — one XLA compilation for the whole run, no
-bucketing needed. The sampled layout is exactly the dense neighbor-list
-format (`GraphBatch.nbr`): hop h's table is [n_h, fanout_h] with masks,
-aggregations are masked K-axis reductions, and padding slots point at a
-sentinel node.
+TPU-first property: the fanout is FIXED per hop, so every sampled
+subgraph has identical array shapes — ONE XLA compilation for the whole
+run, no bucketing needed. The sampled computation graph is materialized
+as a padded `GraphBatch` whose node slots are laid out
+``[seeds | hop1 | hop2 | ... | padding]`` with explicit edges, so it
+flows through the REAL conv stacks and multihead decoders unchanged
+(the seed's toy `sage_subgraph_forward` bypassed them entirely); the
+loss is masked to the seed slots via ``GraphBatch.seed_mask``.
+
+Three cooperating pieces:
+
+* ``NeighborSamplingLoader`` — seed-node minibatches from a global
+  permutation that is a pure function of ``(epoch, seed)``, re-sliced
+  per rank as ``batches[rank::world]`` (the PR 2 global-plan contract:
+  any world size sees the same global batch sequence, so the PR 15
+  elastic supervisor can resume/re-slice it). Per-batch sampling RNG is
+  keyed by the GLOBAL batch index, never the rank. Background sampling
+  rides the PR 1 ``background_iterate`` machinery.
+* ``NodeFeatureStore`` — features/labels in the PR 5 content-addressed
+  cache's mmap'd shard format, gathered per minibatch by global node id
+  with local/remote byte accounting against a deterministic partition
+  map (parallel/partition.py).
+* ``HistTables`` — the DistGNN historical-embedding cache: device-
+  resident per-layer stale embeddings + version stamps. With staleness
+  K > 0, cross-partition in-neighbors beyond hop 0 are NOT expanded:
+  their layer states read the stale table (train_step.py overrides them
+  inside the encoder) and their features come from the resident table —
+  zero per-step cross-partition fetches; each rank refreshes the rows
+  it owns from its own fresh computations every K steps. K = 0 disables
+  the cache entirely and degrades to exact full expansion.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+import dataclasses
+import hashlib
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax.numpy as jnp
 import numpy as np
+from flax import struct
 
 from ..graphs.batch import GraphBatch
+from ..parallel.partition import (partition_fingerprint, partition_nodes,
+                                  _splitmix64)
 
 
 class CSRGraph:
     """In-neighbor CSR adjacency for sampling: for node i,
-    senders[indptr[i]:indptr[i+1]] are its in-edge sources."""
+    senders[indptr[i]:indptr[i+1]] are its in-edge sources.
+
+    Validates the edge list up front: an out-of-range receiver would
+    silently corrupt ``indptr`` (``bincount(minlength=num_nodes)`` keeps
+    counting past num_nodes, so every later node's slice shifts), and an
+    empty edge list must build an all-zero indptr, not crash."""
 
     def __init__(self, senders: np.ndarray, receivers: np.ndarray,
                  num_nodes: int):
+        senders = np.asarray(senders, np.int64).reshape(-1)
+        receivers = np.asarray(receivers, np.int64).reshape(-1)
+        num_nodes = int(num_nodes)
+        if num_nodes < 0:
+            raise ValueError(f"num_nodes must be >= 0, got {num_nodes}")
+        if senders.shape != receivers.shape:
+            raise ValueError(
+                f"senders ({senders.shape}) and receivers "
+                f"({receivers.shape}) must have the same length")
+        for name, arr in (("senders", senders), ("receivers", receivers)):
+            if arr.size == 0:
+                continue
+            lo, hi = int(arr.min()), int(arr.max())
+            if lo < 0 or hi >= num_nodes:
+                bad = lo if lo < 0 else hi
+                raise ValueError(
+                    f"CSRGraph: {name} contains node id {bad} outside "
+                    f"[0, {num_nodes}); an out-of-range receiver would "
+                    "silently corrupt indptr (bincount truncation) and "
+                    "missample every later node — fix the edge list or "
+                    "raise num_nodes")
         order = np.argsort(receivers, kind="stable")
-        self.senders = np.asarray(senders)[order].astype(np.int32)
+        self.senders = senders[order].astype(np.int32)
         self.indptr = np.zeros(num_nodes + 1, np.int64)
         counts = np.bincount(receivers, minlength=num_nodes)
         np.cumsum(counts, out=self.indptr[1:])
         self.num_nodes = num_nodes
 
+    @property
+    def num_edges(self) -> int:
+        return int(self.senders.size)
+
     def sample_in_neighbors(self, nodes: np.ndarray, fanout: int,
-                            rng: np.random.RandomState):
+                            rng: np.random.RandomState,
+                            skip: Optional[np.ndarray] = None):
         """[B] nodes -> ([B, fanout] sampled senders, [B, fanout] mask).
         Nodes with degree <= fanout take all neighbors (no replacement);
-        higher-degree nodes are subsampled uniformly."""
+        higher-degree nodes are subsampled uniformly. Rows where `skip`
+        is True (historical-cache-served frontier nodes) are left empty
+        — their receptive field is the stale table, not an expansion."""
         B = len(nodes)
         nbr = np.zeros((B, fanout), np.int32)
         mask = np.zeros((B, fanout), bool)
         for b, n in enumerate(nodes):
+            if skip is not None and skip[b]:
+                continue
             lo, hi = self.indptr[n], self.indptr[n + 1]
             deg = int(hi - lo)
             if deg == 0:
@@ -58,115 +125,475 @@ class CSRGraph:
         return nbr, mask
 
 
+# ------------------------------------------------------------- seed plan --
+def seed_plan(num_seeds: int, epoch: int, seed: int) -> np.ndarray:
+    """Global seed-node permutation — a pure function of (epoch, seed),
+    identical on every rank at every world size (the PR 2 pack-plan
+    contract). Ranks slice BATCHES of this one order, never re-draw."""
+    mixed = _splitmix64(np.uint64((np.int64(seed) << np.int64(20))
+                                  ^ np.int64(epoch)))
+    rng = np.random.RandomState(int(mixed) % (2 ** 31 - 1))
+    return rng.permutation(int(num_seeds)).astype(np.int64)
+
+
+def _batch_rng(seed: int, epoch: int, global_batch: int
+               ) -> np.random.RandomState:
+    """Sampling RNG keyed by the GLOBAL batch index: the same global
+    batch is sampled identically no matter which rank (at which world
+    size) builds it."""
+    mixed = _splitmix64(np.uint64((np.int64(seed) << np.int64(40))
+                                  ^ (np.int64(epoch) << np.int64(20))
+                                  ^ np.int64(global_batch)))
+    return np.random.RandomState(int(mixed) % (2 ** 31 - 1))
+
+
+# --------------------------------------------------------------- sampling --
+@dataclasses.dataclass
+class SampledSubgraph:
+    """One k-hop computation graph with fixed shapes.
+
+    ``node_ids`` lays out ``[seeds | hop1 | ... | hopK]`` (occurrences,
+    not deduped — a node reached twice appears twice, which keeps shapes
+    static without dedup maps). ``hop_tables[h] = (local, mask)`` where
+    ``local[i, k]`` is the flat position (within node_ids) of frontier
+    node i's k-th sampled in-neighbor. ``halted`` marks occurrences
+    served from the historical cache (remote, beyond hop 0, K > 0):
+    their fanout rows are fully masked."""
+    node_ids: np.ndarray                       # [n_total] int64 global ids
+    hop_of: np.ndarray                         # [n_total] int32 hop depth
+    halted: np.ndarray                         # [n_total] bool
+    hop_tables: List[Tuple[np.ndarray, np.ndarray]]
+    offsets: np.ndarray                        # [K + 2] block offsets
+
+    @property
+    def num_seeds(self) -> int:
+        return int(self.offsets[1])
+
+
 def sample_khop_subgraph(csr: CSRGraph, seeds: np.ndarray,
                          fanouts: Sequence[int],
-                         rng: np.random.RandomState):
+                         rng: np.random.RandomState,
+                         owner: Optional[np.ndarray] = None,
+                         rank: int = 0,
+                         expand_remote: bool = True) -> SampledSubgraph:
     """Sample the k-hop computation graph of `seeds` with fixed fanouts.
 
-    Returns (node_ids [n_total], hop_tables): layer-wise frontier expansion;
-    hop_tables[h] = (nbr_local [B_h, fanout_h], mask) with LOCAL indices
-    into node_ids, where B_h is the hop-h frontier size
-    (B_0 = len(seeds), B_{h+1} = B_h * fanout_h — fixed shapes).
-    node_ids may repeat (a node reached twice appears twice); features are
-    gathered per occurrence, which keeps shapes static without dedup maps.
-    """
-    frontiers = [np.asarray(seeds, np.int32)]
+    Frontier sizes are B_0 = len(seeds), B_{h+1} = B_h * fanout_h —
+    fixed shapes regardless of the sample. With ``expand_remote=False``
+    (historical-cache mode), frontier nodes beyond hop 0 whose owner is
+    not `rank` are halted: not expanded further, flagged for the stale
+    table. Seeds are always expanded (hop-0 exactness)."""
+    seeds = np.asarray(seeds, np.int64).reshape(-1)
+    frontiers: List[np.ndarray] = [seeds]
+    halts: List[np.ndarray] = [np.zeros(len(seeds), bool)]
     tables = []
     for f in fanouts:
-        cur = frontiers[-1]
-        nbr, mask = csr.sample_in_neighbors(cur, f, rng)
-        # sampled senders join the node list after the current nodes
+        cur, cur_halt = frontiers[-1], halts[-1]
+        nbr, mask = csr.sample_in_neighbors(cur, int(f), rng,
+                                            skip=cur_halt)
         tables.append((nbr, mask))
-        frontiers.append(nbr.reshape(-1))
-    node_ids = np.concatenate([fr.reshape(-1) for fr in frontiers])
-    # local index of hop h's frontier block within node_ids
+        flat = nbr.reshape(-1).astype(np.int64)
+        fmask = mask.reshape(-1)
+        if owner is not None and not expand_remote:
+            new_halt = fmask & (owner[flat] != rank)
+        else:
+            new_halt = np.zeros(flat.size, bool)
+        frontiers.append(flat)
+        halts.append(new_halt)
+    node_ids = np.concatenate(frontiers)
+    halted = np.concatenate(halts)
     offsets = np.cumsum([0] + [fr.size for fr in frontiers])
+    hop_of = np.concatenate(
+        [np.full(fr.size, h, np.int32) for h, fr in enumerate(frontiers)])
     hop_tables = []
     for h, (nbr, mask) in enumerate(tables):
-        B = nbr.shape[0]
-        # occurrence j of hop-(h+1) block corresponds to flat position j
+        # occurrence j of hop-(h+1)'s block sits at flat position
+        # offsets[h+1] + j — the neighbor "gather" is an index identity
         local = (offsets[h + 1]
                  + np.arange(nbr.size, dtype=np.int32).reshape(nbr.shape))
         hop_tables.append((local, mask))
-    return node_ids, hop_tables
+    return SampledSubgraph(node_ids=node_ids, hop_of=hop_of, halted=halted,
+                           hop_tables=hop_tables,
+                           offsets=np.asarray(offsets, np.int64))
 
 
-class NeighborSamplingLoader:
-    """Minibatch stream of fixed-shape k-hop subgraph batches for node-level
-    training on one big graph.
+def refresh_allowance(sub: SampledSubgraph, owner: Optional[np.ndarray],
+                      rank: int, num_layers: int) -> np.ndarray:
+    """[n_total] int32: deepest historical-table layer t (1-based; the
+    table stores post-layer states for layers 1..L-1) each occurrence
+    may refresh, -1 for none.
 
-    Yields (features [n_total, F], hop_tables, seed_targets [B, T]) per
-    batch; aggregation at hop h is a masked reduction over
-    features[hop_tables[h][0]] — the dense neighbor-list layout.
-    """
+    A hop-h occurrence's layer-t state is exact only for t <= L - h (it
+    has L - h hops of expansion beneath it), so shallower occurrences
+    refresh deeper tables. Only occurrences this rank OWNS and computed
+    FRESH (not halted) qualify, and at most ONE occurrence per global id
+    keeps its allowance (the deepest; ties to the first occurrence) so
+    the device scatter has unique indices — deterministic by
+    construction, not by scatter ordering luck."""
+    n = sub.node_ids.size
+    allow = np.minimum(num_layers - sub.hop_of, num_layers - 1)
+    qualify = (~sub.halted) & (allow >= 1)
+    if owner is not None:
+        qualify &= owner[sub.node_ids] == rank
+    out = np.full(n, -1, np.int32)
+    cand = np.flatnonzero(qualify)
+    if cand.size:
+        # lexsort: last key is primary — group by node id, deepest
+        # allowance first, earliest occurrence breaking ties
+        ordkey = np.lexsort((cand, -allow[cand], sub.node_ids[cand]))
+        cs = cand[ordkey]
+        first = np.ones(cs.size, bool)
+        first[1:] = sub.node_ids[cs[1:]] != sub.node_ids[cs[:-1]]
+        keep = cs[first]
+        out[keep] = allow[keep]
+    return out
 
-    def __init__(self, x: np.ndarray, senders: np.ndarray,
-                 receivers: np.ndarray, y_node: np.ndarray,
-                 batch_size: int, fanouts: Sequence[int] = (8, 8),
-                 shuffle: bool = True, seed: int = 0,
-                 train_nodes: Optional[np.ndarray] = None):
+
+# ------------------------------------------------------ batch construction --
+def build_sampled_batch(sub: SampledSubgraph, x_rows: np.ndarray,
+                        y_seed: np.ndarray, *, num_nodes_global: int,
+                        num_layers: Optional[int] = None,
+                        hist: bool = False,
+                        owner: Optional[np.ndarray] = None,
+                        rank: int = 0) -> GraphBatch:
+    """Sampled subgraph -> padded static-shape `GraphBatch` for the REAL
+    conv stacks: last node slot is the padding node, masked fanout slots
+    become padding self-edges, the whole subgraph is graph 0 of 2 (graph
+    1 is the padding graph), and the loss mask is ``seed_mask``.
+
+    ``x_rows`` are per-OCCURRENCE features (halted rows may be zeros —
+    the train step overrides them from the resident feature table)."""
+    n_total = sub.node_ids.size
+    B = sub.num_seeds
+    N = n_total + 1
+    F = x_rows.shape[1]
+    y_seed = np.asarray(y_seed, np.float32)
+    if y_seed.ndim == 1:
+        y_seed = y_seed[:, None]
+    T = y_seed.shape[1]
+
+    x = np.zeros((N, F), np.float32)
+    x[:n_total] = x_rows
+    y_node = np.zeros((N, T), np.float32)
+    y_node[:B] = y_seed
+
+    send_parts, recv_parts, mask_parts = [], [], []
+    for h, (local, mask) in enumerate(sub.hop_tables):
+        Bh, fh = local.shape
+        recv = (sub.offsets[h]
+                + np.repeat(np.arange(Bh, dtype=np.int64), fh))
+        send = local.reshape(-1).astype(np.int64)
+        m = mask.reshape(-1)
+        send_parts.append(np.where(m, send, N - 1))
+        recv_parts.append(np.where(m, recv, N - 1))
+        mask_parts.append(m)
+    # one guaranteed padding edge keeps E >= 1 even with no fanouts
+    send_parts.append(np.asarray([N - 1], np.int64))
+    recv_parts.append(np.asarray([N - 1], np.int64))
+    mask_parts.append(np.asarray([False]))
+    senders = np.concatenate(send_parts).astype(np.int32)
+    receivers = np.concatenate(recv_parts).astype(np.int32)
+    edge_mask = np.concatenate(mask_parts)
+
+    node_mask = np.ones(N, bool)
+    node_mask[N - 1] = False
+    seed_mask = np.zeros(N, bool)
+    seed_mask[:B] = True
+    node_graph = np.zeros(N, np.int32)
+    node_graph[N - 1] = 1
+    graph_mask = np.asarray([True, False])
+
+    node_global = np.concatenate(
+        [sub.node_ids, [num_nodes_global]]).astype(np.int32)
+    hist_mask = None
+    refresh_upto = None
+    if hist:
+        if num_layers is None:
+            num_layers = len(sub.hop_tables)
+        hist_mask = np.concatenate([sub.halted, [False]])
+        refresh_upto = np.concatenate(
+            [refresh_allowance(sub, owner, rank, int(num_layers)),
+             [-1]]).astype(np.int32)
+
+    return GraphBatch(
+        x=x, pos=np.zeros((N, 3), np.float32), senders=senders,
+        receivers=receivers, node_graph=node_graph, node_mask=node_mask,
+        edge_mask=edge_mask, graph_mask=graph_mask, y_node=y_node,
+        seed_mask=seed_mask, node_global=node_global, hist_mask=hist_mask,
+        refresh_upto=refresh_upto)
+
+
+# --------------------------------------------------- historical embeddings --
+@struct.dataclass
+class HistTables:
+    """Device-resident historical-embedding cache (the DistGNN trick):
+    stale per-layer states + version stamps, refreshed inside the jitted
+    step every K steps from the rank's own fresh computations."""
+    feat: jnp.ndarray      # [Ng+1, F] static features (row Ng = dump row)
+    layers: jnp.ndarray    # [L-1, Ng+1, H] stale post-layer states
+    versions: jnp.ndarray  # [Ng+1] int32 refresh step stamps
+
+
+def init_hist_tables(features: np.ndarray, hidden_dim: int,
+                     num_layers: int) -> HistTables:
+    """Fresh tables: ``feat`` is filled ONCE from the feature store
+    (features are static — only hidden states go stale), which is the
+    one-time replication the per-step fetch savings amortize; a real
+    multi-host deployment would fill only partition + boundary rows.
+    Row Ng is the padding/scatter-dump row — written by refreshes whose
+    slot doesn't qualify, read only into masked-out lanes."""
+    features = np.asarray(features, np.float32)
+    ng, f = features.shape
+    feat = np.zeros((ng + 1, f), np.float32)
+    feat[:ng] = features
+    t = max(int(num_layers) - 1, 0)
+    return HistTables(
+        feat=jnp.asarray(feat),
+        layers=jnp.zeros((t, ng + 1, int(hidden_dim)), jnp.float32),
+        versions=jnp.zeros((ng + 1,), jnp.int32))
+
+
+# ------------------------------------------------------------ feature store --
+class NodeFeatureStore:
+    """Partitioned node feature/label store with per-minibatch gathers
+    by global node id and local/remote byte accounting.
+
+    Backed either by in-memory arrays or by the PR 5 content-addressed
+    cache's mmap'd shard format (`open_cached` / `build_cached` — the
+    zero-copy host gather path; preprocess/cache.save_array_shard)."""
+
+    def __init__(self, x: np.ndarray, y_node: np.ndarray,
+                 owner: Optional[np.ndarray] = None, rank: int = 0):
         self.x = np.asarray(x)
         self.y = np.asarray(y_node)
-        self.csr = CSRGraph(senders, receivers, len(x))
-        self.batch_size = batch_size
-        self.fanouts = tuple(fanouts)
-        self.shuffle = shuffle
-        self.seed = seed
+        if self.y.ndim == 1:
+            self.y = self.y[:, None]
+        self.owner = (np.zeros(len(self.x), np.int32) if owner is None
+                      else np.asarray(owner, np.int32))
+        self.rank = int(rank)
+        self.local_bytes = 0
+        self.remote_bytes = 0
+
+    @property
+    def num_nodes(self) -> int:
+        return int(self.x.shape[0])
+
+    @property
+    def feat_dim(self) -> int:
+        return int(self.x.shape[1])
+
+    @property
+    def label_dim(self) -> int:
+        return int(self.y.shape[1])
+
+    def _count(self, ids: np.ndarray, row_bytes: int) -> None:
+        remote = int(np.sum(self.owner[ids] != self.rank))
+        self.remote_bytes += remote * row_bytes
+        self.local_bytes += (ids.size - remote) * row_bytes
+
+    def gather_features(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(ids, np.int64)
+        self._count(ids, int(self.x.itemsize * self.x.shape[1]))
+        return np.ascontiguousarray(self.x[ids], dtype=np.float32)
+
+    def gather_labels(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(ids, np.int64)
+        self._count(ids, int(self.y.itemsize * self.y.shape[1]))
+        return np.ascontiguousarray(self.y[ids], dtype=np.float32)
+
+    def fetch_stats(self) -> Dict[str, int]:
+        return {"local_bytes": int(self.local_bytes),
+                "remote_bytes": int(self.remote_bytes)}
+
+    # ------------------------------------------------------ cache-backed --
+    @classmethod
+    def build_cached(cls, cache_dir: str, key: str, x: np.ndarray,
+                     y_node: np.ndarray, owner: np.ndarray,
+                     rank: int = 0) -> "NodeFeatureStore":
+        """Write the store into the content-addressed cache (atomic
+        shard), then reopen it mmap'd — every later run at the same key
+        takes the zero-copy path."""
+        from .cache import save_array_shard
+        y_node = np.asarray(y_node)
+        if y_node.ndim == 1:
+            y_node = y_node[:, None]
+        save_array_shard(cache_dir, key, {
+            "x": np.asarray(x, np.float32),
+            "y_node": np.asarray(y_node, np.float32),
+            "owner": np.asarray(owner, np.int32)})
+        return cls.open_cached(cache_dir, key, rank=rank)
+
+    @classmethod
+    def open_cached(cls, cache_dir: str, key: str, rank: int = 0,
+                    verify: bool = True) -> "NodeFeatureStore":
+        from .cache import load_array_shard
+        arrays, _ = load_array_shard(cache_dir, key, verify=verify)
+        return cls(arrays["x"], arrays["y_node"], arrays["owner"],
+                   rank=rank)
+
+
+# ------------------------------------------------------------------ loader --
+class NeighborSamplingLoader:
+    """Minibatch stream of fixed-shape sampled `GraphBatch`es for
+    node-level training on one big graph.
+
+    The global plan: ``seed_plan(epoch, seed)`` permutes the train
+    nodes, consecutive size-B slices form ``num_global_batches`` batches
+    (trailing partial dropped — shapes stay fixed), and rank r of W
+    takes batches ``r, r+W, r+2W, ...``. Identical global order at every
+    world size; per-batch sampling RNG keyed by the global batch index —
+    re-slicing the world re-distributes, never re-samples."""
+
+    def __init__(self, x: Optional[np.ndarray] = None,
+                 senders: np.ndarray = None, receivers: np.ndarray = None,
+                 y_node: Optional[np.ndarray] = None,
+                 batch_size: int = 32, fanouts: Sequence[int] = (8, 8),
+                 shuffle: bool = True, seed: int = 0,
+                 train_nodes: Optional[np.ndarray] = None, *,
+                 store: Optional[NodeFeatureStore] = None,
+                 rank: int = 0, world: int = 1,
+                 num_partitions: int = 1, partition_mode: str = "range",
+                 staleness_k: int = 0, num_layers: Optional[int] = None,
+                 async_workers: Optional[int] = None):
+        if store is None:
+            if x is None or y_node is None:
+                raise ValueError(
+                    "NeighborSamplingLoader needs either (x, y_node) "
+                    "arrays or a prebuilt NodeFeatureStore")
+            owner = partition_nodes(len(np.asarray(x)),
+                                    int(num_partitions), partition_mode,
+                                    seed=int(seed))
+            store = NodeFeatureStore(x, y_node, owner, rank=rank)
+        self.store = store
+        self.owner = store.owner
+        self.csr = CSRGraph(senders, receivers, store.num_nodes)
+        self.batch_size = int(batch_size)
+        self.fanouts = tuple(int(f) for f in fanouts)
+        self.shuffle = bool(shuffle)
+        self.seed = int(seed)
+        self.rank = int(rank)
+        self.world = max(int(world), 1)
+        self.num_partitions = int(num_partitions)
+        self.partition_mode = str(partition_mode)
+        self.staleness_k = int(staleness_k)
+        self.num_layers = int(num_layers if num_layers is not None
+                              else len(self.fanouts))
+        from ..datasets.async_loader import resolve_async_workers
+        self.async_workers = resolve_async_workers(async_workers)
         self.epoch = 0
-        self.train_nodes = (np.arange(len(x), dtype=np.int32)
+        self.train_nodes = (np.arange(store.num_nodes, dtype=np.int64)
                             if train_nodes is None
-                            else np.asarray(train_nodes, np.int32))
+                            else np.asarray(train_nodes, np.int64))
+        if len(self.train_nodes) < self.batch_size:
+            raise ValueError(
+                f"batch_size={self.batch_size} exceeds the "
+                f"{len(self.train_nodes)} available seed nodes — fixed "
+                "shapes need at least one full batch")
+        self.batches_built = 0
+        # background-sampling overlap accounting (async_loader
+        # background_iterate mutates this in place)
+        self.overlap_stats: Dict[str, float] = {}
 
-    def set_epoch(self, epoch: int):
-        self.epoch = epoch
+    # ----------------------------------------------------------- the plan --
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = int(epoch)
 
-    def __len__(self):
-        return max(len(self.train_nodes) // self.batch_size, 1)
+    @property
+    def hist_mode(self) -> bool:
+        return self.staleness_k > 0
+
+    @property
+    def num_global_batches(self) -> int:
+        return len(self.train_nodes) // self.batch_size
+
+    def rank_batches(self) -> List[int]:
+        """This rank's global batch indices — the world re-slice."""
+        return list(range(self.rank, self.num_global_batches, self.world))
+
+    def __len__(self) -> int:
+        return len(self.rank_batches())
+
+    def epoch_order(self, epoch: Optional[int] = None) -> np.ndarray:
+        ep = self.epoch if epoch is None else int(epoch)
+        if not self.shuffle:
+            return self.train_nodes
+        return self.train_nodes[seed_plan(len(self.train_nodes), ep,
+                                          self.seed)]
+
+    def plan_fingerprint(self) -> str:
+        """sha256 over everything that determines the global batch
+        sequence — world-size-invariant by construction, compared across
+        ranks and generations by the elastic bench (the PR 2 plan_fp
+        contract)."""
+        h = hashlib.sha256()
+        h.update(json.dumps({
+            "batch_size": self.batch_size, "fanouts": list(self.fanouts),
+            "shuffle": self.shuffle, "seed": self.seed,
+            "num_layers": self.num_layers,
+            "staleness_k": self.staleness_k,
+            "partitions": partition_fingerprint(
+                self.store.num_nodes, self.num_partitions,
+                self.partition_mode, self.seed),
+            "scheme": "sample-plan-v1"}, sort_keys=True).encode())
+        h.update(np.ascontiguousarray(self.train_nodes).tobytes())
+        h.update(self.epoch_order(0).tobytes())
+        return h.hexdigest()[:32]
+
+    # ------------------------------------------------------------ batches --
+    def _build_batch(self, order: np.ndarray, gb: int) -> GraphBatch:
+        rng = _batch_rng(self.seed, self.epoch, gb)
+        seeds = order[gb * self.batch_size:(gb + 1) * self.batch_size]
+        sub = sample_khop_subgraph(
+            self.csr, seeds, self.fanouts, rng, owner=self.owner,
+            rank=self.rank, expand_remote=not self.hist_mode)
+        x_rows = np.zeros((sub.node_ids.size, self.store.feat_dim),
+                          np.float32)
+        fresh = ~sub.halted
+        x_rows[fresh] = self.store.gather_features(sub.node_ids[fresh])
+        y_seed = self.store.gather_labels(seeds)
+        batch = build_sampled_batch(
+            sub, x_rows, y_seed, num_nodes_global=self.store.num_nodes,
+            num_layers=self.num_layers, hist=self.hist_mode,
+            owner=self.owner, rank=self.rank)
+        self.batches_built += 1
+        from ..telemetry.sampling import record_sampled_batch
+        record_sampled_batch(
+            num_seeds=len(seeds), num_nodes=int(sub.node_ids.size),
+            hist_served=int(np.sum(sub.halted)),
+            fetch_stats=self.store.fetch_stats())
+        return batch
 
     def __iter__(self):
-        rng = np.random.RandomState(self.seed + self.epoch)
-        order = self.train_nodes.copy()
-        if self.shuffle:
-            rng.shuffle(order)
-        for ib in range(len(self)):
-            seeds = order[ib * self.batch_size:(ib + 1) * self.batch_size]
-            if len(seeds) < self.batch_size:   # keep shapes fixed
-                seeds = np.concatenate(
-                    [seeds, order[:self.batch_size - len(seeds)]])
-            node_ids, tables = sample_khop_subgraph(
-                self.csr, seeds, self.fanouts, rng)
-            yield (self.x[node_ids], tables, self.y[seeds])
+        order = self.epoch_order()
 
+        def gen():
+            for gb in self.rank_batches():
+                yield self._build_batch(order, gb)
 
-def sage_subgraph_forward(apply_layer, params_per_hop, feats: np.ndarray,
-                          hop_tables):
-    """Reference forward for k-hop subgraph batches: aggregate the deepest
-    frontier inward until only the seed block remains (the standard
-    GraphSAGE minibatch computation). `apply_layer(params, h_self,
-    h_nbr_agg) -> h'`.
+        if self.async_workers > 0:
+            from ..datasets.async_loader import background_iterate
+            return background_iterate(gen(),
+                                      depth=self.async_workers + 1,
+                                      stats=self.overlap_stats)
+        return gen()
 
-    feats is [n_total, F] laid out [seeds | hop1 | hop2 | ...]; by
-    construction hop b's sampled neighbors ARE block b+1 in order, so the
-    neighbor gather is a reshape — zero indexing on device.
-    """
-    import jax.numpy as jnp
+    def sampler_overlap_frac(self) -> float:
+        """Fraction of consumed batches that were already waiting in the
+        background queue — 1.0 when sampling fully hides behind the
+        step (async mode only; 0.0 before any async iteration)."""
+        items = self.overlap_stats.get("items", 0)
+        if not items:
+            return 0.0
+        return self.overlap_stats["ready_items"] / items
 
-    k = len(hop_tables)
-    sizes = [hop_tables[0][0].shape[0]]
-    for local, _ in hop_tables:
-        sizes.append(local.size)
-    offsets = np.cumsum([0] + sizes)
-    feats = jnp.asarray(feats)
-    hs = [feats[offsets[b]:offsets[b + 1]] for b in range(k + 1)]
-    for layer in range(k):
-        new = []
-        for b in range(k - layer):
-            _, mask = hop_tables[b]
-            B, fanout = mask.shape
-            m = jnp.asarray(mask)[..., None]
-            nbr = hs[b + 1].reshape(B, fanout, hs[b + 1].shape[-1])
-            agg = jnp.sum(jnp.where(m, nbr, 0.0), axis=1) / \
-                jnp.maximum(jnp.sum(m, axis=1), 1.0)
-            new.append(apply_layer(params_per_hop[layer], hs[b], agg))
-        hs = new
-    return hs[0]
+    def fetch_stats(self) -> Dict[str, float]:
+        """Cumulative host-gather byte accounting — `remote_bytes` is
+        the cross-partition fetch volume the historical cache removes
+        (the BENCH_SAMPLE adjudication quantity)."""
+        stats = dict(self.store.fetch_stats())
+        n = max(self.batches_built, 1)
+        stats["batches"] = self.batches_built
+        stats["remote_bytes_per_batch"] = stats["remote_bytes"] / n
+        stats["local_bytes_per_batch"] = stats["local_bytes"] / n
+        stats["sampler_overlap_frac"] = self.sampler_overlap_frac()
+        return stats

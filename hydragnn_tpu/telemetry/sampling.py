@@ -1,0 +1,49 @@
+"""Sampled-training telemetry (docs/sampling.md, docs/observability.md).
+
+Host-side producer helpers for the giant-graph sampling pipeline —
+counters land in the process metrics registry so the exporters and
+BENCH_SAMPLE read one source of truth. No knobs are read here (the
+traced-env-read discipline): callers pass plain values.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from .registry import get_registry
+
+
+def record_sampled_batch(num_seeds: int, num_nodes: int, hist_served: int,
+                         fetch_stats: Dict[str, int]) -> None:
+    """One sampled minibatch: seed/node throughput, historical-cache
+    serve counts, and the cumulative local/remote fetch bytes (the
+    registry keeps counters monotone; `fetch_stats` is cumulative, so
+    gauges carry it)."""
+    reg = get_registry()
+    reg.counter_inc("sampler_batches_total",
+                    help="sampled minibatches built")
+    reg.counter_inc("sampler_seed_nodes_total", float(num_seeds),
+                    help="seed nodes trained on")
+    reg.counter_inc("sampler_subgraph_nodes_total", float(num_nodes),
+                    help="sampled subgraph node occurrences")
+    reg.counter_inc("sampler_hist_served_nodes_total", float(hist_served),
+                    help="occurrences served from the historical "
+                         "embedding cache instead of expansion")
+    reg.gauge_set("sampler_fetched_bytes", float(fetch_stats["local_bytes"]),
+                  help="cumulative feature-store gather bytes",
+                  kind="local")
+    reg.gauge_set("sampler_fetched_bytes",
+                  float(fetch_stats["remote_bytes"]),
+                  help="cumulative feature-store gather bytes",
+                  kind="remote")
+
+
+def record_hist_refresh(staleness_mean: float, hist_frac: float) -> None:
+    """Per-step historical-cache health, from the jitted step's metrics
+    (host-side after device fetch): mean version staleness of served
+    rows and the fraction of batch slots served stale."""
+    reg = get_registry()
+    reg.gauge_set("sampler_hist_staleness_steps", float(staleness_mean),
+                  help="mean steps since refresh of served hist rows")
+    reg.gauge_set("sampler_hist_served_frac", float(hist_frac),
+                  help="fraction of batch node slots served from the "
+                       "historical cache")

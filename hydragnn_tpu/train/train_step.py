@@ -206,6 +206,193 @@ def _make_step_body(model, cfg: ModelConfig, tx: optax.GradientTransformation,
     return step_body
 
 
+# ------------------------------------------------- sampled giant-graph --
+def _seed_loss_batch(batch: GraphBatch) -> GraphBatch:
+    """Loss view of a sampled batch: node heads are supervised on SEED
+    slots only (docs/sampling.md) — the hop-expansion slots exist to
+    give seeds their receptive field, not to be predicted. multihead_loss
+    masks node heads with node_mask, so the loss view swaps seed_mask in;
+    the model forward keeps the full node_mask."""
+    if batch.seed_mask is None:
+        return batch
+    return batch.replace(node_mask=batch.seed_mask)
+
+
+def make_sampled_loss_fn(model, cfg: ModelConfig, loss_name: str = "ce",
+                         compute_dtype: Optional[str] = None,
+                         num_hist_layers: int = 0):
+    """loss_fn(params, batch_stats, batch) -> (total, (new_batch_stats,
+    metrics, hist_states_or_None)) for sampled giant-graph batches: the
+    seed-masked loss plus (when `num_hist_layers` > 0) the encoder's
+    fresh post-layer states, sown by BaseStack.encode and returned
+    [L-1, N, H] for the historical-cache refresh."""
+    from ..kernels.fused_mp_pallas import resolve_fused_mp_flag
+    from ..kernels.nbr_pallas import resolve_nbr_pallas_flag
+    resolve_nbr_pallas_flag(refresh=True)  # pinned at construction time
+    resolve_fused_mp_flag(refresh=True)
+    cdtype = _resolve_compute_dtype(cfg, compute_dtype)
+    mixed = cdtype != jnp.float32
+
+    def loss_fn(params, batch_stats, batch: GraphBatch):
+        if mixed:
+            params = _cast_floats(params, cdtype)
+            batch_stats = _cast_floats(batch_stats, cdtype)
+        variables = {"params": params, "batch_stats": batch_stats}
+        mutable = ["batch_stats"]
+        if num_hist_layers:
+            mutable.append("intermediates")
+        (outputs, outputs_var), mutated = model.apply(
+            variables, _cast_floats(batch, cdtype) if mixed else batch,
+            train=True, mutable=mutable)
+        if mixed:
+            outputs = _cast_floats(outputs, jnp.float32)
+            outputs_var = _cast_floats(outputs_var, jnp.float32)
+        total, tasks = multihead_loss(cfg, loss_name, outputs,
+                                      outputs_var, _seed_loss_batch(batch))
+        metrics = {"loss": total}
+        for i, t in enumerate(tasks):
+            metrics[f"task_{i}"] = t
+        new_bs = mutated["batch_stats"]
+        if mixed:
+            new_bs = _cast_floats(new_bs, jnp.float32)
+        inter = None
+        if num_hist_layers:
+            sown = mutated["intermediates"]
+            inter = jnp.stack(
+                [sown[f"encoder_h{i}"][0].astype(jnp.float32)
+                 for i in range(num_hist_layers)])
+        return total, (new_bs, metrics, inter)
+
+    return loss_fn
+
+
+def make_sampled_train_step(model, cfg: ModelConfig,
+                            tx: optax.GradientTransformation, *,
+                            loss_name: str = "ce", staleness_k: int = 0,
+                            compute_dtype: Optional[str] = None,
+                            donate: bool = True):
+    """Jitted train step for fixed-shape sampled batches
+    (preprocess/sampling.py, docs/sampling.md) — every batch has
+    identical shapes, so this compiles exactly ONCE for the whole run
+    (BENCH_SAMPLE pins `jit_recompiles == 1`).
+
+    ``staleness_k == 0`` (exact mode): `step(state, batch)`, the plain
+    optimizer step under the seed-masked loss.
+
+    ``staleness_k > 0`` (historical-embedding mode):
+    `step(state, batch, tables, do_refresh)` additionally
+
+    * substitutes the resident feature row and per-layer stale states
+      for every hist-served slot (gathered by ``batch.node_global``;
+      BaseStack.encode applies the per-layer override),
+    * on ``do_refresh`` (a TRACED flag — both branches live in the one
+      compiled program), scatters the rank's own fresh post-layer
+      states back into the tables at the loader-deduplicated
+      ``refresh_upto`` slots and version-stamps them.
+
+    Refresh cadence is the CALLER's ``step % K == 0`` — K never enters
+    the trace, so changing it cannot recompile."""
+    hist = int(staleness_k) > 0
+    num_hist = max(int(cfg.num_conv_layers) - 1, 0) if hist else 0
+    loss_fn = make_sampled_loss_fn(model, cfg, loss_name, compute_dtype,
+                                   num_hist)
+
+    def optimizer_step(state: TrainState, batch: GraphBatch):
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (total, (new_bs, metrics, inter)), grads = grad_fn(
+            state.params, state.batch_stats, batch)
+        metrics = {**metrics,
+                   "nonfinite_steps": _nonfinite_watchdog(total, grads)}
+        grads = freeze_conv_grads(grads, cfg)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        updates = freeze_conv_grads(updates, cfg)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(params=new_params, batch_stats=new_bs,
+                                  opt_state=new_opt, step=state.step + 1)
+        return new_state, metrics, inter
+
+    if not hist:
+        def step(state: TrainState, batch: GraphBatch):
+            new_state, metrics, _ = optimizer_step(state, batch)
+            return new_state, metrics
+
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    def hist_step(state: TrainState, batch: GraphBatch, tables,
+                  do_refresh):
+        ids = batch.node_global
+        x = jnp.where(batch.hist_mask[:, None], tables.feat[ids], batch.x)
+        b = batch.replace(x=x, hist_states=tables.layers[:, ids])
+        # staleness telemetry BEFORE the update: what this step consumed
+        hist_n = jnp.sum(batch.hist_mask)
+        staleness = (jnp.sum(jnp.where(
+            batch.hist_mask, state.step - tables.versions[ids], 0))
+            / jnp.maximum(hist_n, 1))
+        new_state, metrics, inter = optimizer_step(state, b)
+        metrics = {**metrics, "hist_staleness": staleness.astype(
+            jnp.float32), "hist_frac": hist_n / batch.hist_mask.shape[0]}
+        inter = jax.lax.stop_gradient(inter)
+        dump = tables.feat.shape[0] - 1  # scatter-dump row, never read
+
+        def do_ref(tb):
+            new_layers = tb.layers
+            for t in range(1, tb.layers.shape[0] + 1):
+                safe = jnp.where(batch.refresh_upto >= t, ids, dump)
+                new_layers = new_layers.at[t - 1, safe].set(inter[t - 1])
+            safe0 = jnp.where(batch.refresh_upto >= 1, ids, dump)
+            new_vers = tb.versions.at[safe0].set(new_state.step)
+            return tb.replace(layers=new_layers, versions=new_vers)
+
+        new_tables = jax.lax.cond(do_refresh, do_ref, lambda tb: tb,
+                                  tables)
+        return new_state, new_tables, metrics
+
+    return jax.jit(hist_step, donate_argnums=(0, 2) if donate else ())
+
+
+def make_sampled_eval_step(model, cfg: ModelConfig, loss_name: str = "ce",
+                           staleness_k: int = 0,
+                           compute_dtype: Optional[str] = None):
+    """Jitted eval for sampled batches: seed-masked loss plus top-1
+    accuracy counts for classification node heads (y_node wider than one
+    column). Hist mode takes the tables and applies the same stale
+    substitution as training — eval sees exactly the serving-time
+    approximation."""
+    forward = make_forward_fn(model, cfg, compute_dtype)
+
+    def eval_core(state: TrainState, batch: GraphBatch):
+        variables = {"params": state.params,
+                     "batch_stats": state.batch_stats}
+        outputs, outputs_var = forward(variables, batch, train=False)
+        total, tasks = multihead_loss(cfg, loss_name, outputs,
+                                      outputs_var, _seed_loss_batch(batch))
+        metrics = {"loss": total}
+        for i, t in enumerate(tasks):
+            metrics[f"task_{i}"] = t
+        if batch.y_node is not None and batch.y_node.shape[-1] > 1:
+            nclass = batch.y_node.shape[-1]
+            pred = jnp.argmax(outputs[0][..., :nclass], axis=-1)
+            label = jnp.argmax(batch.y_node, axis=-1)
+            sm = (batch.seed_mask if batch.seed_mask is not None
+                  else batch.node_mask)
+            metrics["correct"] = jnp.sum(
+                jnp.where(sm, pred == label, False)).astype(jnp.float32)
+            metrics["count"] = jnp.sum(sm).astype(jnp.float32)
+        return metrics, outputs
+
+    if int(staleness_k) <= 0:
+        return jax.jit(eval_core)
+
+    def hist_eval(state: TrainState, batch: GraphBatch, tables):
+        ids = batch.node_global
+        x = jnp.where(batch.hist_mask[:, None], tables.feat[ids],
+                      batch.x)
+        return eval_core(state, batch.replace(
+            x=x, hist_states=tables.layers[:, ids]))
+
+    return jax.jit(hist_eval)
+
+
 def compiled_cost_flops(compiled):
     """Per-call FLOPs from an already-compiled executable's XLA cost
     analysis; None when the backend doesn't report it. Callers that

@@ -410,3 +410,53 @@ def resolve_steps_per_call(train_cfg) -> int:
     if spc_env is not None:
         return spc_env
     return int(train_cfg.get("steps_per_call", 1))
+
+
+def resolve_sampling(train_cfg=None) -> "tuple[tuple, int, int, str]":
+    """Giant-graph sampled-training knobs (docs/sampling.md) ->
+    (fanouts, staleness_k, partitions, partition_mode).
+
+    Precedence per knob: HYDRAGNN_SAMPLE_* env over the
+    Training.Sampling config block over defaults. STRICT parsing
+    throughout — fanouts change every compiled shape in the run and
+    staleness_k changes the training mathematics, so a typo value must
+    warn and fall back, never silently take effect (the
+    HYDRAGNN_PALLAS_NBR lesson). Resolved ONCE at loader construction;
+    preprocess/sampling.py takes plain values and never reads the
+    environment (tools/check_traced_env_reads.py enforces it).
+
+    Knobs:
+      HYDRAGNN_SAMPLE_FANOUTS      comma-separated per-hop fanouts,
+                                   e.g. "10,5" (Sampling.fanouts;
+                                   default 8,8)
+      HYDRAGNN_SAMPLE_STALENESS_K  historical-cache refresh period; 0 =
+                                   exact, no cache (Sampling.staleness_k;
+                                   default 0)
+      HYDRAGNN_SAMPLE_PARTITIONS   feature/owner partitions
+                                   (Sampling.partitions; default 1)
+    Partition mode (range | hash) is config-only (Sampling.
+    partition_mode): it changes the cache key and the ownership layout,
+    not a per-run tuning choice.
+    """
+    block = (train_cfg or {}).get("Sampling", {}) or {}
+    fan_default = tuple(int(f) for f in block.get("fanouts", (8, 8)))
+    fanouts = fan_default
+    raw = os.getenv("HYDRAGNN_SAMPLE_FANOUTS")
+    if raw is not None and raw.strip():
+        try:
+            parsed = tuple(int(p.strip()) for p in raw.split(","))
+            if not parsed or any(f <= 0 for f in parsed):
+                raise ValueError
+            fanouts = parsed
+        except ValueError:
+            import logging
+            logging.getLogger("hydragnn_tpu").warning(
+                "HYDRAGNN_SAMPLE_FANOUTS=%r is not a comma-separated "
+                "list of positive integers; treating as %r", raw,
+                fan_default)
+    k = env_strict_int("HYDRAGNN_SAMPLE_STALENESS_K",
+                       int(block.get("staleness_k", 0)))
+    parts = env_strict_int("HYDRAGNN_SAMPLE_PARTITIONS",
+                           int(block.get("partitions", 1)))
+    mode = str(block.get("partition_mode", "range"))
+    return fanouts, max(int(k), 0), max(int(parts), 1), mode

@@ -1,98 +1,504 @@
-"""Fixed-fanout neighbor sampling (large-single-graph minibatch training —
-PAPERS.md sampling/DistGNN techniques; no reference analogue)."""
+"""Giant-graph sampled training (docs/sampling.md; PAPERS.md
+GraphSAGE-fanout + DistGNN historical-embedding techniques; no reference
+analogue — the reference trains on many small graphs).
+
+Covers the rebuilt preprocess/sampling subsystem end to end: CSRGraph
+validation (empty edge lists, out-of-range ids), the fixed-shape k-hop
+sampler, the padded GraphBatch layout the REAL conv stacks consume, the
+(epoch, seed, rank, world)-pure plan (set_epoch reseeding, cross-run and
+cross-world determinism), the partitioned feature store and its
+content-addressed mmap cache, historical-embedding refresh allowances,
+the Training.Sampling / HYDRAGNN_SAMPLE_* knob resolution, and the
+jitted sampled train/eval steps (one-compile + K=0 exactness). Heavy
+multi-epoch training integration rides the slow lane."""
+import logging
+
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from hydragnn_tpu.preprocess.sampling import (CSRGraph,
                                               NeighborSamplingLoader,
-                                              sage_subgraph_forward,
-                                              sample_khop_subgraph)
+                                              build_sampled_batch,
+                                              init_hist_tables,
+                                              partition_fingerprint,
+                                              partition_nodes,
+                                              refresh_allowance,
+                                              sample_khop_subgraph,
+                                              seed_plan)
 
 
-def _big_graph(n=500, deg=6, seed=0):
+def _big_graph(n=300, deg=5, f=4, seed=0):
     rng = np.random.RandomState(seed)
-    senders = rng.randint(0, n, n * deg).astype(np.int32)
-    receivers = np.repeat(np.arange(n), deg).astype(np.int32)
-    x = rng.randn(n, 4).astype(np.float32)
-    return x, senders, receivers, rng
+    senders = rng.randint(0, n, n * deg).astype(np.int64)
+    receivers = np.repeat(np.arange(n, dtype=np.int64), deg)
+    x = rng.randn(n, f).astype(np.float32)
+    labels = rng.randint(0, 3, n)
+    y = np.eye(3, dtype=np.float32)[labels]
+    return x, y, senders, receivers, rng
 
 
+def _loader(x, y, senders, receivers, **kw):
+    kw.setdefault("batch_size", 16)
+    kw.setdefault("fanouts", (4, 3))
+    kw.setdefault("seed", 7)
+    kw.setdefault("async_workers", 0)
+    return NeighborSamplingLoader(x=x, y_node=y, senders=senders,
+                                  receivers=receivers, **kw)
+
+
+def _batches_equal(a, b):
+    for f in ("x", "senders", "receivers", "edge_mask", "node_mask",
+              "seed_mask", "node_graph", "graph_mask", "y_node",
+              "node_global"):
+        np.testing.assert_array_equal(np.asarray(getattr(a, f)),
+                                      np.asarray(getattr(b, f)), f)
+
+
+# ------------------------------------------------------------ CSRGraph --
 def test_csr_sampling_valid_edges():
-    x, senders, receivers, rng = _big_graph()
+    x, _, senders, receivers, rng = _big_graph()
     csr = CSRGraph(senders, receivers, len(x))
-    nodes = np.asarray([0, 3, 7, 499], np.int32)
+    nodes = np.asarray([0, 3, 7, 299], np.int64)
     nbr, mask = csr.sample_in_neighbors(nodes, 4, rng)
     edge_set = set(zip(senders.tolist(), receivers.tolist()))
+    assert mask.any()
     for b, node in enumerate(nodes):
         for k in range(4):
             if mask[b, k]:
                 assert (int(nbr[b, k]), int(node)) in edge_set
 
 
-def test_khop_shapes_fixed():
-    x, senders, receivers, rng = _big_graph()
+def test_csr_empty_edge_list():
+    """A node-only graph (no edges at all) is legal: every fanout row
+    comes back fully masked and the loader still yields fixed-shape
+    batches with only the guaranteed padding edge live-masked off."""
+    csr = CSRGraph(np.asarray([], np.int64), np.asarray([], np.int64), 5)
+    assert csr.num_edges == 0
+    nbr, mask = csr.sample_in_neighbors(
+        np.arange(5), 3, np.random.RandomState(0))
+    assert nbr.shape == (5, 3) and not mask.any()
+
+    x = np.ones((40, 2), np.float32)
+    y = np.eye(2, dtype=np.float32)[np.zeros(40, int)]
+    loader = _loader(x, y, np.asarray([], np.int64),
+                     np.asarray([], np.int64), batch_size=8)
+    b = next(iter(loader))
+    assert not np.asarray(b.edge_mask).any()
+    # every edge endpoint collapses to the padding node
+    n_pad = b.x.shape[0] - 1
+    assert (np.asarray(b.senders) == n_pad).all()
+    assert (np.asarray(b.receivers) == n_pad).all()
+
+
+def test_csr_out_of_range_ids_actionable():
+    """senders/receivers outside [0, num_nodes) raise a ValueError that
+    names the array, the offending id, and the valid range — the
+    build-time check that turns a silent wrong-gather into a message."""
+    good = np.asarray([0, 1], np.int64)
+    with pytest.raises(ValueError, match="receivers.*5.*num_nodes"):
+        CSRGraph(good, np.asarray([0, 5], np.int64), 4)
+    with pytest.raises(ValueError, match="senders.*-1"):
+        CSRGraph(np.asarray([0, -1], np.int64), good, 4)
+    with pytest.raises(ValueError, match="same length"):
+        CSRGraph(np.asarray([0], np.int64), good, 4)
+
+
+# ------------------------------------------------------- fixed shapes --
+def test_khop_shapes_fixed_across_samples():
+    x, _, senders, receivers, rng = _big_graph()
     csr = CSRGraph(senders, receivers, len(x))
     shapes = set()
-    for seed_start in (0, 50, 100):
-        seeds = np.arange(seed_start, seed_start + 8, dtype=np.int32)
-        node_ids, tables = sample_khop_subgraph(csr, seeds, (4, 3), rng)
-        shapes.add((node_ids.shape, tuple(t[0].shape for t in tables)))
-        assert tables[0][0].shape == (8, 4)
-        assert tables[1][0].shape == (32, 3)
-        assert node_ids.shape == (8 + 32 + 96,)
+    for start in (0, 50, 100):
+        seeds = np.arange(start, start + 8)
+        sub = sample_khop_subgraph(csr, seeds, (4, 3), rng)
+        shapes.add((sub.node_ids.shape,
+                    tuple(t[0].shape for t in sub.hop_tables)))
+        assert sub.hop_tables[0][0].shape == (8, 4)
+        assert sub.hop_tables[1][0].shape == (32, 3)
+        assert sub.node_ids.shape == (8 + 32 + 96,)
     assert len(shapes) == 1  # one compiled program for the whole run
 
 
-def test_loader_and_forward_trains():
-    """2-hop SAGE minibatch training on a 500-node graph converges on a
-    closed-form target (mean of in-neighbor features)."""
-    x, senders, receivers, rng = _big_graph()
-    n = len(x)
-    # target: node's own first feature + mean of in-neighbor first features
-    agg = np.zeros(n)
-    cnt = np.zeros(n)
-    np.add.at(agg, receivers, x[senders, 0])
-    np.add.at(cnt, receivers, 1)
-    y = (x[:, 0] + agg / np.maximum(cnt, 1))[:, None].astype(np.float32)
+def test_batch_layout_invariants():
+    x, y, senders, receivers, _ = _big_graph()
+    loader = _loader(x, y, senders, receivers)
+    b = next(iter(loader))
+    n_total = 16 + 16 * 4 + 16 * 4 * 3
+    N = n_total + 1
+    assert b.x.shape == (N, x.shape[1])
+    # nodes: [seeds | hops | padding]; loss mask is the seed block
+    assert np.asarray(b.seed_mask)[:16].all()
+    assert not np.asarray(b.seed_mask)[16:].any()
+    assert np.asarray(b.node_mask)[:n_total].all()
+    assert not np.asarray(b.node_mask)[n_total]
+    # graph 0 is the subgraph, graph 1 the padding graph
+    assert np.asarray(b.node_graph)[n_total] == 1
+    np.testing.assert_array_equal(np.asarray(b.graph_mask),
+                                  [True, False])
+    # masked fanout slots became padding self-edges; E = fanout + 1
+    E = 16 * 4 + 16 * 4 * 3 + 1
+    assert b.senders.shape == (E,)
+    em = np.asarray(b.edge_mask)
+    assert not em[-1]
+    dead = ~em
+    assert (np.asarray(b.senders)[dead] == N - 1).all()
+    assert (np.asarray(b.receivers)[dead] == N - 1).all()
+    # node_global maps every occurrence back to its global id
+    assert np.asarray(b.node_global)[-1] == len(x)
 
-    loader = NeighborSamplingLoader(x, senders, receivers, y, batch_size=32,
-                                    fanouts=(6, 6), seed=1)
-    params = {
-        "l0_self": jnp.asarray(np.random.RandomState(2).randn(4, 16) * 0.3),
-        "l0_nbr": jnp.asarray(np.random.RandomState(3).randn(4, 16) * 0.3),
-        "l1_self": jnp.asarray(np.random.RandomState(4).randn(16, 1) * 0.3),
-        "l1_nbr": jnp.asarray(np.random.RandomState(5).randn(16, 1) * 0.3),
-    }
 
-    def apply_layer(p, h_self, h_agg):
-        ws, wn = p
-        out = h_self @ ws + h_agg @ wn
-        return jax.nn.relu(out) if ws.shape[1] > 1 else out
+# ------------------------------------------- determinism + multi-rank --
+def test_seed_plan_pure_and_epoch_reseeds():
+    p0 = seed_plan(100, 0, 7)
+    assert np.array_equal(p0, seed_plan(100, 0, 7))
+    assert not np.array_equal(p0, seed_plan(100, 1, 7))
+    assert not np.array_equal(p0, seed_plan(100, 0, 8))
+    assert sorted(p0.tolist()) == list(range(100))
 
-    def loss_fn(params, feats, tables, targets):
-        out = sage_subgraph_forward(
-            apply_layer,
-            [(params["l0_self"], params["l0_nbr"]),
-             (params["l1_self"], params["l1_nbr"])],
-            feats, tables)
-        return jnp.mean((out - targets) ** 2)
 
+def test_loader_bitwise_deterministic_across_runs():
+    x, y, senders, receivers, _ = _big_graph()
+    a = _loader(x, y, senders, receivers)
+    b = _loader(x, y, senders, receivers)
+    a.set_epoch(3)
+    b.set_epoch(3)
+    for ba, bb in zip(a, b):
+        _batches_equal(ba, bb)
+    assert a.plan_fingerprint() == b.plan_fingerprint()
+
+
+def test_set_epoch_reseeds_order():
+    x, y, senders, receivers, _ = _big_graph()
+    loader = _loader(x, y, senders, receivers)
+    loader.set_epoch(0)
+    e0 = [np.asarray(b.node_global).copy() for b in loader]
+    loader.set_epoch(1)
+    e1 = [np.asarray(b.node_global).copy() for b in loader]
+    assert any(not np.array_equal(a, b) for a, b in zip(e0, e1))
+    loader.set_epoch(0)
+    for a, b in zip(e0, loader):
+        np.testing.assert_array_equal(a, np.asarray(b.node_global))
+
+
+def test_world_reslice_invariance():
+    """The union of every rank's batches at world=W is bitwise the
+    world=1 stream, batch-for-batch by GLOBAL index — re-slicing the
+    world re-distributes, never re-samples (the elastic contract)."""
+    x, y, senders, receivers, _ = _big_graph()
+    ref = _loader(x, y, senders, receivers)
+    ref.set_epoch(2)
+    got = {}
+    for r in range(3):
+        lr = _loader(x, y, senders, receivers, rank=r, world=3)
+        lr.set_epoch(2)
+        assert lr.plan_fingerprint() == ref.plan_fingerprint()
+        for gb, b in zip(lr.rank_batches(), lr):
+            got[gb] = b
+    assert sorted(got) == ref.rank_batches()
+    for gb, b in zip(ref.rank_batches(), ref):
+        _batches_equal(b, got[gb])
+    # disjoint cover: every global batch is built by exactly one rank
+    assert sum(len(_loader(x, y, senders, receivers, rank=r, world=3))
+               for r in range(3)) == len(ref)
+
+
+def test_batch_size_exceeding_seeds_actionable():
+    x, y, senders, receivers, _ = _big_graph(n=30)
+    with pytest.raises(ValueError, match="batch_size"):
+        _loader(x, y, senders, receivers, batch_size=64)
+
+
+# ------------------------------------------------ partitions + store --
+def test_partition_nodes_modes():
+    for mode in ("range", "hash"):
+        own = partition_nodes(100, 4, mode, seed=3)
+        assert own.shape == (100,)
+        assert set(np.unique(own)) <= set(range(4))
+        np.testing.assert_array_equal(
+            own, partition_nodes(100, 4, mode, seed=3))
+    # range mode is contiguous id blocks
+    rng_own = partition_nodes(100, 4, "range", seed=0)
+    assert (np.diff(rng_own) >= 0).all()
+    with pytest.raises(ValueError, match="partition mode"):
+        partition_nodes(100, 4, "metis", seed=0)
+    assert partition_fingerprint(100, 4, "range", 0) \
+        != partition_fingerprint(100, 4, "hash", 0)
+
+
+def test_feature_store_remote_byte_accounting():
+    from hydragnn_tpu.preprocess.sampling import NodeFeatureStore
+    x = np.ones((10, 4), np.float32)
+    y = np.ones((10, 1), np.float32)
+    owner = np.asarray([0] * 5 + [1] * 5, np.int32)
+    store = NodeFeatureStore(x, y, owner, rank=0)
+    store.gather_features(np.asarray([0, 1, 7]))
+    stats = store.fetch_stats()
+    assert stats["local_bytes"] == 2 * 16
+    assert stats["remote_bytes"] == 1 * 16
+
+
+def test_feature_store_cache_round_trip(tmp_path):
+    """build_cached writes the store into the content-addressed shard
+    cache; open_cached mmaps it back bitwise. The key folds graph +
+    partition identity, so either changing lands on a fresh key."""
+    from hydragnn_tpu.preprocess.cache import feature_store_key
+    from hydragnn_tpu.preprocess.sampling import NodeFeatureStore
+    rng = np.random.RandomState(0)
+    x = rng.randn(20, 3).astype(np.float32)
+    y = rng.randn(20, 2).astype(np.float32)
+    owner = partition_nodes(20, 2, "range", seed=0)
+    key = feature_store_key("graph-abc",
+                            partition_fingerprint(20, 2, "range", 0))
+    st = NodeFeatureStore.build_cached(str(tmp_path), key, x, y, owner)
+    np.testing.assert_array_equal(st.x, x)
+    reopened = NodeFeatureStore.open_cached(str(tmp_path), key, rank=1)
+    np.testing.assert_array_equal(reopened.x, x)
+    np.testing.assert_array_equal(reopened.y, y)
+    np.testing.assert_array_equal(reopened.owner, owner)
+    assert reopened.rank == 1
+    assert key != feature_store_key(
+        "graph-abc", partition_fingerprint(20, 4, "range", 0))
+    assert key != feature_store_key(
+        "graph-DIFFERENT", partition_fingerprint(20, 2, "range", 0))
+
+
+# --------------------------------------------------- historical cache --
+def test_hist_mode_halts_remote_beyond_hop0():
+    x, y, senders, receivers, rng = _big_graph()
+    csr = CSRGraph(senders, receivers, len(x))
+    owner = partition_nodes(len(x), 4, "range", seed=7)
+    seeds = np.arange(16)
+    sub = sample_khop_subgraph(csr, seeds, (4, 3), rng, owner=owner,
+                               rank=0, expand_remote=False)
+    # seeds are always expanded (hop-0 exactness)...
+    assert not sub.halted[:16].any()
+    # ...and some deeper remote occurrence was halted on this partition
+    assert sub.halted[16:].any()
+    # a halted occurrence's fanout row is fully masked (not expanded)
+    hop1 = sub.hop_tables[1][1]  # [B1, f1] mask
+    halted_hop1 = sub.halted[16:16 + 16 * 4]
+    assert not hop1[halted_hop1].any()
+
+
+def test_hist_k0_batches_match_exact_with_one_partition():
+    """partitions=1 means every node is local: hist mode halts nothing
+    and the sampled arrays equal the exact loader's bitwise — the
+    degrades-to-exact end of the staleness dial."""
+    x, y, senders, receivers, _ = _big_graph()
+    ex = _loader(x, y, senders, receivers, num_partitions=1)
+    hi = _loader(x, y, senders, receivers, num_partitions=1,
+                 staleness_k=4)
+    for be, bh in zip(ex, hi):
+        _batches_equal(be, bh)
+        assert not np.asarray(bh.hist_mask).any()
+
+
+def test_refresh_allowance_unique_and_deepest():
+    x, y, senders, receivers, rng = _big_graph()
+    csr = CSRGraph(senders, receivers, len(x))
+    owner = partition_nodes(len(x), 2, "range", seed=7)
+    sub = sample_khop_subgraph(csr, np.arange(8), (4, 3), rng,
+                               owner=owner, rank=0, expand_remote=False)
+    allow = refresh_allowance(sub, owner, rank=0, num_layers=2)
+    keep = allow >= 1
+    # unique scatter indices: at most one kept occurrence per global id
+    kept_ids = sub.node_ids[keep]
+    assert len(kept_ids) == len(np.unique(kept_ids))
+    # halted and remote occurrences never qualify
+    assert not (keep & sub.halted).any()
+    assert (owner[sub.node_ids[keep]] == 0).all()
+    # seeds (hop 0) hold the deepest allowance: min(L - 0, L - 1)
+    assert (allow[:8][keep[:8]] == 1).all()
+
+
+def test_init_hist_tables_layout():
+    x = np.random.RandomState(0).randn(10, 3).astype(np.float32)
+    t = init_hist_tables(x, hidden_dim=8, num_layers=3)
+    assert t.feat.shape == (11, 3)       # + scatter-dump row
+    assert t.layers.shape == (2, 11, 8)  # L-1 stale tables
+    assert t.versions.shape == (11,)
+    np.testing.assert_array_equal(np.asarray(t.feat[:10]), x)
+    assert not np.asarray(t.feat[10]).any()
+
+
+# ------------------------------------------------------------- knobs --
+def test_resolve_sampling_precedence(monkeypatch):
+    from hydragnn_tpu.utils.envflags import resolve_sampling
+    for var in ("HYDRAGNN_SAMPLE_FANOUTS", "HYDRAGNN_SAMPLE_STALENESS_K",
+                "HYDRAGNN_SAMPLE_PARTITIONS"):
+        monkeypatch.delenv(var, raising=False)
+    # defaults
+    assert resolve_sampling(None) == ((8, 8), 0, 1, "range")
+    # config block beats defaults
+    block = {"Sampling": {"fanouts": [10, 5], "staleness_k": 8,
+                          "partitions": 4, "partition_mode": "hash"}}
+    assert resolve_sampling(block) == ((10, 5), 8, 4, "hash")
+    # env beats the block
+    monkeypatch.setenv("HYDRAGNN_SAMPLE_FANOUTS", "6,2,2")
+    monkeypatch.setenv("HYDRAGNN_SAMPLE_STALENESS_K", "32")
+    monkeypatch.setenv("HYDRAGNN_SAMPLE_PARTITIONS", "8")
+    assert resolve_sampling(block) == ((6, 2, 2), 32, 8, "hash")
+
+
+def test_resolve_sampling_typo_warns_falls_back(monkeypatch, caplog):
+    from hydragnn_tpu.utils.envflags import resolve_sampling
+    block = {"Sampling": {"fanouts": [10, 5], "staleness_k": 8,
+                          "partitions": 4}}
+    monkeypatch.setenv("HYDRAGNN_SAMPLE_FANOUTS", "8,banana")
+    monkeypatch.setenv("HYDRAGNN_SAMPLE_STALENESS_K", "eight")
+    monkeypatch.setenv("HYDRAGNN_SAMPLE_PARTITIONS", "-3")
+    with caplog.at_level(logging.WARNING, logger="hydragnn_tpu"):
+        fanouts, k, parts, mode = resolve_sampling(block)
+    # a typo warns and falls back to the block value, never crashes
+    # and never silently installs a surprise
+    assert fanouts == (10, 5)
+    assert k == 8
+    assert parts >= 1
+    assert "HYDRAGNN_SAMPLE_FANOUTS" in caplog.text
+    assert "HYDRAGNN_SAMPLE_STALENESS_K" in caplog.text
+
+
+# ----------------------------------------------- jitted step (fast) --
+def _small_model_and_batchstream(staleness_k=0, n=120, hidden=8):
     import optax
-    tx = optax.adam(3e-3)
-    opt = tx.init(params)
-    losses = []
-    for epoch in range(30):
+
+    from hydragnn_tpu.config.config import HeadConfig, ModelConfig
+    from hydragnn_tpu.models import create_model, init_params
+    from hydragnn_tpu.train.train_step import (TrainState,
+                                               make_sampled_train_step)
+    x, y, senders, receivers, _ = _big_graph(n=n)
+    loader = _loader(x, y, senders, receivers, batch_size=8,
+                     fanouts=(3, 2), num_partitions=2,
+                     staleness_k=staleness_k)
+    cfg = ModelConfig(
+        model_type="SAGE", input_dim=x.shape[1], hidden_dim=hidden,
+        num_conv_layers=2,
+        heads=(HeadConfig(head_type="node", output_dim=3, offset=0,
+                          dim_headlayers=(8,), node_arch="mlp"),),
+        output_dim=(3,), output_type=("node",), task_weights=(1.0,))
+    model = create_model(cfg)
+    tx = optax.adam(1e-2)
+    first = next(iter(loader))
+    init_b = first
+    if staleness_k > 0:
+        init_b = first.replace(
+            hist_states=jnp.zeros((1, first.x.shape[0], hidden)))
+    variables = init_params(model, init_b, seed=0)
+    state = TrainState.create(variables, tx)
+    step = make_sampled_train_step(model, cfg, tx, loss_name="ce",
+                                   staleness_k=staleness_k)
+    return x, loader, cfg, state, step, hidden
+
+
+def test_sampled_train_step_one_compile():
+    from hydragnn_tpu.utils.profiling import jit_cache_total
+    _, loader, _, state, step, _ = _small_model_and_batchstream()
+    for epoch in range(2):
         loader.set_epoch(epoch)
-        tot, nb = 0.0, 0
-        for feats, tables, targets in loader:
-            val, grads = jax.value_and_grad(loss_fn)(
-                params, feats, tables, jnp.asarray(targets))
-            upd, opt = tx.update(grads, opt, params)
-            params = optax.apply_updates(params, upd)
-            tot += float(val)
-            nb += 1
-        losses.append(tot / nb)
-    assert losses[-1] < losses[0] * 0.2, losses[::10]
+        for b in loader:
+            state, metrics = step(state, b)
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(state.step) == 2 * len(loader)
+    # ONE compile across epochs — the fixed-shape contract
+    assert jit_cache_total(step) == 1
+
+
+def test_sampled_hist_step_refresh_and_k_not_traced():
+    """K never enters the trace: the refresh decision is a TRACED bool
+    through lax.cond, so flipping cadence cannot recompile; refreshed
+    rows carry version stamps."""
+    from hydragnn_tpu.utils.profiling import jit_cache_total
+    x, loader, cfg, state, step, hidden = _small_model_and_batchstream(
+        staleness_k=4)
+    tables = init_hist_tables(x, hidden, cfg.num_conv_layers)
+    for i, b in enumerate(loader):
+        # alternate cadence mid-run — same compiled program
+        state, tables, metrics = step(state, b, tables,
+                                      jnp.asarray(i % 2 == 0))
+    assert jit_cache_total(step) == 1
+    assert float(metrics["hist_frac"]) >= 0.0
+    vers = np.asarray(tables.versions)
+    # refreshes landed on REAL rows (the dump row also gets stamped —
+    # it absorbs non-qualifying scatters and is never read live)
+    assert (vers[:-1] > 0).any()
+
+
+@pytest.mark.slow
+def test_sampled_training_learns_and_eval_exact():
+    """Multi-epoch sampled training on the homophilous synthetic ogbn
+    graph beats chance by a wide margin, exact and stale arms both."""
+    import optax
+
+    from examples.ogbn.ogbn_data import synthetic_arxiv
+    from hydragnn_tpu.config.config import HeadConfig, ModelConfig
+    from hydragnn_tpu.models import create_model, init_params
+    from hydragnn_tpu.train.train_step import (TrainState,
+                                               make_sampled_eval_step,
+                                               make_sampled_train_step)
+    g = synthetic_arxiv(num_nodes=600, seed=0)
+    y = g.y_onehot
+    cfg = ModelConfig(
+        model_type="SAGE", input_dim=g.x.shape[1], hidden_dim=32,
+        num_conv_layers=2,
+        heads=(HeadConfig(head_type="node", output_dim=g.num_classes,
+                          offset=0, dim_headlayers=(32, 32),
+                          node_arch="mlp"),),
+        output_dim=(g.num_classes,), output_type=("node",),
+        task_weights=(1.0,))
+    model = create_model(cfg)
+    tx = optax.adam(3e-3)
+    val = g.val_idx[:len(g.val_idx) // 32 * 32]
+    val_loader = NeighborSamplingLoader(
+        x=g.x, y_node=y, senders=g.senders, receivers=g.receivers,
+        train_nodes=val, batch_size=32, fanouts=(8, 4), shuffle=False,
+        seed=0, async_workers=0)
+    eval_step = make_sampled_eval_step(model, cfg, loss_name="ce")
+    for k in (0, 4):
+        loader = NeighborSamplingLoader(
+            x=g.x, y_node=y, senders=g.senders, receivers=g.receivers,
+            train_nodes=g.train_idx, batch_size=32, fanouts=(8, 4),
+            seed=0, num_partitions=4, staleness_k=k, async_workers=0)
+        first = next(iter(loader))
+        init_b = (first if k == 0 else first.replace(
+            hist_states=jnp.zeros((1, first.x.shape[0], 32))))
+        state = TrainState.create(init_params(model, init_b, seed=0), tx)
+        step = make_sampled_train_step(model, cfg, tx, loss_name="ce",
+                                       staleness_k=k)
+        tables = init_hist_tables(g.x, 32, 2) if k else None
+        for epoch in range(4):
+            loader.set_epoch(epoch)
+            for i, b in enumerate(loader):
+                if k:
+                    state, tables, _ = step(
+                        state, b, tables,
+                        jnp.asarray((epoch * len(loader) + i) % k == 0))
+                else:
+                    state, _ = step(state, b)
+        corr = cnt = 0.0
+        for b in val_loader:
+            m, _ = eval_step(state, b)
+            corr += float(m["correct"])
+            cnt += float(m["count"])
+        acc = corr / max(cnt, 1.0)
+        assert acc > 0.5, (k, acc)  # chance is 1/8
+        if k:
+            # the stale arm moved real bytes off the interconnect
+            assert loader.fetch_stats()["remote_bytes_per_batch"] > 0
+
+
+@pytest.mark.slow
+def test_async_sampling_overlap_stats():
+    x, y, senders, receivers, _ = _big_graph(n=400)
+    loader = _loader(x, y, senders, receivers, batch_size=16,
+                     async_workers=2)
+    for epoch in range(2):
+        loader.set_epoch(epoch)
+        for _ in loader:
+            pass
+    frac = loader.sampler_overlap_frac()
+    assert 0.0 <= frac <= 1.0
+    stats = loader.fetch_stats()
+    assert stats["batches"] == 2 * len(loader)
+    assert stats["sampler_overlap_frac"] == frac

@@ -98,6 +98,13 @@ TRACED_FILES = (
     # programs — an env read here would be the same trace-time-frozen
     # bug class, so it must go through utils/envflags like the kernels
     os.path.join("hydragnn_tpu", "train", "precision.py"),
+    # the sampled-training pipeline: its knobs (fanouts, staleness_k,
+    # partitions) determine every compiled shape of the run and the
+    # training mathematics — they resolve ONCE through
+    # utils/envflags.resolve_sampling at loader construction; an env
+    # read here would fork the one-compile contract from a typo
+    # (docs/sampling.md)
+    os.path.join("hydragnn_tpu", "preprocess", "sampling.py"),
 )
 
 MESSAGE = ("read inside a traced module — resolve it via utils/envflags.py "
