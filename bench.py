@@ -207,6 +207,35 @@ Env knobs:
                        per-job bound on the elastic leg (default 900)
   BENCH_SAMPLE_OUT     also write the JSON to this path (the nightly
                        sample-bench job emits BENCH_SAMPLE.json)
+  BENCH_GFM            =1: pod-scale multi-dataset GFM mixture training
+                       (docs/gfm.md) — five legs on the synthetic
+                       3-member mixture examples/gfm trains: ONE
+                       compile for a 2-member then a 3-member mixture
+                       through a shared pinned pack budget (adding a
+                       dataset adds ZERO compiles, probed via the jit
+                       cache); every head's val loss improves over the
+                       run; the head-masked step is BITWISE equal to
+                       the plain multihead step under one-hot head
+                       weights on dyadic data; mixture throughput vs
+                       the sequential per-dataset baseline (three
+                       loaders, three jitted steps) on identical
+                       samples >= BENCH_GFM_MIN_SPEEDUP; and an elastic
+                       leg running examples.gfm.train_gfm under the
+                       JobSupervisor with an injected rank-kill —
+                       resumed history + final params must equal an
+                       uninterrupted twin bitwise, one plan_fp across
+                       generations, zero orphaned process groups
+  BENCH_GFM_SIZES      per-member sample counts (default "48,32,40")
+  BENCH_GFM_BATCH / BENCH_GFM_EPOCHS
+                       mixture batch size and epochs (default 8 / 3)
+  BENCH_GFM_MIN_SPEEDUP
+                       required mixture-vs-sequential throughput ratio
+                       (default 1.3)
+  BENCH_GFM_ELASTIC_EPOCHS / BENCH_GFM_DEADLINE_S
+                       elastic-leg epochs and per-job bound
+                       (default 3 / 900)
+  BENCH_GFM_OUT        also write the JSON to this path (the nightly
+                       gfm-bench job emits BENCH_GFM.json)
   BENCH_PREPROC        =1: preprocessing mode (docs/preprocessing.md) —
                        vectorized neighbor-construction throughput
                        (atoms/s, edges/s, speedup vs the embedded seed
@@ -2627,6 +2656,383 @@ def run_bench_sample(backend=None):
     return out
 
 
+def run_bench_gfm(backend=None):
+    """BENCH_GFM: pod-scale multi-dataset GFM mixture training
+    (docs/gfm.md). Five legs over the example's own synthetic 3-member
+    mixture (examples/gfm/gfm_data.py + gfm_mixture.json — the bench
+    adjudicates exactly what ``examples.gfm.train_gfm`` runs):
+
+      * ONE COMPILE / ZERO ADDED COMPILES: a 2-member mixture and then
+        the full 3-member mixture train through the SAME jitted step
+        under ONE pinned pack budget (the union histogram's) — the jit
+        cache must hold exactly 1 entry after BOTH phases: adding a
+        member dataset changes the data, never the compiled program.
+      * LEARNING: per-head (= per member) val losses over the mixture
+        run — every head's final val loss must improve on its first
+        epoch (the shared stack learns every member, none is starved).
+      * PARITY: the head-masked step vs the plain multihead step on the
+        SAME single-member batch (dataset_id set vs None) under one-hot
+        head weights, on dyadic (exactly-representable) data — updated
+        params and the supervised head's loss must be BITWISE equal,
+        per member. The weighted-sum combine is the documented
+        reassociation boundary; one-hot weights make the foreign heads'
+        contributions exact zeros, so nothing else may differ.
+      * THROUGHPUT: the one-step mixture epoch vs the sequential
+        per-dataset baseline (three per-member packed loaders, three
+        separately-jitted steps — the pre-GFM regime) over IDENTICAL
+        samples, wall-clock INCLUDING compiles; mixture graphs/s must
+        be >= BENCH_GFM_MIN_SPEEDUP x sequential (CPU-honest: the win
+        is one compile + union-histogram packing, both backend-
+        independent).
+      * ELASTIC: examples.gfm.train_gfm as a supervised job
+        (JobSupervisor + a real child process), an injected rank-kill
+        at the first committed checkpoint; the resumed run must match
+        an uninterrupted twin BITWISE (history AND final-params
+        sha256), one plan_fp across generations (the fingerprint folds
+        the mixture spec), zero orphaned process groups."""
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+    import optax
+
+    from examples.gfm.gfm_data import build_members, split_members
+    from hydragnn_tpu.config.config import build_model_config, update_config
+    from hydragnn_tpu.datasets.loader import GraphDataLoader
+    from hydragnn_tpu.models import create_model, init_params
+    from hydragnn_tpu.parallel.multidataset import GfmMixtureLoader
+    from hydragnn_tpu.train.gfm import (GfmEpochAccumulator,
+                                        apply_head_weights,
+                                        make_gfm_eval_step,
+                                        make_gfm_train_step)
+    from hydragnn_tpu.train.train_step import (TrainState, make_train_step)
+    from hydragnn_tpu.utils.envflags import (env_str, env_strict_float,
+                                             env_strict_int, resolve_gfm)
+
+    if backend is None:
+        backend = _resolve_backend_and_cache()
+    sizes = [int(v) for v in env_str("BENCH_GFM_SIZES",
+                                     "48,32,40").split(",")]
+    batch_size = env_strict_int("BENCH_GFM_BATCH", 8)
+    num_epochs = env_strict_int("BENCH_GFM_EPOCHS", 3)
+    elastic_epochs = env_strict_int("BENCH_GFM_ELASTIC_EPOCHS", 3)
+    deadline_s = env_strict_float("BENCH_GFM_DEADLINE_S", 900.0)
+    min_speedup = env_strict_float("BENCH_GFM_MIN_SPEEDUP", 1.3)
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "examples", "gfm",
+                           "gfm_mixture.json")) as f:
+        config = json.load(f)
+    train_cfg = config["NeuralNetwork"]["Training"]
+    mixture, head_weights = resolve_gfm(train_cfg)
+
+    members = build_members(sizes=sizes, seed=0)
+    train_members, val_members = split_members(members)
+    names = sorted(train_members)
+    all_train = [s for v in train_members.values() for s in v]
+    config = update_config(config, all_train)
+    mcfg = build_model_config(config)
+    model = create_model(mcfg)
+    tx = optax.adam(3e-3)
+
+    # the ONE shared pack budget: derived from the full 3-member union
+    # histogram and pinned EXTERNALLY, so the 2-member phase compiles
+    # the exact shapes the 3-member phase reuses
+    union_loader = GfmMixtureLoader(train_members, batch_size, cfg=mcfg,
+                                    weights=mixture, seed=0)
+    budget = union_loader.pack_budget
+    plan_fp = union_loader.global_plan_fingerprint()
+
+    step = make_gfm_train_step(model, mcfg, tx,
+                               head_weights=head_weights,
+                               num_datasets=len(names))
+    eval_step = make_gfm_eval_step(model, mcfg,
+                                   head_weights=head_weights,
+                                   num_datasets=len(names))
+
+    # ---- phase 1: 2-member mixture through the shared budget ---------
+    two_members = {n: train_members[n] for n in names[:2]}
+    loader2 = GfmMixtureLoader(two_members, batch_size, seed=0,
+                               pack_budget=budget)
+    loader2.set_epoch(0)
+    first = next(iter(loader2))
+    variables = init_params(model, first, seed=0)
+    state = TrainState.create(variables, tx)
+    t0 = time.perf_counter()
+    for b in loader2:
+        state, m = step(state, b)
+    jax.block_until_ready(m["loss"])
+    compiles_after_two = _jit_cache(step)
+
+    # ---- phase 2: add the third dataset — ZERO new compiles ----------
+    loader3 = GfmMixtureLoader(train_members, batch_size, cfg=mcfg,
+                               weights=mixture, seed=0,
+                               pack_budget=budget)
+    vloader = GfmMixtureLoader(val_members, batch_size, seed=0,
+                               pack_budget=budget)
+    per_head_val = []
+    mix_graphs = 0
+    for epoch in range(num_epochs):
+        loader3.set_epoch(epoch)
+        acc = GfmEpochAccumulator(names)
+        for b in loader3:
+            state, m = step(state, b)
+            acc.update(b, m)
+        mix_graphs += acc.total_graphs
+        vloader.set_epoch(0)
+        vacc = GfmEpochAccumulator(names)
+        for b in vloader:
+            mv, _ = eval_step(state, b)
+            vacc.update(b, mv)
+        per_head_val.append(vacc.summary()["head_losses"])
+    jax.block_until_ready(state.params)
+    mixture_s = time.perf_counter() - t0
+    mixture_frac = acc.summary()["mixture_frac"]
+    compiles_after_three = _jit_cache(step)
+    one_compile = compiles_after_two == 1
+    added_compiles = compiles_after_three - compiles_after_two
+    heads_improved = all(per_head_val[-1][n] < per_head_val[0][n]
+                         for n in names)
+
+    # ---- parity: masked step vs plain step, one-hot weights, dyadic --
+    from hydragnn_tpu.graphs import BucketSpec, collate
+    dyadic = build_members(sizes=[8, 8, 8], seed=1, dyadic=True)
+    parity = []
+    for d, name in enumerate(sorted(dyadic)):
+        onehot = tuple(1.0 if i == d else 0.0 for i in range(len(names)))
+        cfg_d = apply_head_weights(mcfg, onehot)
+        step_d = make_train_step(model, cfg_d, tx, donate=False)
+        b = collate(dyadic[name], bucket=BucketSpec(multiple=64))
+        ids = np.where(np.asarray(b.graph_mask),
+                       np.int32(d), np.int32(-1))
+        b_gfm = b.replace(dataset_id=ids)
+        s0 = TrainState.create(init_params(model, b, seed=2), tx)
+        s_gfm, m_gfm = step_d(s0, b_gfm)
+        s_plain, m_plain = step_d(s0, b)
+        leaves_g = jax.tree_util.tree_leaves(s_gfm.params)
+        leaves_p = jax.tree_util.tree_leaves(s_plain.params)
+        params_bitwise = all(
+            np.array_equal(np.asarray(a), np.asarray(c))
+            for a, c in zip(leaves_g, leaves_p))
+        loss_bitwise = bool(np.asarray(m_gfm[f"task_{d}"])
+                            == np.asarray(m_plain[f"task_{d}"]))
+        parity.append({"member": name,
+                       "params_bitwise": bool(params_bitwise),
+                       "head_loss_bitwise": loss_bitwise})
+    parity_ok = all(p["params_bitwise"] and p["head_loss_bitwise"]
+                    for p in parity)
+
+    # ---- throughput: one-step mixture vs sequential per-dataset ------
+    # identical samples both sides (size-proportional quotas = one full
+    # pass over every member per epoch); both sides pay their compiles
+    # inside the timed window — the sequential regime pays THREE (one
+    # per one-hot config) plus per-member packing, the mixture ONE
+    mix_state = TrainState.create(init_params(model, first, seed=3), tx)
+    tput_loader = GfmMixtureLoader(train_members, batch_size, cfg=mcfg,
+                                   seed=1)
+    tput_step = make_gfm_train_step(model, mcfg, tx,
+                                    num_datasets=len(names))
+    t0 = time.perf_counter()
+    mix_count = 0
+    for epoch in range(num_epochs):
+        tput_loader.set_epoch(epoch)
+        acc = GfmEpochAccumulator(names)
+        for b in tput_loader:
+            mix_state, m = tput_step(mix_state, b)
+            acc.update(b, m)
+        mix_count += acc.total_graphs
+    jax.block_until_ready(mix_state.params)
+    mix_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    seq_count = 0
+    for d, name in enumerate(names):
+        onehot = tuple(1.0 if i == d else 0.0 for i in range(len(names)))
+        cfg_d = apply_head_weights(mcfg, onehot)
+        step_d = make_train_step(model, cfg_d, tx)
+        loader_d = GraphDataLoader(train_members[name], batch_size,
+                                   shuffle=True, seed=1, packing=True)
+        sd = TrainState.create(init_params(model, first, seed=3), tx)
+        for epoch in range(num_epochs):
+            loader_d.set_epoch(epoch)
+            for b in loader_d:
+                sd, m = step_d(sd, b)
+                seq_count += int(np.asarray(b.graph_mask).sum())
+        jax.block_until_ready(sd.params)
+    seq_s = time.perf_counter() - t0
+    mix_gps = mix_count / max(mix_s, 1e-9)
+    seq_gps = seq_count / max(seq_s, 1e-9)
+    speedup = mix_gps / max(seq_gps, 1e-9)
+
+    # ---- elastic leg: the example as a supervised job, kill vs twin --
+    from hydragnn_tpu.elastic import COMPLETED, JobLedger, JobSupervisor
+    from hydragnn_tpu.elastic.process import (RankProcessHandle,
+                                              _child_env, free_port)
+    from hydragnn_tpu.utils.envflags import resolve_elastic
+    from hydragnn_tpu.utils.faults import (install_fault_plan,
+                                           parse_fault_plan)
+
+    max_restarts, heartbeat_s, backoff_s = resolve_elastic(
+        {"max_restarts": 3, "heartbeat_s": 60.0, "backoff_s": 0.2})
+
+    class GfmJobLauncher:
+        """launch_fn for JobSupervisor: examples.gfm.train_gfm as the
+        child rank — the elastic leg runs the REAL example."""
+
+        def __init__(self, job_dir):
+            self.job_dir = os.path.abspath(job_dir)
+            self.handles = []
+
+        def __call__(self, generation, world_size, rank, resume, hang):
+            os.makedirs(self.job_dir, exist_ok=True)
+            cmd = [sys.executable, "-m", "examples.gfm.train_gfm",
+                   "--rank", str(int(rank)),
+                   "--world", str(int(world_size)),
+                   "--num-epochs", str(elastic_epochs),
+                   "--batch-size", str(batch_size),
+                   "--job-dir", self.job_dir]
+            if resume:
+                cmd.append("--resume")
+            log_path = os.path.join(self.job_dir,
+                                    f"rank_{int(rank)}.log")
+            with open(log_path, "ab") as out:
+                proc = subprocess.Popen(
+                    cmd, cwd=self.job_dir, stdout=out,
+                    stderr=subprocess.STDOUT,
+                    env=_child_env(rank, world_size, 1, free_port(),
+                                   120.0),
+                    start_new_session=True)
+            handle = RankProcessHandle(proc, self.job_dir, log_path)
+            self.handles.append(handle)
+            return handle
+
+        def live_process_groups(self):
+            return [h.proc.pid for h in self.handles if h.group_alive()]
+
+    def _gfm_plan_fps(job_dir):
+        fps = []
+        for fname in sorted(os.listdir(job_dir)):
+            if not fname.startswith("rank_"):
+                continue
+            try:
+                with open(os.path.join(job_dir, fname)) as f:
+                    for line in f:
+                        if "plan_fp=" in line:
+                            fps.append(
+                                line.split("plan_fp=")[1].split()[0])
+            except OSError:
+                continue
+        return fps
+
+    def _run_job(job_dir, plan_spec, schedule):
+        launcher = GfmJobLauncher(job_dir)
+        install_fault_plan(parse_fault_plan(plan_spec)
+                           if plan_spec else None)
+        ledger = JobLedger()
+        sup = JobSupervisor(
+            launcher, world_size=schedule[0], world_schedule=schedule,
+            max_restarts=max_restarts, heartbeat_s=heartbeat_s,
+            backoff_s=backoff_s, poll_interval_s=0.2, ledger=ledger)
+        rec = sup.run(deadline_s=deadline_s)
+        install_fault_plan(None)
+        return rec, ledger, launcher.live_process_groups()
+
+    t_el = time.perf_counter()
+    dirs = {name: tempfile.mkdtemp(prefix=f"bench_gfm_{name}_")
+            for name in ("kill", "twin")}
+    try:
+        kill_rec, kill_led, kill_orphans = _run_job(
+            dirs["kill"], "rank-kill@0", [1, 1])
+        twin_rec, _, twin_orphans = _run_job(dirs["twin"], "", [1])
+        results = {}
+        for name, d in dirs.items():
+            try:
+                with open(os.path.join(d, "result.json")) as f:
+                    results[name] = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                results[name] = None
+        fps = {name: _gfm_plan_fps(d) for name, d in dirs.items()}
+    finally:
+        install_fault_plan(None)
+        for d in dirs.values():
+            shutil.rmtree(d, ignore_errors=True)
+    elastic_s = time.perf_counter() - t_el
+
+    r_kill, r_twin = results["kill"], results["twin"]
+    kill_landed = len([e for e in kill_led.data_view()
+                       if e["event"] == "killed"])
+    elastic_bitwise = (
+        r_kill is not None and r_twin is not None
+        and r_kill["history"] == r_twin["history"]
+        and r_kill["param_digest"] == r_twin["param_digest"])
+    all_fps = sorted({fp for f in fps.values() for fp in f})
+    # the kill job prints plan_fp once per generation (>= 2: original +
+    # resumed); ONE distinct value across all jobs and generations is
+    # the mixture-plan re-slice contract
+    plan_fp_consistent = (len(all_fps) == 1 and len(fps["kill"]) >= 2
+                          and len(fps["twin"]) >= 1)
+    orphans = kill_orphans + twin_orphans
+
+    passed = (bool(one_compile) and added_compiles == 0
+              and bool(heads_improved) and bool(parity_ok)
+              and speedup >= min_speedup
+              and kill_rec.state == COMPLETED and kill_rec.restarts >= 1
+              and kill_landed >= 1 and twin_rec.state == COMPLETED
+              and bool(elastic_bitwise) and plan_fp_consistent
+              and not orphans)
+    out = {
+        "metric": "gfm_mixture_training",
+        "value": 1.0 if passed else 0.0,
+        "unit": "pass",
+        "vs_baseline": round(speedup, 3),
+        "backend": backend,
+        "members": names,
+        "sizes": sizes,
+        "batch_size": batch_size,
+        "epochs": num_epochs,
+        "pack_budget": {"n_node": int(budget.n_node),
+                        "n_edge": int(budget.n_edge),
+                        "n_graph": int(budget.n_graph)},
+        "plan_fp": plan_fp,
+        "mixture_weights": mixture,
+        "mixture_frac_measured": {k: round(v, 4)
+                                  for k, v in mixture_frac.items()},
+        "one_compile": bool(one_compile),
+        "compiles_after_two_datasets": compiles_after_two,
+        "compiles_after_three_datasets": compiles_after_three,
+        "added_compiles_for_new_dataset": added_compiles,
+        "per_head_val_first": {k: round(float(v), 5)
+                               for k, v in per_head_val[0].items()},
+        "per_head_val_final": {k: round(float(v), 5)
+                               for k, v in per_head_val[-1].items()},
+        "per_head_val_improved": bool(heads_improved),
+        "parity": parity,
+        "parity_bitwise": bool(parity_ok),
+        "mixture_graphs_per_s": round(mix_gps, 1),
+        "sequential_graphs_per_s": round(seq_gps, 1),
+        "throughput_speedup": round(speedup, 3),
+        "min_speedup": min_speedup,
+        "elastic_job": {
+            "kill_state": kill_rec.state,
+            "kill_restarts": kill_rec.restarts,
+            "injected_kills_landed": kill_landed,
+            "twin_state": twin_rec.state,
+            "trajectory_bitwise_equal": bool(elastic_bitwise),
+            "plan_fp_consistent": plan_fp_consistent,
+            "plan_fps": fps,
+            "zero_orphans": not orphans,
+            "elapsed_s": round(elastic_s, 2),
+        },
+        "mixture_train_s": round(mixture_s, 2),
+    }
+    out_path = os.environ.get("BENCH_GFM_OUT", "").strip()
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1)
+    return out
+
+
 # ---- seed neighbor-construction implementations (pre-fast-path), kept
 # here verbatim as the BENCH_PREPROC baseline so the reported speedup is
 # measured against the exact code this PR replaced, not a strawman ----
@@ -3516,6 +3922,8 @@ def main():
         out = run_bench_elastic()
     elif os.environ.get("BENCH_SAMPLE") == "1":
         out = run_bench_sample()
+    elif os.environ.get("BENCH_GFM") == "1":
+        out = run_bench_gfm()
     elif os.environ.get("BENCH_MD") == "1":
         _pin_cpu_host_threads()
         out = run_bench_md()

@@ -1,0 +1,113 @@
+"""Synthetic member datasets for the GFM mixture example (docs/gfm.md).
+
+Three deterministic BCC-lattice graph datasets — "alpha", "beta",
+"gamma" — each supervising a DIFFERENT polynomial of the nodal feature,
+standing in for the multi-source atomistic mixtures of the reference's
+GFM runs (examples/multidataset): same input modality, disjoint label
+spaces. Labels are widened to the UNION layout: ``y_graph`` has one
+column per member and member ``i`` fills only column ``i`` — head ``i``
+of the shared model reads exactly that column (HeadConfig offset
+``i``), and the head-masked step restricts head ``i``'s loss to member
+``i``'s graphs, so the zero-filled foreign columns are never trained
+on.
+
+Self-contained generator (the hpo/runner.py recipe): examples never
+import the test tree.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# member name -> coefficients (a, b, c) of the graph target
+# sum_n(a*x + b*x^2 + c*x^3); order here is ALPHABETICAL on purpose —
+# it matches the sorted member order the mixture loader pins, so
+# "column i" and "head i" and "dataset_id i" all mean the same member.
+MEMBER_SPECS: Tuple[Tuple[str, Tuple[float, float, float]], ...] = (
+    ("alpha", (1.0, 1.0, 1.0)),
+    ("beta", (2.0, -1.0, 0.0)),
+    ("gamma", (0.0, 1.0, -2.0)),
+)
+
+
+def _bcc_samples(num_configs: int, coeffs: Tuple[float, float, float],
+                 column: int, num_columns: int, seed: int,
+                 dyadic: bool = False) -> List:
+    """One member's samples: random BCC supercells, nodal feature
+    x = (type+1)/num_types, graph target sum(a*x + b*x^2 + c*x^3) in
+    union column `column`. With ``dyadic`` every feature and target is
+    a multiple of 2^-6 — exactly representable in float32, so sums are
+    exact and the bench's bitwise parity leg has no rounding to hide
+    behind."""
+    from hydragnn_tpu.graphs import GraphSample, radius_graph
+
+    rng = np.random.RandomState(int(seed))
+    a, b, c = coeffs
+    graphs, targets = [], []
+    for _ in range(int(num_configs)):
+        ucx, ucy = rng.randint(1, 4), rng.randint(1, 4)
+        ucz = rng.randint(1, 3)
+        pos = []
+        for ix in range(ucx):
+            for iy in range(ucy):
+                for iz in range(ucz):
+                    pos.append([ix, iy, iz])
+                    pos.append([ix + 0.5, iy + 0.5, iz + 0.5])
+        pos = np.asarray(pos, dtype=np.float32)
+        types = np.arange(pos.shape[0]) % 3
+        x = (types.astype(np.float32) + 1.0) / 3.0
+        if dyadic:
+            x = np.round(x * 64.0) / 64.0
+        send, recv = radius_graph(pos, 1.0, 100)
+        graphs.append((x, pos, send, recv))
+        targets.append(float((a * x + b * x ** 2 + c * x ** 3).sum()))
+    # per-member minmax normalization (the reference's minmax pipeline):
+    # without it the members' raw scales differ by orders of magnitude
+    # and the small-scale heads drown in the combined loss
+    t = np.asarray(targets, np.float64)
+    lo, hi = float(t.min()), float(t.max())
+    t = (t - lo) / max(hi - lo, 1e-12)
+    if dyadic:
+        t = np.round(t * 64.0) / 64.0
+    samples = []
+    for (x, pos, send, recv), target in zip(graphs, t):
+        y = np.zeros(num_columns, np.float32)
+        y[column] = target
+        samples.append(GraphSample(
+            x=x[:, None], pos=pos, senders=send, receivers=recv,
+            y_graph=y))
+    return samples
+
+
+def build_members(sizes: Optional[Sequence[int]] = None, seed: int = 0,
+                  dyadic: bool = False) -> Dict[str, List]:
+    """The example's member datasets: name -> samples with union-widened
+    labels. ``sizes`` gives per-member sample counts in MEMBER_SPECS
+    order (default 48/32/40 — unequal on purpose, so size-proportional
+    vs weighted mixtures differ observably)."""
+    if sizes is None:
+        sizes = (48, 32, 40)
+    if len(sizes) != len(MEMBER_SPECS):
+        raise ValueError(
+            f"got {len(sizes)} sizes for {len(MEMBER_SPECS)} members")
+    members = {}
+    for i, (name, coeffs) in enumerate(MEMBER_SPECS):
+        members[name] = _bcc_samples(
+            int(sizes[i]), coeffs, i, len(MEMBER_SPECS),
+            seed=int(seed) + 100 * (i + 1), dyadic=dyadic)
+    return members
+
+
+def split_members(members: Dict[str, List], val_frac: float = 0.2
+                  ) -> Tuple[Dict[str, List], Dict[str, List]]:
+    """Deterministic per-member train/val split: the LAST
+    ceil(val_frac*n) samples of each member are validation (generation
+    order is already seeded-random, so a suffix split is unbiased and
+    needs no extra RNG state to replay across elastic restarts)."""
+    train, val = {}, {}
+    for name, samples in members.items():
+        k = max(int(np.ceil(len(samples) * float(val_frac))), 1)
+        train[name] = samples[:-k]
+        val[name] = samples[-k:]
+    return train, val

@@ -1,0 +1,242 @@
+"""Pod-scale multi-dataset GFM mixture training (docs/gfm.md):
+``python -m examples.gfm.train_gfm``.
+
+Drives the whole GFM subsystem end to end on the synthetic 3-member
+mixture (gfm_data.py): the deterministic global mixture pack plan
+(GfmMixtureLoader — ONE compiled train step for the run, every epoch,
+every member), the head-masked multi-task step (head i supervised only
+by member i's graphs), strict knob resolution
+(envflags.resolve_gfm: HYDRAGNN_GFM_* over the config's Training.Gfm
+block), and per-head telemetry (telemetry.record_gfm_epoch + the epoch
+JSONL ``data`` bucket when a telemetry session is on).
+
+It doubles as the ELASTIC RANK CHILD for BENCH_GFM's kill-resume leg
+(the elastic/runner.py contract, same shape as examples/ogbn): a
+first-print heartbeat before heavy imports, an alive ticker, per-epoch
+COMMITTED checkpoints under ``--job-dir``, ``--resume`` restoring from
+LATEST and replaying the epoch plan deterministically, ``plan_fp=``
+printed for cross-generation adjudication (the GFM fingerprint folds
+the mixture spec — members, weights, quotas — on top of the pack-plan
+fingerprint), and an atomic ``result.json`` carrying history + a
+params sha256 digest.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from typing import Dict
+
+
+def _start_alive_ticker(period_s: float = 5.0) -> None:
+    """Liveness token for the supervisor's heartbeat watchdog (the
+    BENCH_HPO lesson — jax import/compile is a long silent window);
+    SIGSTOP freezes this thread too, so a wedged rank still goes
+    stale."""
+    import threading
+
+    def _tick():
+        n = 0
+        while True:
+            time.sleep(period_s)
+            n += 1
+            print(f"gfm-runner: alive t+{n * period_s:g}s", flush=True)
+
+    threading.Thread(target=_tick, daemon=True).start()
+
+
+def run(args) -> int:
+    import numpy as np
+    import optax
+
+    from hydragnn_tpu.config import build_model_config, update_config
+    from hydragnn_tpu.elastic.runner import _param_digest
+    from hydragnn_tpu.hpo.process import committed_steps
+    from hydragnn_tpu.models import create_model, init_params
+    from hydragnn_tpu.parallel.multidataset import GfmMixtureLoader
+    from hydragnn_tpu.telemetry import record_gfm_epoch, start_session
+    from hydragnn_tpu.train.gfm import (GfmEpochAccumulator,
+                                        make_gfm_eval_step,
+                                        make_gfm_train_step)
+    from hydragnn_tpu.train.train_step import TrainState
+    from hydragnn_tpu.utils.checkpoint import (load_existing_model,
+                                               save_model)
+    from hydragnn_tpu.utils.envflags import (resolve_gfm,
+                                             resolve_telemetry)
+
+    from .gfm_data import build_members, split_members
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, args.inputfile)) as f:
+        config = json.load(f)
+    train_cfg = config["NeuralNetwork"]["Training"]
+    if args.num_epochs is not None:
+        train_cfg["num_epoch"] = args.num_epochs
+    if args.batch_size is not None:
+        train_cfg["batch_size"] = args.batch_size
+    # strict knobs, resolved ONCE here: env over Training.Gfm over
+    # defaults — the loader and the step factories take plain values
+    mixture, head_weights = resolve_gfm(train_cfg)
+
+    members = build_members(
+        sizes=[int(v) for v in args.sizes.split(",")],
+        seed=args.data_seed)
+    train_members, val_members = split_members(members)
+    all_train = [s for v in train_members.values() for s in v]
+    config = update_config(config, all_train)
+    mcfg = build_model_config(config)
+
+    B = int(train_cfg["batch_size"])
+    loader = GfmMixtureLoader(
+        train_members, B, cfg=mcfg, weights=mixture, seed=args.seed,
+        pack_rank=args.rank, pack_nproc=args.world)
+    # val replays the full mixture at epoch 0's fixed order each time;
+    # per-head val losses come from the same masked metrics
+    val_loader = GfmMixtureLoader(
+        val_members, B, cfg=mcfg, seed=args.seed)
+    plan_fp = loader.global_plan_fingerprint()
+    print(f"plan_fp={plan_fp}", flush=True)
+
+    model = create_model(mcfg)
+    lr = float(train_cfg["Optimizer"].get("learning_rate", 3e-3))
+    tx = optax.adam(lr)
+    names = loader.member_names
+    step = make_gfm_train_step(model, mcfg, tx,
+                               head_weights=head_weights,
+                               num_datasets=len(names))
+    eval_step = make_gfm_eval_step(model, mcfg,
+                                   head_weights=head_weights,
+                                   num_datasets=len(names))
+
+    loader.set_epoch(0)
+    first = next(iter(loader))
+    variables = init_params(model, first, seed=args.seed)
+    # .create pins step to a strong int32 (one-compile contract: a
+    # Python-int step weak-types the first trace and recompiles)
+    state = TrainState.create(variables, tx)
+
+    session = start_session(resolve_telemetry(train_cfg), args.job_dir)
+    ckpt_path = os.path.join(args.job_dir, "logs")
+    history: Dict[str, list] = {"train_loss": [], "val_loss": []}
+    for n in names:
+        history[f"val_loss_{n}"] = []
+    start_epoch = 0
+    if args.resume and committed_steps(args.job_dir):
+        restored, meta = load_existing_model(
+            state, args.log_name, path=ckpt_path, with_metadata=True)
+        if restored is not None:
+            state = restored
+            if meta and "history" in meta:
+                history = {k: list(v)
+                           for k, v in meta["history"].items()}
+            start_epoch = len(history["train_loss"])
+            print(f"gfm-runner: resumed at step {int(state.step)} "
+                  f"(epoch {start_epoch})", flush=True)
+
+    num_epochs = int(train_cfg["num_epoch"])
+    t_train = time.perf_counter()
+    graphs_done = 0
+    for epoch in range(start_epoch, num_epochs):
+        loader.set_epoch(epoch)
+        acc = GfmEpochAccumulator(names)
+        losses = []
+        for batch in loader:
+            state, metrics = step(state, batch)
+            acc.update(batch, metrics)
+            losses.append(float(metrics["loss"]))
+        train_sum = acc.summary()
+        graphs_done += acc.total_graphs
+        val_loader.set_epoch(0)
+        vacc = GfmEpochAccumulator(names)
+        vl = []
+        for batch in val_loader:
+            m, _ = eval_step(state, batch)
+            vacc.update(batch, m)
+            vl.append(float(m["loss"]))
+        val_sum = vacc.summary()
+        history["train_loss"].append(float(np.mean(losses)))
+        history["val_loss"].append(float(np.mean(vl)))
+        for n in names:
+            history[f"val_loss_{n}"].append(
+                float(val_sum["head_losses"][n]))
+        record_gfm_epoch(train_sum["head_losses"],
+                         val_losses=val_sum["head_losses"],
+                         mixture_frac=train_sum["mixture_frac"])
+        if session is not None:
+            data = {"train_loss": history["train_loss"][-1],
+                    "val_loss": history["val_loss"][-1]}
+            for n in names:
+                data[f"gfm_head_loss_{n}"] = float(
+                    train_sum["head_losses"][n])
+                data[f"gfm_val_head_loss_{n}"] = float(
+                    val_sum["head_losses"][n])
+                data[f"gfm_mixture_frac_{n}"] = float(
+                    train_sum["mixture_frac"][n])
+            session.epoch_event(epoch, data=data)
+        frac = " ".join(f"{n}={train_sum['mixture_frac'][n]:.2f}"
+                        for n in names)
+        print(f"epoch {epoch}: train_loss={history['train_loss'][-1]:.4f}"
+              f" val_loss={history['val_loss'][-1]:.4f} mix[{frac}]",
+              flush=True)
+        save_model(state, args.log_name, path=ckpt_path,
+                   metadata={"history": history, "epoch": epoch})
+    train_s = time.perf_counter() - t_train
+    if session is not None:
+        session.finalize()
+
+    committed = committed_steps(args.job_dir)
+    result = {
+        "objective": float(history["val_loss"][-1]),
+        "history": history,
+        "per_head_val": {n: history[f"val_loss_{n}"][-1] for n in names},
+        "mixture_frac": dict(loader.mixture_fractions()),
+        "step": int(state.step),
+        "final_step": int(committed[-1]) if committed
+        else int(state.step),
+        "world_size": int(args.world),
+        "plan_fp": plan_fp,
+        "graphs_per_s": graphs_done / max(train_s, 1e-9),
+        **_param_digest(state),
+    }
+    if args.rank == 0:
+        tmp = os.path.join(args.job_dir, "result.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+        os.replace(tmp, os.path.join(args.job_dir, "result.json"))
+    print(json.dumps({"final_train_loss": history["train_loss"][-1],
+                      "final_val_loss": history["val_loss"][-1]}))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--inputfile", default="gfm_mixture.json")
+    p.add_argument("--num-epochs", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--sizes", default="48,32,40",
+                   help="per-member sample counts (alpha,beta,gamma)")
+    p.add_argument("--data-seed", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--rank", type=int, default=0,
+                   help="pack_rank: this process's slice of the global "
+                        "mixture plan")
+    p.add_argument("--world", type=int, default=1,
+                   help="pack_nproc: the plan is computed globally and "
+                        "sliced, so step counts are world-size-invariant")
+    p.add_argument("--job-dir", default=".",
+                   help="checkpoints land under <job-dir>/logs; rank 0 "
+                        "writes <job-dir>/result.json")
+    p.add_argument("--log-name", default="gfm")
+    p.add_argument("--resume", action="store_true",
+                   help="continue from this job dir's LATEST")
+    args = p.parse_args(argv)
+    # first heartbeat before any heavy import (supervisor watchdog)
+    print(f"gfm-runner: starting (rank={args.rank} world={args.world} "
+          f"resume={args.resume})", flush=True)
+    _start_alive_ticker()
+    return run(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -304,23 +304,38 @@ class GraphDataLoader:
         return self._build_batch_from_samples(
             sel, fetch_samples(self.dataset, self._flat_indices(sel)))
 
+    def _postprocess_shard(self, batch: GraphBatch,
+                           shard_sel) -> GraphBatch:
+        """Subclass hook: per-shard batch enrichment from the shard's
+        dataset-index selection, after collation but before stacking.
+        The mixture loader (parallel/multidataset.GfmMixtureLoader)
+        attaches the per-graph ``dataset_id`` here — selection-derived,
+        so the batch cache (keyed by the exact selection) stays
+        correct. Runs on worker threads under iterate_async: numpy
+        only, no shared mutable state."""
+        return batch
+
     def _build_batch_from_samples(self, sel, samples) -> GraphBatch:
         if self.packing:
             # sel is a tuple of per-shard index tuples; `samples` holds the
             # flattened fetch in the same order
             shards, at = [], 0
             for shard_sel in sel:
-                shards.append(self._collate_shard(
-                    samples[at:at + len(shard_sel)]))
+                shards.append(self._postprocess_shard(
+                    self._collate_shard(samples[at:at + len(shard_sel)]),
+                    shard_sel))
                 at += len(shard_sel)
             return shards[0] if self.num_shards == 1 else \
                 _stack_batches(shards)
         if self.num_shards == 1:
-            return self._collate_shard(samples)
+            return self._postprocess_shard(self._collate_shard(samples),
+                                           tuple(sel))
         shards = []
         g = self.graphs_per_shard
         for sh in range(self.num_shards):
-            shards.append(self._collate_shard(samples[sh * g:(sh + 1) * g]))
+            shards.append(self._postprocess_shard(
+                self._collate_shard(samples[sh * g:(sh + 1) * g]),
+                tuple(sel[sh * g:(sh + 1) * g])))
         return _stack_batches(shards)
 
     def __iter__(self) -> Iterator[GraphBatch]:

@@ -74,6 +74,13 @@ class GraphBatch:
     # layer this slot may refresh (-1 = none; loader-deduplicated so at
     # most one slot per global id qualifies — scatter stays deterministic)
     hist_states: Optional[jnp.ndarray] = None   # [L-1, N, H] stale states
+    # multi-dataset ("GFM") mixture training (parallel/multidataset.py,
+    # docs/gfm.md): which member dataset each graph slot came from.
+    # Padding slots carry -1 so they match no head even before the
+    # graph/node masks apply. When present, multihead_loss restricts each
+    # head's loss mask to its own dataset's graphs (head-masked multi-task
+    # step) — the mixture changes the DATA, never the compiled program.
+    dataset_id: Optional[jnp.ndarray] = None    # [G] int32, -1 = padding
 
     @property
     def num_nodes(self) -> int:

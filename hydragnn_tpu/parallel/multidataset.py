@@ -1,25 +1,39 @@
-"""Heterogeneous multi-dataset ("GFM") data parallelism.
+"""Heterogeneous multi-dataset ("GFM") mixture training (docs/gfm.md).
 
 reference: examples/multidataset/train.py:188-328 — the world communicator
 is split into per-dataset groups sized proportionally to dataset size; each
 group trains on its own ADIOS file while gradients are still allreduced
 globally by DDP; PNA degree histograms are merged across datasets.
 
-TPU redesign: no communicator splits. The device-stacked batch layout
-(datasets/loader.py) already gives every device its own self-contained
-sub-batch, so "groups" become a static device->dataset assignment inside
-one data mesh; the single gradient pmean over the mesh IS the global
-allreduce. Each device slot runs its own shuffled epoch stream over its
-assigned dataset (proportional assignment, largest-remainder rounding).
+TPU redesign, two tiers:
+
+* `MultiDatasetLoader` — the communicator-split analogue: a static
+  device->dataset assignment inside one data mesh (proportional,
+  largest-remainder), each device slot cycling its own shuffled epoch
+  stream. Shards are independent streams; there is no global plan.
+* `GfmMixtureLoader` — the pod-scale mixture pipeline: ONE deterministic
+  global mixture pack plan over the union of member datasets. The
+  interleaved epoch order is a pure function of (seed, epoch) and the
+  mixture spec — computed BEFORE any per-process slicing — then packed
+  against one shared budget chosen over the union size histogram
+  (graphs/packing.py) and sliced per (pack_rank, pack_nproc) exactly like
+  a single-dataset packing loader (the PR 2/PR 15 contract). Step counts
+  and per-step global batch contents are therefore world-size-invariant,
+  `global_plan_fingerprint()` folds the mixture spec, and every batch
+  shares one padded shape: a >=3-dataset mixture trains through ONE
+  compiled train step, and adding a member dataset (under a pinned
+  budget) adds ZERO compiles. Batches carry a per-graph ``dataset_id``
+  that train/loss.multihead_loss uses to mask each head to its own
+  member dataset — the head-masked multi-task step (train/gfm.py).
 """
 from __future__ import annotations
 
 import math
-from typing import List, Optional, Sequence
+from typing import List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..graphs.batch import BucketSpec, GraphSample
+from ..graphs.batch import BucketSpec, GraphBatch, GraphSample
 from ..datasets.loader import GraphDataLoader, _stack_batches
 
 
@@ -60,25 +74,270 @@ def merge_pna_deg(histograms: Sequence[Sequence[int]]) -> List[int]:
     return out.tolist()
 
 
+def _normalize_members(datasets):
+    """(names, members) with a PINNED iteration order: a Mapping is
+    sorted by member name so the shared pack budget and the mixture
+    plan are functions of the mixture's CONTENT, never of dict
+    construction/insertion order; a plain sequence keeps its positional
+    order (names ``dataset<i>``) because position IS its identity —
+    the head<->dataset index convention (train/loss.head_loss_mask)
+    binds to this normalized order either way."""
+    if isinstance(datasets, Mapping):
+        names = tuple(sorted(str(k) for k in datasets.keys()))
+        members = [datasets[n] for n in names]
+    else:
+        members = list(datasets)
+        names = tuple(f"dataset{i}" for i in range(len(members)))
+    if not members:
+        raise ValueError("at least one member dataset is required")
+    for name, m in zip(names, members):
+        if len(m) == 0:
+            raise ValueError(f"member dataset '{name}' is empty")
+    return names, members
+
+
+def validate_member_heads(cfg, names: Sequence[str], members,
+                          per_dataset_heads: bool = False) -> None:
+    """Fail fast, actionably, on mixture/model head mismatches that would
+    otherwise surface as shape errors deep inside the jitted loss.
+
+    Checks (naming the dataset and head in every error):
+      * ``task_weights`` length matches the head count,
+      * with ``per_dataset_heads`` (the GFM mixture convention): exactly
+        one head per member dataset, bound by index in normalized member
+        order,
+      * every member's packed labels are wide enough for every head that
+        will read them (all heads for `MultiDatasetLoader`, the member's
+        own head for the mixture).
+
+    Width checks probe each member's first sample — collate's
+    homogeneity validation (graphs/batch.py) covers the rest of the
+    member."""
+    heads = cfg.heads
+    if len(cfg.task_weights) != len(heads):
+        raise ValueError(
+            f"config declares {len(heads)} heads but "
+            f"{len(cfg.task_weights)} task_weights — one loss weight per "
+            "head is required")
+    if per_dataset_heads and len(heads) != len(names):
+        raise ValueError(
+            f"GFM mixture has {len(names)} member datasets "
+            f"({', '.join(names)}) but the model defines {len(heads)} "
+            "heads — the head-masked multi-task step binds head i to "
+            "member dataset i (sorted member order), so the counts must "
+            "match")
+
+    def _check(ds_idx, ih):
+        head = heads[ih]
+        s = members[ds_idx][0]
+        y = s.y_graph if head.head_type == "graph" else s.y_node
+        width = 0 if y is None else (
+            y.shape[0] if head.head_type == "graph" else y.shape[1])
+        end = head.offset + head.output_dim
+        if width < end:
+            label = head.name or f"head_{ih}"
+            raise ValueError(
+                f"dataset '{names[ds_idx]}' provides "
+                f"{width} packed {head.head_type}-label columns but "
+                f"{head.head_type} head '{label}' (index {ih}) reads "
+                f"columns [{head.offset}:{end}) — widen the member's "
+                "labels to the union layout (docs/gfm.md) or fix the "
+                "head's output_dim/offset")
+
+    for d in range(len(names)):
+        if per_dataset_heads:
+            _check(d, d)
+        else:
+            for ih in range(len(heads)):
+                _check(d, ih)
+
+
+def mixture_quotas(sizes: Sequence[int], weights: Sequence[float],
+                   total: Optional[int] = None) -> List[int]:
+    """Per-dataset draw counts for one epoch: largest-remainder
+    apportionment of `total` (default: sum of sizes) by weight, with
+    >=1 draw per member whenever total allows — a silent zero-quota
+    member would train a head on nothing without any visible sign."""
+    sizes = [int(s) for s in sizes]
+    w = np.asarray([float(x) for x in weights], np.float64)
+    if np.any(w <= 0) or not np.all(np.isfinite(w)):
+        raise ValueError(f"mixture weights must be positive finite, got "
+                         f"{list(weights)}")
+    if total is None:
+        total = sum(sizes)
+    total = int(total)
+    share = w / w.sum() * total
+    base = np.floor(share).astype(np.int64)
+    order = np.argsort(-(share - base), kind="stable")
+    for i in order[:total - int(base.sum())]:
+        base[i] += 1
+    if total >= len(sizes):
+        while np.any(base == 0):
+            base[int(np.argmin(base))] += 1
+            base[int(np.argmax(base))] -= 1
+    return [int(b) for b in base]
+
+
+def mixture_order(sizes: Sequence[int], quotas: Sequence[int],
+                  seed: int, epoch: int) -> np.ndarray:
+    """The epoch's GLOBAL interleaved sample order over the concatenated
+    (normalized-order) members — a pure function of (seed, epoch) and
+    the mixture spec, with NO rank/world input, so every process derives
+    the identical order and the pack plan sliced from it
+    (docs/packing.md) keeps step counts world-size-invariant.
+
+    Per member d: draw ``quotas[d]`` samples by cycling shuffled
+    passes — pass c uses the permutation seeded by (seed, epoch, d, c),
+    so oversampled members reshuffle per cycle instead of repeating one
+    permutation. Interleave: draw j of member d sorts by the fractional
+    position ((j+1)/quota_d, d) — deterministic weighted round-robin
+    that spreads each member evenly across the epoch (no head starves
+    for a stretch of steps, which matters once bins become batches)."""
+    offsets = np.concatenate([[0], np.cumsum(sizes)])[:-1]
+    all_idx, all_keys, all_ds = [], [], []
+    base_seed = int(seed) & 0x7FFFFFFF
+    for d, (n, q) in enumerate(zip(sizes, quotas)):
+        if q <= 0:
+            continue
+        cycles = -(-q // n)
+        perms = [np.random.RandomState(
+            [base_seed, int(epoch), d, c]).permutation(n)
+            for c in range(cycles)]
+        idx = np.concatenate(perms)[:q] + offsets[d]
+        all_idx.append(idx.astype(np.int64))
+        all_keys.append((np.arange(q, dtype=np.float64) + 1.0) / q)
+        all_ds.append(np.full(q, d, np.int64))
+    idx = np.concatenate(all_idx)
+    keys = np.concatenate(all_keys)
+    ds = np.concatenate(all_ds)
+    return idx[np.lexsort((ds, keys))]
+
+
+class GfmMixtureLoader(GraphDataLoader):
+    """One-compile mixture pipeline for GFM training (docs/gfm.md).
+
+    A packing-mode `GraphDataLoader` over the concatenated members whose
+    epoch order is the deterministic global mixture interleave
+    (`mixture_order`) instead of a flat shuffle — everything else (pack
+    plan, per-(rank, nproc) slicing, async collation, batch cache,
+    padding stats) is inherited from the PR 2 machinery unchanged.
+    Every emitted batch carries a per-graph ``dataset_id`` (-1 on
+    padding slots) so the head-masked multi-task loss
+    (train/loss.multihead_loss) can mask each head to its member.
+
+    ``weights`` maps member name -> sampling weight (resolve_gfm /
+    HYDRAGNN_GFM_MIXTURE); members absent from the spec default to
+    weight 1.0, unknown names raise (typo protection). Without a spec
+    the epoch draws every sample exactly once (size-proportional).
+    ``pack_budget`` pins the shared union budget externally — pass the
+    full-menu budget to train a sub-mixture under the same compiled
+    shapes (the adding-a-dataset-adds-zero-compiles contract BENCH_GFM
+    adjudicates).
+    """
+
+    def __init__(self, datasets, batch_size: int, *, cfg=None,
+                 weights: Optional[Mapping[str, float]] = None,
+                 seed: int = 0, num_shards: int = 1,
+                 epoch_quota: Optional[int] = None,
+                 pack_budget=None, pack_lookahead: Optional[int] = None,
+                 pack_rank: int = 0, pack_nproc: int = 1,
+                 async_workers: Optional[int] = None,
+                 cache_mb: Optional[int] = None):
+        names, members = _normalize_members(datasets)
+        if cfg is not None:
+            validate_member_heads(cfg, names, members,
+                                  per_dataset_heads=True)
+        self.member_names = names
+        self.member_sizes = [len(m) for m in members]
+        if weights:
+            unknown = sorted(set(weights) - set(names))
+            if unknown:
+                raise ValueError(
+                    f"mixture weights name unknown dataset(s) "
+                    f"{unknown}; members are {sorted(names)}")
+            self.member_weights = tuple(
+                float(weights.get(n, 1.0)) for n in names)
+        else:
+            # size-proportional default: every sample exactly once
+            self.member_weights = tuple(
+                float(s) for s in self.member_sizes)
+        self._quotas = mixture_quotas(self.member_sizes,
+                                      self.member_weights, epoch_quota)
+        self._ds_of = np.repeat(
+            np.arange(len(members), dtype=np.int32),
+            self.member_sizes)
+        concat: List[GraphSample] = []
+        for m in members:
+            concat.extend(m)
+        super().__init__(
+            concat, batch_size, shuffle=True, seed=seed,
+            num_shards=num_shards, drop_last=True, packing=True,
+            pack_budget=pack_budget, pack_lookahead=pack_lookahead,
+            pack_rank=pack_rank, pack_nproc=pack_nproc,
+            async_workers=async_workers, cache_mb=cache_mb)
+
+    def _order(self) -> np.ndarray:
+        # the GLOBAL mixture interleave — pure in (seed, epoch) + spec;
+        # the inherited _plan() packs it and slices per (rank, nproc)
+        return mixture_order(self.member_sizes, self._quotas,
+                             self.seed, self.epoch)
+
+    def _postprocess_shard(self, batch: GraphBatch,
+                           shard_sel) -> GraphBatch:
+        ids = np.full(self.n_graph, -1, np.int32)
+        if len(shard_sel):
+            ids[:len(shard_sel)] = self._ds_of[list(shard_sel)]
+        return batch.replace(dataset_id=ids)
+
+    def mixture_fractions(self) -> "dict[str, float]":
+        """name -> fraction of the epoch's global plan drawn from that
+        member (deterministic — quota-derived, not measured), the
+        ``gfm_mixture_frac_<dataset>`` telemetry value."""
+        total = max(sum(self._quotas), 1)
+        return {n: q / total
+                for n, q in zip(self.member_names, self._quotas)}
+
+    def global_plan_fingerprint(self) -> str:
+        """The packing fingerprint (docs/packing.md) with the mixture
+        spec folded in: two runs agree iff they agree on the global bin
+        sequence, budget, slicing geometry AND (member names, weights,
+        quotas) — so a drifted mixture can never masquerade as the same
+        plan across elastic generations (docs/fault_tolerance.md)."""
+        import hashlib
+        base = super().global_plan_fingerprint()
+        payload = repr((base, self.member_names, self.member_weights,
+                        tuple(self._quotas)))
+        return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
 class MultiDatasetLoader:
     """Device-stacked batches where shard d draws from its assigned dataset.
 
     All shards share one padded shape (the max over datasets) -> one
-    compiled program for the heterogeneous mix.
+    compiled program for the heterogeneous mix. Members may arrive as a
+    Mapping (iteration pinned sorted by name — the shared budget cannot
+    drift with construction order) or a Sequence (positional). Passing
+    the model ``cfg`` validates every member's labels against every
+    head up front (`validate_member_heads`) instead of failing as a
+    shape error deep in the loss.
     """
 
-    def __init__(self, datasets: Sequence[Sequence[GraphSample]],
-                 batch_size: int, num_shards: int, seed: int = 0,
-                 bucket: Optional[BucketSpec] = None,
+    def __init__(self, datasets, batch_size: int, num_shards: int,
+                 seed: int = 0, bucket: Optional[BucketSpec] = None,
                  packing: bool = False,
-                 pack_lookahead: Optional[int] = None):
+                 pack_lookahead: Optional[int] = None, cfg=None):
         if batch_size % num_shards != 0:
             raise ValueError(
                 f"batch_size {batch_size} must divide evenly over "
                 f"{num_shards} shards")
+        names, members = _normalize_members(datasets)
+        if cfg is not None:
+            validate_member_heads(cfg, names, members,
+                                  per_dataset_heads=False)
+        self.member_names = names
         self.gps = batch_size // num_shards
         self.assignment = assign_shards_to_datasets(
-            [len(d) for d in datasets], num_shards)
+            [len(d) for d in members], num_shards)
         self.packing = bool(packing)
         pack_budget = None
         if self.packing:
@@ -92,7 +351,7 @@ class MultiDatasetLoader:
             # (len() already cycles the shorter streams).
             import numpy as _np
             from ..graphs.packing import choose_budget, sample_sizes
-            sizes = [sample_sizes(d) for d in datasets]
+            sizes = [sample_sizes(d) for d in members]
             nodes = _np.concatenate([s[0] for s in sizes])
             edges = _np.concatenate([s[1] for s in sizes])
             pack_budget = choose_budget(nodes, edges, self.gps,
@@ -101,7 +360,7 @@ class MultiDatasetLoader:
         else:
             bucket = bucket or BucketSpec(multiple=64)
             from ..datasets.async_loader import dataset_invariants
-            invs = [dataset_invariants(d) for d in datasets]
+            invs = [dataset_invariants(d) for d in members]
             max_n = max(i.max_nodes for i in invs)
             max_e = max(i.max_edges for i in invs)
             n_node = bucket.bucket(max_n * self.gps + 1)
@@ -117,7 +376,7 @@ class MultiDatasetLoader:
             # num_shards for fresh-permutation streams whose selection
             # keys essentially never repeat
             self.loaders.append(GraphDataLoader(
-                datasets[ds_idx], self.gps, shuffle=True,
+                members[ds_idx], self.gps, shuffle=True,
                 seed=seed * 1000 + shard, num_shards=1,
                 n_node_per_shard=None if self.packing else n_node,
                 n_edge_per_shard=None if self.packing else n_edge,

@@ -20,6 +20,7 @@ Disabled by default with a near-zero hot-path cost: producers call
 and report registry metrics only from cold paths (per epoch, per retry,
 per cache probe, per scrape).
 """
+from .gfm import record_gfm_epoch
 from .mfu import PEAK_FLOPS, achieved_and_mfu, peak_flops
 from .registry import (COUNTER, GAUGE, HISTOGRAM, MetricsRegistry,
                        MetricTypeError, get_registry, set_registry)
@@ -34,4 +35,5 @@ __all__ = [
     "TelemetryConfig", "TelemetrySession", "start_session",
     "EpochDeviceTrace", "SpanRecorder", "current_recorder", "device_trace",
     "install_recorder", "record", "span",
+    "record_gfm_epoch",
 ]

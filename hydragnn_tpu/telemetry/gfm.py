@@ -1,0 +1,41 @@
+"""GFM mixture-training telemetry (docs/gfm.md, docs/observability.md).
+
+Host-side producer helpers for the multi-dataset mixture workload —
+per-head loss gauges and per-member mixture fractions land in the
+process metrics registry so the exporters, the epoch JSONL, and
+BENCH_GFM read one source of truth. No knobs are read here (the
+traced-env-read discipline): callers pass plain values.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .registry import get_registry
+
+
+def record_gfm_epoch(train_losses: Dict[str, float],
+                     val_losses: Optional[Dict[str, float]] = None,
+                     mixture_frac: Optional[Dict[str, float]] = None
+                     ) -> None:
+    """One mixture epoch: per-head train/val losses keyed by member
+    dataset name (train/gfm.GfmEpochAccumulator's count-weighted means)
+    and the epoch's measured per-member mixture fractions. Labeled
+    gauges, not name-mangled metrics — `gfm_head_loss{head=..., split=...}`
+    and `gfm_mixture_frac{dataset=...}` — matching the registry's label
+    idiom; the epoch JSONL `data` bucket carries the same values
+    deterministically (the PR 7 split: losses and fractions are
+    plan-derived, never wall-clock)."""
+    reg = get_registry()
+    for name, v in train_losses.items():
+        reg.gauge_set("gfm_head_loss", float(v),
+                      help="per-head (= per member dataset) masked loss",
+                      head=name, split="train")
+    for name, v in (val_losses or {}).items():
+        reg.gauge_set("gfm_head_loss", float(v),
+                      help="per-head (= per member dataset) masked loss",
+                      head=name, split="val")
+    for name, v in (mixture_frac or {}).items():
+        reg.gauge_set("gfm_mixture_frac", float(v),
+                      help="fraction of the epoch's real graphs drawn "
+                           "from this member dataset",
+                      dataset=name)

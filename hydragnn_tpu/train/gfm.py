@@ -1,0 +1,155 @@
+"""Pod-scale multi-dataset GFM training: the head-masked multi-task step
+(docs/gfm.md).
+
+The mixture pipeline (parallel/multidataset.GfmMixtureLoader) packs a
+>=3-dataset mixture into fixed-shape batches carrying a per-graph
+``dataset_id``; train/loss.multihead_loss masks each head's loss to its
+own member dataset, so the shared conv stack runs ONCE over the packed
+mixture and dataset composition changes the DATA, never the compiled
+program. This module is the thin step-factory layer on top:
+
+* `apply_head_weights` — fold resolved per-head combine weights
+  (envflags.resolve_gfm: HYDRAGNN_GFM_HEAD_WEIGHTS / Training.Gfm) into
+  the frozen ModelConfig's ``task_weights``; every downstream factory
+  (single-device, spmd + ZeRO, composed mesh, 1F1B pipeline) reads
+  weights from there, so ONE substitution covers every parallelism
+  composition — the step factories themselves need no GFM variants.
+* `make_gfm_train_step` / `make_gfm_eval_step` — the single-device
+  factories with the substitution applied and the head<->dataset
+  binding validated; they return ordinary jitted steps whose compile
+  count is probe-able via utils/profiling.jit_cache_total (the PR 17
+  one-compile discipline; BENCH_GFM pins it).
+* `mixture_graph_counts` / `GfmEpochAccumulator` — host-side per-head
+  accounting: masked per-head losses are means over that member's
+  entries only, so epoch aggregation must weight each batch's task loss
+  by its member count (a batch with zero member-d graphs contributes a
+  0.0 task_d that must not dilute the epoch mean).
+
+Determinism boundary (documented at multihead_loss, pinned by
+tests/test_gfm.py): per-head losses/grads are bitwise vs the
+corresponding single-dataset step on exactly-representable data;
+per-head gradients only reassociate at the weighted-sum combine.
+
+No environment reads here (the traced-env-read discipline,
+tools/hydralint): callers resolve knobs once via envflags.resolve_gfm
+and pass plain values.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from ..config.config import ModelConfig
+from ..graphs.batch import GraphBatch
+from .loss import head_loss_mask  # noqa: F401  (re-export: the masking math)
+from .train_step import make_eval_step, make_train_step
+
+
+def apply_head_weights(cfg: ModelConfig,
+                       head_weights: Optional[Sequence[float]]
+                       ) -> ModelConfig:
+    """Return `cfg` with ``task_weights`` replaced by the resolved GFM
+    per-head combine weights (no-op on None). Frozen-dataclass replace:
+    the returned config hashes/compares by value, so jit caches keyed on
+    it behave."""
+    if head_weights is None:
+        return cfg
+    hw = tuple(float(w) for w in head_weights)
+    if len(hw) != len(cfg.heads):
+        raise ValueError(
+            f"got {len(hw)} GFM head weights for {len(cfg.heads)} heads "
+            "— one combine weight per head (HYDRAGNN_GFM_HEAD_WEIGHTS / "
+            "Training.Gfm.head_weights)")
+    return dataclasses.replace(cfg, task_weights=hw)
+
+
+def _check_gfm_heads(cfg: ModelConfig, num_datasets: Optional[int]) -> None:
+    if num_datasets is not None and len(cfg.heads) != num_datasets:
+        raise ValueError(
+            f"GFM step binds head i to member dataset i but the model "
+            f"defines {len(cfg.heads)} heads for {num_datasets} member "
+            "datasets — counts must match (docs/gfm.md)")
+
+
+def make_gfm_train_step(model, cfg: ModelConfig, tx, *,
+                        head_weights: Optional[Sequence[float]] = None,
+                        num_datasets: Optional[int] = None,
+                        loss_name: str = "mse", **kwargs):
+    """The head-masked multi-task train step: `make_train_step` over a
+    head-weight-substituted config. Batches must carry ``dataset_id``
+    (GfmMixtureLoader emits it); on plain batches this IS the standard
+    multihead step — same compiled program either way, which is the
+    point. One compile per bucket shape, probe with
+    utils.profiling.jit_cache_total."""
+    _check_gfm_heads(cfg, num_datasets)
+    return make_train_step(model, apply_head_weights(cfg, head_weights),
+                           tx, loss_name=loss_name, **kwargs)
+
+
+def make_gfm_eval_step(model, cfg: ModelConfig, *,
+                       head_weights: Optional[Sequence[float]] = None,
+                       num_datasets: Optional[int] = None,
+                       loss_name: str = "mse", **kwargs):
+    """Eval twin of `make_gfm_train_step`: per-head metrics
+    (``task_<i>``) are masked means over each head's own member
+    entries, so per-head val losses come straight out of the standard
+    metrics dict."""
+    _check_gfm_heads(cfg, num_datasets)
+    return make_eval_step(model, apply_head_weights(cfg, head_weights),
+                          loss_name=loss_name, **kwargs)
+
+
+def mixture_graph_counts(batch: GraphBatch, num_heads: int) -> np.ndarray:
+    """Per-head REAL graph counts of one (possibly device-stacked)
+    mixture batch, host-side numpy — the weights for epoch-level
+    aggregation of masked per-head losses and the numerator of the
+    measured mixture fractions. Works on [G] and [D, G] layouts."""
+    ids = np.asarray(batch.dataset_id).reshape(-1)
+    real = np.asarray(batch.graph_mask).reshape(-1)
+    counts = np.zeros(num_heads, np.int64)
+    for h in range(num_heads):
+        counts[h] = int(np.sum(real & (ids == h)))
+    return counts
+
+
+class GfmEpochAccumulator:
+    """Count-weighted per-head epoch means over a stream of mixture
+    batches: ``update(batch, metrics)`` after each step, ``summary()``
+    at epoch end -> {"head_losses": {name: mean}, "mixture_frac":
+    {name: measured fraction}}. Metrics may be jax scalars or floats;
+    task i's batch loss is weighted by the batch's member-i graph
+    count, so empty-member batches (task loss 0.0 by masked_loss's
+    max(count, 1) denominator) do not dilute the mean."""
+
+    def __init__(self, member_names: Sequence[str]):
+        self.names = tuple(member_names)
+        self._loss_sum = np.zeros(len(self.names), np.float64)
+        self._count = np.zeros(len(self.names), np.int64)
+
+    def update(self, batch: GraphBatch, metrics: Dict) -> None:
+        counts = mixture_graph_counts(batch, len(self.names))
+        for i in range(len(self.names)):
+            li = metrics.get(f"task_{i}")
+            if li is None:
+                continue
+            self._loss_sum[i] += float(li) * counts[i]
+            self._count[i] += counts[i]
+
+    @property
+    def total_graphs(self) -> int:
+        """Real (non-padding) graphs seen so far, summed over members —
+        the honest numerator for epoch throughput."""
+        return int(self._count.sum())
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        total = max(int(self._count.sum()), 1)
+        return {
+            "head_losses": {
+                n: self._loss_sum[i] / max(int(self._count[i]), 1)
+                for i, n in enumerate(self.names)},
+            "mixture_frac": {
+                n: int(self._count[i]) / total
+                for i, n in enumerate(self.names)},
+        }

@@ -36,16 +36,48 @@ def head_targets(cfg: ModelConfig, batch: GraphBatch) -> List[jnp.ndarray]:
     return targets
 
 
+def head_loss_mask(batch: GraphBatch, ih: int, head) -> jnp.ndarray:
+    """The loss mask of head `ih`: real graphs (or real nodes) — and, on a
+    multi-dataset mixture batch (``batch.dataset_id`` set, docs/gfm.md),
+    only the entries belonging to head ih's member dataset. The head↔
+    dataset convention is by index: head ih supervises graphs with
+    ``dataset_id == ih`` (GfmMixtureLoader assigns ids in sorted member
+    order; validate_member_heads pins the correspondence). Node-level
+    heads broadcast the per-graph id through ``node_graph``; padding
+    graphs carry id -1 so they match no head with or without the base
+    mask."""
+    if head.head_type == "graph":
+        mask = batch.graph_mask
+        if batch.dataset_id is not None:
+            mask = mask & (batch.dataset_id == ih)
+    else:
+        mask = batch.node_mask
+        if batch.dataset_id is not None:
+            mask = mask & (batch.dataset_id[batch.node_graph] == ih)
+    return mask
+
+
 def multihead_loss(cfg: ModelConfig, loss_name: str, outputs, outputs_var,
                    batch: GraphBatch):
     """Per-task weighted sum (reference: Base.loss_hpweighted, Base.py:434-461).
 
-    Returns (total, list of per-task losses)."""
+    Returns (total, list of per-task losses).
+
+    On mixture batches carrying ``dataset_id`` this IS the head-masked
+    multi-task step (docs/gfm.md): the shared conv stack has already run
+    once over the packed mixture, every head's output covers the full
+    graph/node tensor, and each head's masked mean sees only its own
+    dataset's entries. Determinism boundary (the PR 6/PR 8 contract):
+    each per-head loss/grad is a fixed-shape masked reduction — bitwise
+    reproducible — and per-head gradients only reassociate at this
+    weighted-sum combine, so a one-hot-weighted mixture step matches the
+    corresponding single-dataset step bitwise on exactly-representable
+    data (tests/test_gfm.py pins it)."""
     targets = head_targets(cfg, batch)
     tot = 0.0
     tasks = []
     for ih, head in enumerate(cfg.heads):
-        mask = batch.graph_mask if head.head_type == "graph" else batch.node_mask
+        mask = head_loss_mask(batch, ih, head)
         var = outputs_var[ih] if outputs_var is not None else None
         li = masked_loss(loss_name, outputs[ih], targets[ih], mask, var)
         tasks.append(li)

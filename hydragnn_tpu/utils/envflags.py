@@ -3,6 +3,7 @@
 hydragnn distributed.py:126-141, train_validate_test.py:46,177,475,640)."""
 from __future__ import annotations
 
+import math
 import os
 
 _FALSY = ("", "0", "false", "no", "off")
@@ -460,3 +461,79 @@ def resolve_sampling(train_cfg=None) -> "tuple[tuple, int, int, str]":
                            int(block.get("partitions", 1)))
     mode = str(block.get("partition_mode", "range"))
     return fanouts, max(int(k), 0), max(int(parts), 1), mode
+
+
+def resolve_gfm(train_cfg=None) -> "tuple":
+    """Multi-dataset GFM mixture knobs (docs/gfm.md) ->
+    (mixture weights dict-or-None, head weights tuple-or-None).
+
+    Precedence per knob: HYDRAGNN_GFM_* env over the Training.Gfm config
+    block over defaults (None = loader/step defaults: size-proportional
+    sampling, cfg.task_weights head combine). STRICT parsing — the
+    mixture weights change the epoch's global pack plan and the head
+    weights change the training mathematics, so a typo value must warn
+    naming the variable and fall back, never silently take effect (the
+    HYDRAGNN_PALLAS_NBR lesson). Resolved ONCE at loader/step
+    construction; parallel/multidataset.py and train/gfm.py take plain
+    values and never read the environment (the traced-env-read
+    discipline, tools/hydralint).
+
+    Knobs:
+      HYDRAGNN_GFM_MIXTURE       comma-separated ``name:weight`` pairs,
+                                 e.g. "ani1x:2,mptrj:1" (weight omitted
+                                 = 1.0); config: Gfm.mixture mapping
+                                 name -> weight. Weights must be
+                                 positive finite numbers.
+      HYDRAGNN_GFM_HEAD_WEIGHTS  comma-separated per-head loss weights,
+                                 e.g. "1.0,0.5,0.5" (config:
+                                 Gfm.head_weights list). Must be
+                                 non-negative finite numbers.
+    """
+    import logging
+    block = (train_cfg or {}).get("Gfm", {}) or {}
+    log = logging.getLogger("hydragnn_tpu")
+
+    mixture = None
+    if block.get("mixture"):
+        mixture = {str(k): float(v) for k, v in block["mixture"].items()}
+    raw = os.getenv("HYDRAGNN_GFM_MIXTURE")
+    if raw is not None and raw.strip():
+        try:
+            parsed = {}
+            for part in raw.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                name, _, w = part.partition(":")
+                if not name.strip():
+                    raise ValueError
+                weight = float(w) if w.strip() else 1.0
+                if not (weight > 0) or not math.isfinite(weight):
+                    raise ValueError
+                parsed[name.strip()] = weight
+            if not parsed:
+                raise ValueError
+            mixture = parsed
+        except ValueError:
+            log.warning(
+                "HYDRAGNN_GFM_MIXTURE=%r is not a comma-separated list "
+                "of name:positive-weight pairs; treating as %r", raw,
+                mixture)
+
+    head_weights = None
+    if block.get("head_weights"):
+        head_weights = tuple(float(v) for v in block["head_weights"])
+    raw = os.getenv("HYDRAGNN_GFM_HEAD_WEIGHTS")
+    if raw is not None and raw.strip():
+        try:
+            parsed = tuple(float(p.strip()) for p in raw.split(","))
+            if not parsed or any(not math.isfinite(w) or w < 0
+                                 for w in parsed):
+                raise ValueError
+            head_weights = parsed
+        except ValueError:
+            log.warning(
+                "HYDRAGNN_GFM_HEAD_WEIGHTS=%r is not a comma-separated "
+                "list of non-negative weights; treating as %r", raw,
+                head_weights)
+    return mixture, head_weights

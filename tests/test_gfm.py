@@ -1,0 +1,505 @@
+"""Pod-scale multi-dataset GFM training (docs/gfm.md): the deterministic
+global mixture pack plan (parallel/multidataset.GfmMixtureLoader), the
+head-masked multi-task step (train/loss.head_loss_mask + train/gfm.py),
+strict knob resolution (envflags.resolve_gfm), and the parallelism
+composition proofs (the masking lives inside multihead_loss, so the
+SPMD+ZeRO and 1F1B-pipeline step factories are GFM-capable with zero
+extra plumbing).
+
+Bitwise contract: the head-masked step on a batch whose real graphs all
+belong to member d, under one-hot head weights, is BITWISE equal to the
+plain multihead step on the same tensors (dataset_id None) — the masks
+coincide for head d and the foreign heads' contributions are exact
+zeros. Dyadic (exactly-representable) data pins it with no rounding to
+hide behind; per-head gradients only reassociate at the weighted-sum
+combine (the documented determinism boundary, train/loss.py)."""
+import logging
+
+import numpy as np
+import pytest
+
+import jax
+
+from hydragnn_tpu.config import build_model_config, update_config
+from hydragnn_tpu.parallel.multidataset import (GfmMixtureLoader,
+                                                MultiDatasetLoader,
+                                                mixture_order,
+                                                mixture_quotas,
+                                                validate_member_heads)
+from tests.deterministic_data import deterministic_graph_dataset
+from tests.utils import make_config
+
+
+def _widen(samples, col, ncol):
+    for s in samples:
+        y = np.zeros(ncol, np.float32)
+        y[col] = s.y_graph[0]
+        s.y_graph = y
+    return samples
+
+
+def _members(sizes=(12, 8, 10), seed=100):
+    names = ("alpha", "beta", "gamma")
+    return {
+        name: _widen(deterministic_graph_dataset(
+            num_configs=n, seed=seed + i), i, len(names))
+        for i, (name, n) in enumerate(zip(names, sizes))}
+
+
+def _gfm_config(members, model_type="GIN"):
+    cfg = make_config(model_type, heads=("graph",) * 3)
+    cfg["Dataset"]["graph_features"] = {
+        "name": ["a", "b", "c"], "dim": [1, 1, 1],
+        "column_index": [0, 1, 2]}
+    voi = cfg["NeuralNetwork"]["Variables_of_interest"]
+    voi["output_index"] = [0, 1, 2]
+    voi["output_names"] = ["a", "b", "c"]
+    all_samples = [s for v in members.values() for s in v]
+    cfg = update_config(cfg, all_samples)
+    return cfg, build_model_config(cfg)
+
+
+# ---------------------------------------------------------------- knobs
+
+
+def test_resolve_gfm_precedence(monkeypatch):
+    from hydragnn_tpu.utils.envflags import resolve_gfm
+    for var in ("HYDRAGNN_GFM_MIXTURE", "HYDRAGNN_GFM_HEAD_WEIGHTS"):
+        monkeypatch.delenv(var, raising=False)
+    assert resolve_gfm(None) == (None, None)
+    block = {"Gfm": {"mixture": {"a": 2.0, "b": 1.0},
+                     "head_weights": [1.0, 0.5]}}
+    assert resolve_gfm(block) == ({"a": 2.0, "b": 1.0}, (1.0, 0.5))
+    monkeypatch.setenv("HYDRAGNN_GFM_MIXTURE", "a:3,b")
+    monkeypatch.setenv("HYDRAGNN_GFM_HEAD_WEIGHTS", "0.25,0.75")
+    assert resolve_gfm(block) == ({"a": 3.0, "b": 1.0}, (0.25, 0.75))
+
+
+def test_resolve_gfm_typo_warns_falls_back(monkeypatch, caplog):
+    from hydragnn_tpu.utils.envflags import resolve_gfm
+    block = {"Gfm": {"mixture": {"a": 2.0}, "head_weights": [1.0]}}
+    monkeypatch.setenv("HYDRAGNN_GFM_MIXTURE", "a:zero")
+    monkeypatch.setenv("HYDRAGNN_GFM_HEAD_WEIGHTS", "1.0,nope")
+    with caplog.at_level(logging.WARNING, logger="hydragnn_tpu"):
+        mixture, hw = resolve_gfm(block)
+    # a typo value warns NAMING the variable and falls back to the
+    # config block — it must never silently take effect
+    assert mixture == {"a": 2.0} and hw == (1.0,)
+    text = caplog.text
+    assert "HYDRAGNN_GFM_MIXTURE" in text
+    assert "HYDRAGNN_GFM_HEAD_WEIGHTS" in text
+    # negative / non-finite weights are typos too
+    monkeypatch.setenv("HYDRAGNN_GFM_MIXTURE", "a:-1")
+    monkeypatch.setenv("HYDRAGNN_GFM_HEAD_WEIGHTS", "inf")
+    with caplog.at_level(logging.WARNING, logger="hydragnn_tpu"):
+        assert resolve_gfm(block) == ({"a": 2.0}, (1.0,))
+
+
+# ----------------------------------------------------- mixture plan math
+
+
+def test_mixture_quotas():
+    assert mixture_quotas([12, 8, 10], [12, 8, 10]) == [12, 8, 10]
+    q = mixture_quotas([12, 8, 10], [1.0, 1.0, 2.0], total=20)
+    assert sum(q) == 20 and q == [5, 5, 10]
+    # >=1 per member whenever total allows: a silent zero-quota member
+    # would train its head on nothing
+    q = mixture_quotas([100, 1, 1], [100.0, 0.001, 0.001], total=10)
+    assert min(q) >= 1 and sum(q) == 10
+    with pytest.raises(ValueError, match="positive finite"):
+        mixture_quotas([4, 4], [1.0, -1.0])
+
+
+def test_mixture_order_deterministic_and_covering():
+    sizes, quotas = [12, 8, 10], [12, 8, 10]
+    a = mixture_order(sizes, quotas, seed=7, epoch=3)
+    b = mixture_order(sizes, quotas, seed=7, epoch=3)
+    np.testing.assert_array_equal(a, b)
+    # full-pass quotas visit every concatenated index exactly once
+    assert sorted(a.tolist()) == list(range(sum(sizes)))
+    # a different epoch reshuffles
+    c = mixture_order(sizes, quotas, seed=7, epoch=4)
+    assert not np.array_equal(a, c)
+    # oversampled member: cycles draw fresh permutations, every sample
+    # appears floor/ceil(q/n) times
+    d = mixture_order([4, 4], [8, 4], seed=0, epoch=0)
+    counts = np.bincount(d, minlength=8)
+    assert counts[:4].tolist() == [2, 2, 2, 2]
+    assert counts[4:].tolist() == [1, 1, 1, 1]
+
+
+def test_mixture_plan_world_size_invariant():
+    """The PR 2 contract, mixture edition: the global plan is computed
+    before per-process slicing, so two ranks at W=2 partition exactly
+    the selections a single rank at W=1 sees, fingerprints agree across
+    ranks, and re-running is bitwise."""
+    members = _members()
+
+    def mk(**kw):
+        return GfmMixtureLoader(members, 6, seed=7, **kw)
+
+    a, b = mk(), mk()
+    a.set_epoch(1), b.set_epoch(1)
+    assert a._selections() == b._selections()
+
+    one = mk()
+    one.set_epoch(1)
+    r0 = mk(pack_rank=0, pack_nproc=2)
+    r1 = mk(pack_rank=1, pack_nproc=2)
+    r0.set_epoch(1), r1.set_epoch(1)
+    s0, s1 = set(r0._selections()), set(r1._selections())
+    assert s0.isdisjoint(s1)
+    assert s0 | s1 == set(one._selections())
+    assert (r0.global_plan_fingerprint()
+            == r1.global_plan_fingerprint())
+    # the fingerprint folds the mixture spec: different weights -> a
+    # different plan identity even over the same members
+    w = GfmMixtureLoader(members, 6, seed=7, weights={"gamma": 3.0})
+    assert (w.global_plan_fingerprint()
+            != one.global_plan_fingerprint())
+
+
+def test_mixture_mapping_order_pinned():
+    """Mapping members iterate sorted by name: construction order can
+    never change the plan, the budget, or the head<->dataset binding."""
+    members = _members()
+    fwd = GfmMixtureLoader(dict(members), 6, seed=7)
+    rev = GfmMixtureLoader(
+        dict(reversed(list(members.items()))), 6, seed=7)
+    assert fwd.member_names == rev.member_names == ("alpha", "beta",
+                                                    "gamma")
+    assert (fwd.global_plan_fingerprint()
+            == rev.global_plan_fingerprint())
+    fwd.set_epoch(0), rev.set_epoch(0)
+    assert fwd._selections() == rev._selections()
+
+
+def test_dataset_id_attached():
+    members = _members()
+    loader = GfmMixtureLoader(members, 6, seed=7)
+    loader.set_epoch(0)
+    sizes = loader.member_sizes
+    bounds = np.concatenate([[0], np.cumsum(sizes)])
+    seen = set()
+    for sel, batch in zip(loader._selections(), loader):
+        shard = sel[0]  # num_shards=1: one per-shard index tuple
+        ids = np.asarray(batch.dataset_id)
+        mask = np.asarray(batch.graph_mask)
+        # real slots carry the member of their source sample, padding -1
+        assert ids.shape == (loader.n_graph,)
+        np.testing.assert_array_equal(
+            ids[:len(shard)],
+            [int(np.searchsorted(bounds, i, side="right") - 1)
+             for i in shard])
+        assert (ids[mask] >= 0).all()
+        assert (ids[~mask] == -1).all()
+        seen.update(ids[mask].tolist())
+    assert seen == {0, 1, 2}
+
+
+def test_mixture_fractions_weighted():
+    members = _members()
+    frac = GfmMixtureLoader(members, 6, seed=0,
+                            weights={"alpha": 1.0, "beta": 1.0,
+                                     "gamma": 2.0}).mixture_fractions()
+    assert frac["gamma"] == pytest.approx(0.5, abs=0.04)
+    # size-proportional default: fractions mirror member sizes
+    frac = GfmMixtureLoader(members, 6, seed=0).mixture_fractions()
+    assert frac["alpha"] == pytest.approx(12 / 30)
+
+
+# ------------------------------------------------------------ validation
+
+
+def test_validation_unknown_weight_name():
+    with pytest.raises(ValueError, match="unknown dataset"):
+        GfmMixtureLoader(_members(), 6, weights={"delta": 2.0})
+
+
+def test_validation_head_count_mismatch():
+    members = _members()
+    _, mcfg = _gfm_config(members)
+    two = {n: members[n] for n in ("alpha", "beta")}
+    with pytest.raises(ValueError, match="binds head i to member"):
+        GfmMixtureLoader(two, 6, cfg=mcfg)
+
+
+def test_validation_label_width_names_dataset_and_head():
+    members = _members()
+    _, mcfg = _gfm_config(members)
+    # gamma's labels are too narrow for head 2 (columns [2:3))
+    members["gamma"] = deterministic_graph_dataset(num_configs=4,
+                                                   seed=9)
+    with pytest.raises(ValueError) as ei:
+        GfmMixtureLoader(members, 6, cfg=mcfg)
+    msg = str(ei.value)
+    assert "gamma" in msg and "head" in msg and "[2:3)" in msg
+
+
+def test_validation_task_weights_mismatch():
+    members = _members()
+    _, mcfg = _gfm_config(members)
+    import dataclasses
+    bad = dataclasses.replace(mcfg, task_weights=(1.0,))
+    with pytest.raises(ValueError, match="task_weights"):
+        validate_member_heads(bad, ("alpha", "beta", "gamma"),
+                              list(members.values()),
+                              per_dataset_heads=True)
+
+
+def test_multidataset_loader_cfg_validation():
+    """MultiDatasetLoader validates every member against every head and
+    pins Mapping iteration sorted."""
+    members = _members()
+    _, mcfg = _gfm_config(members)
+    ld = MultiDatasetLoader(members, batch_size=8, num_shards=4,
+                            cfg=mcfg)
+    assert ld.member_names == ("alpha", "beta", "gamma")
+    members["beta"] = deterministic_graph_dataset(num_configs=4, seed=9)
+    with pytest.raises(ValueError, match="beta"):
+        MultiDatasetLoader(members, batch_size=8, num_shards=4,
+                           cfg=mcfg)
+
+
+def test_gfm_head_weight_length_validated():
+    from hydragnn_tpu.train.gfm import apply_head_weights
+    members = _members()
+    _, mcfg = _gfm_config(members)
+    assert apply_head_weights(mcfg, None) is mcfg
+    assert apply_head_weights(mcfg, (1.0, 0.0, 0.0)).task_weights == \
+        (1.0, 0.0, 0.0)
+    with pytest.raises(ValueError, match="head weights"):
+        apply_head_weights(mcfg, (1.0, 0.5))
+
+
+# ------------------------------------------- the head-masked loss + step
+
+
+def test_head_loss_mask_graph_and_node():
+    import jax.numpy as jnp
+    from hydragnn_tpu.config.config import HeadConfig
+    from hydragnn_tpu.train.loss import head_loss_mask
+
+    class B:
+        graph_mask = jnp.asarray([True, True, True, False])
+        node_mask = jnp.asarray([True, True, True, True, False])
+        node_graph = jnp.asarray([0, 0, 1, 2, 3])
+        dataset_id = jnp.asarray([0, 1, 0, -1])
+
+    g = HeadConfig(head_type="graph", output_dim=1, offset=0)
+    n = HeadConfig(head_type="node", output_dim=1, offset=0)
+    np.testing.assert_array_equal(
+        np.asarray(head_loss_mask(B, 0, g)), [True, False, True, False])
+    # node heads broadcast the graph's dataset_id through node_graph
+    np.testing.assert_array_equal(
+        np.asarray(head_loss_mask(B, 0, n)),
+        [True, True, False, True, False])
+    B.dataset_id = None
+    np.testing.assert_array_equal(
+        np.asarray(head_loss_mask(B, 0, g)), [True, True, True, False])
+
+
+def test_head_masked_step_bitwise_vs_plain():
+    """The tentpole's bitwise contract: on a batch whose real graphs all
+    come from member d, with one-hot head weights, the head-masked step
+    (dataset_id set) and the plain multihead step (dataset_id None)
+    produce BITWISE-identical updated params and head-d loss — for head
+    d the masks coincide, and the one-hot weights make every foreign
+    head's loss and gradient an exact 0.0. Dyadic data: sums are exact,
+    so there is no tolerance to hide a masking bug in. (Cross-member
+    reassociation is out of scope by design: per-head grads only
+    reassociate at the weighted-sum combine — train/loss.py.)"""
+    import optax
+    from examples.gfm.gfm_data import build_members
+    from hydragnn_tpu.graphs import BucketSpec, collate
+    from hydragnn_tpu.models.create import create_model, init_params
+    from hydragnn_tpu.train.gfm import apply_head_weights
+    from hydragnn_tpu.train.train_step import (TrainState,
+                                               make_train_step)
+
+    dyadic = build_members(sizes=[6, 6, 6], seed=1, dyadic=True)
+    _, mcfg = _gfm_config(dyadic)
+    model = create_model(mcfg)
+    tx = optax.sgd(0.5)
+    for d, name in enumerate(sorted(dyadic)):
+        onehot = tuple(1.0 if i == d else 0.0 for i in range(3))
+        step = make_train_step(model, apply_head_weights(mcfg, onehot),
+                               tx, donate=False)
+        b = collate(dyadic[name], bucket=BucketSpec(multiple=64))
+        ids = np.where(np.asarray(b.graph_mask), np.int32(d),
+                       np.int32(-1))
+        s0 = TrainState.create(init_params(model, b, seed=2), tx)
+        s_gfm, m_gfm = step(s0, b.replace(dataset_id=ids))
+        s_plain, m_plain = step(s0, b)
+        for a, c in zip(jax.tree_util.tree_leaves(s_gfm.params),
+                        jax.tree_util.tree_leaves(s_plain.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+        assert (np.asarray(m_gfm[f"task_{d}"])
+                == np.asarray(m_plain[f"task_{d}"]))
+
+
+def test_gfm_mixture_one_compile_and_zero_added():
+    """The one-compile discipline (PR 17), mixture edition: a 2-epoch
+    3-member mixture run holds ONE jit-cache entry, and training a
+    2-member sub-mixture under the SAME pinned budget first adds ZERO
+    compiles when the third member arrives."""
+    import optax
+    from hydragnn_tpu.models.create import create_model, init_params
+    from hydragnn_tpu.train.gfm import (GfmEpochAccumulator,
+                                        make_gfm_train_step)
+    from hydragnn_tpu.train.train_step import TrainState
+    from hydragnn_tpu.utils.profiling import jit_cache_total
+
+    members = _members()
+    _, mcfg = _gfm_config(members)
+    full = GfmMixtureLoader(members, 6, cfg=mcfg, seed=7)
+    sub = GfmMixtureLoader({n: members[n] for n in ("alpha", "beta")},
+                           6, seed=7, pack_budget=full.pack_budget)
+    model = create_model(mcfg)
+    tx = optax.adam(1e-3)
+    step = make_gfm_train_step(model, mcfg, tx, num_datasets=3)
+    sub.set_epoch(0)
+    first = next(iter(sub))
+    state = TrainState.create(init_params(model, first, seed=0), tx)
+    for b in sub:
+        state, metrics = step(state, b)
+    assert jit_cache_total(step) == 1
+    acc = GfmEpochAccumulator(full.member_names)
+    for epoch in range(2):
+        full.set_epoch(epoch)
+        for b in full:
+            state, metrics = step(state, b)
+            acc.update(b, metrics)
+    # adding the third member dataset adds ZERO compiles
+    assert jit_cache_total(step) == 1
+    assert sorted(metrics) == ["loss", "nonfinite_steps", "task_0",
+                               "task_1", "task_2"]
+    summ = acc.summary()
+    assert set(summ["head_losses"]) == {"alpha", "beta", "gamma"}
+    assert sum(summ["mixture_frac"].values()) == pytest.approx(1.0)
+    assert all(np.isfinite(v) for v in summ["head_losses"].values())
+
+
+def test_epoch_accumulator_count_weighted():
+    from hydragnn_tpu.train.gfm import GfmEpochAccumulator
+
+    class B:
+        def __init__(self, ids, mask):
+            self.dataset_id = np.asarray(ids)
+            self.graph_mask = np.asarray(mask)
+
+    acc = GfmEpochAccumulator(("a", "b"))
+    acc.update(B([0, 0, -1], [True, True, False]),
+               {"task_0": 2.0, "task_1": 0.0})
+    # a batch with zero member-b graphs contributes task_1 = 0.0 by the
+    # masked max(count, 1) denominator — it must NOT dilute b's mean
+    acc.update(B([1, -1, -1], [True, False, False]),
+               {"task_0": 0.0, "task_1": 5.0})
+    s = acc.summary()
+    assert s["head_losses"] == {"a": 2.0, "b": 5.0}
+    assert s["mixture_frac"] == {"a": 2 / 3, "b": 1 / 3}
+    assert acc.total_graphs == 3
+
+
+# -------------------------------------------- parallelism composition
+
+
+def test_gfm_spmd_composition():
+    """The composition proof, data-parallel leg: the SAME GfmMixtureLoader
+    + head-masked loss drive the SPMD step factory (with ZeRO partitioned
+    optimizer state) — masking rides inside multihead_loss, so the
+    factory needed zero changes. Heads whose member is absent from a
+    shard-stacked batch read an exact 0.0 task loss."""
+    import optax
+    from hydragnn_tpu.models.create import create_model, init_params
+    from hydragnn_tpu.parallel.mesh import make_mesh
+    from hydragnn_tpu.parallel.spmd import make_spmd_train_step
+    from hydragnn_tpu.train.gfm import apply_head_weights
+    from hydragnn_tpu.train.train_step import TrainState
+    from hydragnn_tpu.graphs.batch import collate
+
+    members = _members(sizes=(24, 16, 20))
+    _, mcfg = _gfm_config(members)
+    loader = GfmMixtureLoader(members, 16, cfg=mcfg, seed=3,
+                              num_shards=8)
+    model = create_model(mcfg)
+    init_batch = collate(members["alpha"][:2], n_node=loader.n_node,
+                         n_edge=loader.n_edge, n_graph=loader.n_graph)
+    variables = init_params(model, init_batch)
+    tx = optax.adam(1e-3)
+    state = TrainState.create(variables, tx)
+    mesh = make_mesh((("data", 8),))
+    step = make_spmd_train_step(
+        model, apply_head_weights(mcfg, (1.0, 1.0, 1.0)), tx, mesh,
+        zero_opt=True)
+    loader.set_epoch(0)
+    for i, batch in enumerate(loader):
+        assert np.asarray(batch.dataset_id).shape[0] == 8
+        state, metrics = step(state, batch)
+        if i >= 2:
+            break
+    assert np.isfinite(float(metrics["loss"]))
+    assert all(np.isfinite(float(metrics[f"task_{h}"]))
+               for h in range(3))
+
+
+def test_gfm_pipeline_composition():
+    """The composition proof, 1F1B leg: microbatches carrying dataset_id
+    flow through make_pipeline_train_step unchanged (it calls
+    multihead_loss directly). All-member-0 microbatches -> heads 1 and 2
+    read exact 0.0 losses (their masks are empty), head 0 trains."""
+    import optax
+    from hydragnn_tpu.datasets.loader import _stack_batches
+    from hydragnn_tpu.graphs.batch import collate
+    from hydragnn_tpu.parallel.mesh import make_mesh
+    from hydragnn_tpu.parallel.pipeline_trainer import (
+        init_pipeline_params, make_pipeline_train_step)
+    from hydragnn_tpu.train.train_step import TrainState
+
+    members = _members()
+    cfg, mcfg = _gfm_config(members)
+    cfg["NeuralNetwork"]["Architecture"]["num_conv_layers"] = 4
+    mcfg = build_model_config(cfg)
+    samples = members["alpha"]
+    micro = []
+    for i in range(0, 12, 3):  # 4 micros: a multiple of the 2 stages
+        b = collate(samples[i:i + 3], n_node=192, n_edge=4096, n_graph=4)
+        ids = np.where(np.asarray(b.graph_mask), np.int32(0),
+                       np.int32(-1))
+        micro.append(b.replace(dataset_id=ids))
+    stacked = _stack_batches(micro)
+    params = init_pipeline_params(jax.random.PRNGKey(0), mcfg, micro[0])
+    tx = optax.adam(1e-3)
+    state = TrainState.create({"params": params}, tx)
+    mesh = make_mesh((("pipe", 2),))
+    step = make_pipeline_train_step(mcfg, mesh, 2, tx)
+    for _ in range(2):
+        state, metrics = step(state, stacked)
+    assert np.isfinite(float(np.asarray(metrics["loss"])))
+    assert float(np.asarray(metrics["task_0"])) > 0.0
+    assert float(np.asarray(metrics["task_1"])) == 0.0
+    assert float(np.asarray(metrics["task_2"])) == 0.0
+
+
+# ------------------------------------------------------------ telemetry
+
+
+def test_record_gfm_epoch_gauges():
+    from hydragnn_tpu.telemetry import record_gfm_epoch
+    from hydragnn_tpu.telemetry.registry import (MetricsRegistry,
+                                                 set_registry)
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    try:
+        record_gfm_epoch({"alpha": 0.5}, val_losses={"alpha": 0.7},
+                         mixture_frac={"alpha": 1.0})
+        snap = reg.snapshot()
+        text = reg.to_prometheus()
+    finally:
+        set_registry(prev)
+    loss = snap["gfm_head_loss"]["values"]
+    assert loss[(("head", "alpha"), ("split", "train"))] == 0.5
+    assert loss[(("head", "alpha"), ("split", "val"))] == 0.7
+    frac = snap["gfm_mixture_frac"]["values"]
+    assert frac[(("dataset", "alpha"),)] == 1.0
+    assert 'head="alpha"' in text and 'split="val"' in text

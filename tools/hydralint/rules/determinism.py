@@ -50,7 +50,13 @@ SCOPE_DIRS = ("hydragnn_tpu/graphs/", "hydragnn_tpu/preprocess/",
               # the same calibration set (the compile-store identity):
               # layer-key iteration and amax accumulation must never
               # follow set or dict-insertion order
-              "hydragnn_tpu/quant/")
+              "hydragnn_tpu/quant/",
+              # the GFM layer promises a world-size-invariant mixture
+              # plan and bitwise head-masked aggregation: member
+              # iteration must never follow dict-insertion or set order
+              # (the loader pins Mapping members sorted by name)
+              "hydragnn_tpu/train/gfm.py",
+              "hydragnn_tpu/telemetry/gfm.py")
 
 _FS_OS = ("listdir", "scandir")
 _FS_GLOB = ("glob", "iglob")

@@ -105,6 +105,12 @@ TRACED_FILES = (
     # read here would fork the one-compile contract from a typo
     # (docs/sampling.md)
     os.path.join("hydragnn_tpu", "preprocess", "sampling.py"),
+    # the GFM step-factory layer: head combine weights and the mixture
+    # spec are baked into the compiled program's config (task_weights
+    # substitution) — they resolve ONCE through utils/envflags
+    # .resolve_gfm at the call site; an env read here would fork the
+    # one-compile mixture contract from a typo (docs/gfm.md)
+    os.path.join("hydragnn_tpu", "train", "gfm.py"),
 )
 
 MESSAGE = ("read inside a traced module — resolve it via utils/envflags.py "
