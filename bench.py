@@ -105,6 +105,46 @@ Env knobs:
                        also write the fleet JSON to this path (the
                        nightly fleet-chaos job emits
                        BENCH_SERVE_FLEET.json)
+  BENCH_CONTINUOUS     =1: continuous-learning production loop
+                       (docs/serving.md "Continuous loop", RUNBOOK.md) —
+                       a live trainer process under the JobSupervisor
+                       streams BEST/COMMITTED checkpoints while the
+                       CheckpointPublisher canaries each candidate into
+                       a serving fleet and the QueueDepthAutoscaler
+                       tracks the load, all in ONE run: the trainer is
+                       SIGTERM-preempted at its first commit and
+                       resumed; one deliberately poisoned candidate
+                       must fail the shadow-window drift adjudication,
+                       roll back, and be quarantined; the open-loop
+                       load doubles (scale-up must warm from the
+                       shared CompileStore with ZERO fresh compiles)
+                       then halves (scale-down through drain). Gates:
+                       zero lost futures, every live replica on ONE
+                       coherent final version, the final promoted
+                       incumbent is the trainer's last save. All
+                       BENCH_CONTINUOUS_* values parse via the
+                       utils/envflags strict helpers.
+  BENCH_CONTINUOUS_REPLICAS / BENCH_CONTINUOUS_MAX_REPLICAS
+                       starting fleet width / autoscale ceiling
+                       (default 2 / replicas+1; min is pinned to the
+                       starting width so the canary always has a
+                       spare)
+  BENCH_CONTINUOUS_SAVES / BENCH_CONTINUOUS_POISON_SAVE
+                       trainer save count and the 0-based index of the
+                       poisoned one (default 3 / 1)
+  BENCH_CONTINUOUS_SAVE_GAP_S
+                       trainer pause after each save (default 2.0; the
+                       poisoned save pauses twice as long so the
+                       publisher provably adjudicates it before the
+                       BEST marker moves on)
+  BENCH_CONTINUOUS_RATE
+                       baseline arrival rate in req/s (default: 2x the
+                       measured closed-loop throughput)
+  BENCH_CONTINUOUS_P99_BUDGET_MS / BENCH_CONTINUOUS_DEADLINE_S
+                       open-loop p99 gate and whole-run bound
+                       (default 10000 ms / 900 s)
+  BENCH_CONTINUOUS_OUT also write the JSON to this path (the nightly
+                       continuous-bench job emits BENCH_CONTINUOUS.json)
   BENCH_FAULTS         =1: chaos mode (docs/fault_tolerance.md) — run the
                        fault-tolerance adjudications end-to-end: a
                        training run killed at an injected forward-step
@@ -1292,6 +1332,478 @@ def run_bench_serve_fleet(backend=None):
         },
     }
     out_path = os.environ.get("BENCH_SERVE_FLEET_OUT", "").strip()
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1)
+    return out
+
+
+def _continuous_trainer_main():
+    """BENCH_CONT_CHILD=1: one generation of the continuous-loop
+    trainer (the BENCH_CONTINUOUS child process). Rebuilds the bench
+    model deterministically (same seeds and env as the driver), resumes
+    from the newest COMMITTED save, and commits the remaining saves as
+    BEST checkpoints through the PR 4 contract — each a slightly
+    scaled copy of the base params (a strictly improving best_val
+    moves the BEST marker every time), except the POISON save whose
+    params are scaled 1e3x: finite, restorable, committed — and
+    catastrophically wrong, exactly what the publisher's shadow-window
+    drift adjudication must catch."""
+    import jax
+
+    from hydragnn_tpu.graphs.batch import collate
+    from hydragnn_tpu.models.create import init_params
+    from hydragnn_tpu.train.train_step import TrainState
+    from hydragnn_tpu.utils.checkpoint import (_step_dirs,
+                                               load_checkpoint_metadata,
+                                               save_model,
+                                               verify_checkpoint)
+    from hydragnn_tpu.utils.envflags import (env_str, env_strict_float,
+                                             env_strict_int)
+
+    root = env_str("BENCH_CONT_DIR", "")
+    log_name = env_str("BENCH_CONT_LOG", "cont_bench")
+    saves = env_strict_int("BENCH_CONT_SAVES", 3)
+    poison = env_strict_int("BENCH_CONT_POISON_SAVE", 1)
+    gap_s = env_strict_float("BENCH_CONT_GAP_S", 2.0)
+    result_path = env_str("BENCH_CONT_RESULT", "")
+
+    rng = np.random.RandomState(0)
+    samples = synth_samples(64, rng, (8, 40), dist="loguniform")
+    _, _, model, tx, _, _ = _bench_model(samples)
+    variables = init_params(model, collate(samples[:4]))
+
+    # resume point: the newest COMMITTED save's metadata names the save
+    # index it carried — a torn newest dir falls through to the intact
+    # one before it (the PR 4 ordering contract)
+    start = 0
+    ckpt_dir = os.path.join(root, log_name, "checkpoint")
+    for step, d in (_step_dirs(ckpt_dir)
+                    if os.path.isdir(ckpt_dir) else []):
+        if verify_checkpoint(d):
+            meta = load_checkpoint_metadata(d) or {}
+            start = int(meta.get("save_idx", step - 1)) + 1
+            break
+
+    for k in range(start, saves):
+        scale = 1e3 if k == poison else 1.0 + 1e-3 * (k + 1)
+        state = TrainState.create(
+            {"params": jax.tree_util.tree_map(
+                lambda a, s=scale: a * s, variables["params"]),
+             "batch_stats": variables.get("batch_stats", {})},
+            tx).replace(step=k + 1)
+        save_model(state, log_name, path=root, mark_best=True,
+                   best_val=1.0 / (k + 2),
+                   metadata={"next_epoch": k + 1, "step": k + 1,
+                             "save_idx": k})
+        # the poisoned candidate must sit under the BEST marker long
+        # enough to be adjudicated before the next save moves it
+        time.sleep(gap_s * (2.0 if k == poison else 1.0))
+
+    out = {"saves": saves, "final_step": saves, "resumed_from": start}
+    if result_path:
+        tmp = result_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(out, f)
+        os.replace(tmp, result_path)
+    return out
+
+
+class _TrainerHandle:
+    """RankHandle over the continuous-loop trainer child — SIGTERM with
+    a SIGKILL escalation (the injected preemption must land even if the
+    child is wedged), progress/checkpoint probes over the shared
+    checkpoint dir (any newly COMMITTED step counts as a heartbeat)."""
+
+    def __init__(self, proc, ckpt_dir, result_path):
+        self._proc = proc
+        self._ckpt_dir = ckpt_dir
+        self._result_path = result_path
+
+    def poll(self):
+        return self._proc.poll()
+
+    def kill(self):
+        import subprocess
+        if self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+                self._proc.wait(timeout=10)
+
+    def progress(self):
+        return (self.checkpoint_step(), self._proc.poll() is None)
+
+    def checkpoint_step(self):
+        from hydragnn_tpu.utils.checkpoint import (_step_dirs,
+                                                   verify_checkpoint)
+        if not os.path.isdir(self._ckpt_dir):
+            return None  # nothing committed yet
+        for step, d in _step_dirs(self._ckpt_dir):
+            if verify_checkpoint(d):
+                return int(step)
+        return None
+
+    def result(self):
+        try:
+            with open(self._result_path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+
+def run_bench_continuous(backend=None):
+    """BENCH_CONTINUOUS: the continuous-learning production loop end to
+    end (docs/serving.md "Continuous loop"; RUNBOOK.md) — ONE run in
+    which a supervised trainer process streams BEST/COMMITTED
+    checkpoints into a live serving fleet through the
+    CheckpointPublisher's canary protocol while the
+    QueueDepthAutoscaler tracks a diurnal load curve, under chaos on
+    every axis:
+
+      * the trainer is SIGTERM-preempted (the supervisor's own
+        ``rank-kill`` site) at its first committed save and restarted
+        with resume — the remaining saves still stream;
+      * one deliberately poisoned candidate (params scaled 1e3x:
+        committed, restorable, catastrophically wrong) must fail the
+        shadow-window drift adjudication on the canary, roll back, and
+        be quarantined — the fleet never serves it a primary request;
+      * the open-loop arrival rate doubles (queue depth crosses the
+        high watermark; the scale-up replica must warm from the shared
+        CompileStore with ZERO fresh compiles and join on the
+        published version) then halves (the surge replica retires
+        through drain).
+
+    Gates: the trainer job COMPLETES with >= 1 restart, exactly one
+    rollback, the poison version quarantined, the final incumbent is
+    the trainer's LAST save, every live replica ends on that ONE
+    version, zero futures lost, and the pooled open-loop p99 lands
+    under budget."""
+    import shutil
+    import subprocess
+    import tempfile
+    import threading
+    from concurrent.futures import TimeoutError as FutTimeout
+
+    from hydragnn_tpu.elastic import COMPLETED, JobLedger, JobSupervisor
+    from hydragnn_tpu.graphs.batch import collate
+    from hydragnn_tpu.models.create import init_params
+    from hydragnn_tpu.serving.autoscale import QueueDepthAutoscaler
+    from hydragnn_tpu.serving.config import (AutoscaleConfig,
+                                             PublishConfig)
+    from hydragnn_tpu.serving.engine import InferenceEngine
+    from hydragnn_tpu.serving.fleet import ReplicaRouter
+    from hydragnn_tpu.serving.publish import CheckpointPublisher
+    from hydragnn_tpu.train.train_step import TrainState
+    from hydragnn_tpu.utils.devices import CompileStore
+    from hydragnn_tpu.utils.envflags import (env_strict_float,
+                                             env_strict_int)
+    from hydragnn_tpu.utils.faults import (install_fault_plan,
+                                           parse_fault_plan)
+
+    if backend is None:
+        backend = _resolve_backend_and_cache()
+    n_rep = max(env_strict_int("BENCH_CONTINUOUS_REPLICAS", 2), 2)
+    max_rep = max(env_strict_int("BENCH_CONTINUOUS_MAX_REPLICAS",
+                                 n_rep + 1), n_rep + 1)
+    saves = env_strict_int("BENCH_CONTINUOUS_SAVES", 3)
+    poison = env_strict_int("BENCH_CONTINUOUS_POISON_SAVE", 1)
+    gap_s = env_strict_float("BENCH_CONTINUOUS_SAVE_GAP_S", 2.0)
+    rate = env_strict_float("BENCH_CONTINUOUS_RATE", 0.0)
+    p99_budget = env_strict_float("BENCH_CONTINUOUS_P99_BUDGET_MS",
+                                  10000.0)
+    deadline_s = env_strict_float("BENCH_CONTINUOUS_DEADLINE_S", 900.0)
+    use_nbr = os.environ.get("BENCH_NBR", "1") != "0"
+
+    # the trainer child rebuilds this EXACT model from the same seeds +
+    # env, so its checkpoints restore cleanly into the fleet's template
+    rng = np.random.RandomState(0)
+    samples = synth_samples(64, rng, (8, 40), dist="loguniform")
+    _, mcfg, model, tx, _, compute_dtype = _bench_model(samples)
+    variables = init_params(model, collate(samples[:4]))
+
+    work = tempfile.mkdtemp(prefix="bench_cont_")
+    store = CompileStore(os.path.join(work, "compile_store"))
+    ckpt_root = os.path.join(work, "logs")
+    log_name = "cont_bench"
+    result_path = os.path.join(work, "trainer_result.json")
+    final_version = f"best:step_{saves}"
+    poison_version = f"best:step_{poison + 1}"
+
+    def factory(idx):
+        return InferenceEngine(
+            model, variables, mcfg, reference_samples=samples,
+            max_batch_size=8, max_wait_ms=1.0, neighbor_format=use_nbr,
+            compute_dtype=compute_dtype, compile_store=store,
+            model_version="v0", breaker_threshold=3, breaker_reset_s=0.3)
+
+    def launch_trainer(generation, world_size, rank, resume, hang):
+        env = dict(os.environ, BENCH_CONT_CHILD="1",
+                   JAX_PLATFORMS="cpu", BENCH_WAIT_TUNNEL_S="0",
+                   BENCH_CONT_DIR=ckpt_root, BENCH_CONT_LOG=log_name,
+                   BENCH_CONT_SAVES=str(saves),
+                   BENCH_CONT_POISON_SAVE=str(poison),
+                   BENCH_CONT_GAP_S=str(gap_s),
+                   BENCH_CONT_RESULT=result_path)
+        env.pop("BENCH_CONTINUOUS", None)  # the child must not recurse
+        log = open(os.path.join(work, f"trainer_gen{generation}.log"),
+                   "ab")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                stdout=log, stderr=subprocess.STDOUT)
+        finally:
+            log.close()  # Popen dup'd the fd; the child holds its own
+        return _TrainerHandle(
+            proc, os.path.join(ckpt_root, log_name, "checkpoint"),
+            result_path)
+
+    publisher = autoscaler = sup = router = None
+    t_start = time.perf_counter()
+    try:
+        router = ReplicaRouter(factory, n_rep)
+        warm_reports = router.warmup()
+
+        template = TrainState.create(
+            {"params": variables["params"],
+             "batch_stats": variables.get("batch_stats", {})}, tx)
+        # the latency gate is effectively disabled (factor 1e3 over a
+        # 1 s floor): on shared CI hosts paired-latency noise dwarfs
+        # any real candidate regression — the DRIFT bound is the
+        # adjudicator that must catch the poison
+        publisher = CheckpointPublisher(
+            router, template, log_name, path=ckpt_root,
+            incumbent_variables=variables, incumbent_version="v0",
+            config=PublishConfig(
+                poll_interval_s=0.2, mirror_every=2, window_pairs=6,
+                min_pairs=3, window_timeout_s=10.0, max_rel_err=0.5,
+                latency_factor=1000.0, latency_floor_ms=1000.0))
+        # min pinned at the starting width: the baseline leg's paced
+        # (empty-queue) traffic must not shrink the fleet below the
+        # 2 routable replicas the canary protocol needs
+        autoscaler = QueueDepthAutoscaler(
+            router, config=AutoscaleConfig(
+                min_replicas=n_rep, max_replicas=max_rep,
+                high_depth=2.0, low_depth=0.25, cooldown_s=2.0,
+                poll_interval_s=0.25, drain_timeout_s=60.0))
+
+        # closed-loop throughput calibrates the open-loop rate
+        t0 = time.perf_counter()
+        router.predict(samples, timeout=300)
+        closed_gps = len(samples) / (time.perf_counter() - t0)
+        if rate <= 0:
+            rate = 2.0 * closed_gps
+        router.reset_stats()
+
+        ledger = JobLedger()
+        sup = JobSupervisor(launch_trainer, world_size=1,
+                            max_restarts=2, heartbeat_s=120.0,
+                            backoff_s=0.5, poll_interval_s=0.2,
+                            ledger=ledger)
+        # the supervisor's OWN preemption site: SIGTERM gen-0 rank-0 at
+        # its first committed save, restart with resume (the serving
+        # sites are keyed by different names, so the plans cannot
+        # interfere)
+        install_fault_plan(parse_fault_plan("rank-kill@0"))
+        rec_box = {}
+        sup_thread = threading.Thread(
+            target=lambda: rec_box.update(
+                rec=sup.run(deadline_s=deadline_s)),
+            daemon=True)
+        sup_thread.start()
+        publisher.start()
+        autoscaler.start()
+
+        # --- leg 1 (baseline): paced arrivals feed the shadow windows
+        # while the trainer streams saves through kill/resume and the
+        # poisoned candidate's rollback; paced = resolve-before-next,
+        # so queue depth stays under both watermarks and the fleet
+        # width is the publisher's alone to manage
+        arrival = np.random.RandomState(7)
+        all_futs = []
+
+        def submit_one(i):
+            f = router.submit(samples[i % len(samples)])
+            all_futs.append(f)
+            return f
+
+        def baseline_done():
+            return (rec_box.get("rec") is not None
+                    and publisher.snapshot()[
+                        "incumbent_version"] == final_version)
+
+        i = 0
+        leg_deadline = time.monotonic() + deadline_s
+        while not baseline_done() and time.monotonic() < leg_deadline:
+            time.sleep(min(arrival.exponential(1.0 / max(rate, 1.0)),
+                           0.25))
+            f = submit_one(i)
+            i += 1
+            try:
+                f.exception(timeout=60)
+            except FutTimeout:
+                pass
+        baseline_ok = baseline_done()
+
+        # --- leg 2 (surge): burst arrivals pile queue depth over the
+        # high watermark until the autoscaler grows the fleet
+        # (disk-warm: zero fresh compiles, published-version reconcile)
+        def surged():
+            return autoscaler.snapshot()["scale_up_count"] >= 1
+
+        burst_n = 64
+        leg_deadline = time.monotonic() + 120
+        while not surged() and time.monotonic() < leg_deadline:
+            burst = [submit_one(i + j) for j in range(burst_n)]
+            i += burst_n
+            t_poll = time.monotonic() + 1.0
+            while not surged() and time.monotonic() < t_poll:
+                time.sleep(0.05)
+            for f in burst:  # bound the backlog between bursts
+                try:
+                    f.exception(timeout=120)
+                except FutTimeout:
+                    pass
+            burst_n = min(burst_n * 2, 256)
+        scaled_up = surged()
+
+        # --- leg 3 (lull): a trickle leaves the queues empty; the
+        # autoscaler retires the surge replica through drain
+        def lulled():
+            return autoscaler.snapshot()["scale_down_count"] >= 1
+
+        leg_deadline = time.monotonic() + 120
+        while not lulled() and time.monotonic() < leg_deadline:
+            f = submit_one(i)
+            i += 1
+            try:
+                f.exception(timeout=60)
+            except FutTimeout:
+                pass
+            time.sleep(0.2)
+        scaled_down = lulled()
+
+        # --- adjudication: every submitted future resolved, none lost
+        unresolved = 0
+        for f in all_futs:
+            try:
+                f.exception(timeout=300)
+            except FutTimeout:
+                unresolved += 1
+        failures = [f for f in all_futs
+                    if f.done() and f.exception(timeout=0) is not None]
+
+        publisher.stop()
+        autoscaler.stop()
+        sup_thread.join(timeout=120)
+        if sup_thread.is_alive():
+            sup.shutdown()
+            sup_thread.join(timeout=60)
+        install_fault_plan(None)
+
+        health = router.health()
+        stats = router.stats()
+        snap = publisher.snapshot()
+        asnap = autoscaler.snapshot()
+        router.shutdown()
+    finally:
+        install_fault_plan(None)
+        for obj in (publisher, autoscaler):
+            if obj is not None:
+                obj.stop()
+        if sup is not None:
+            sup.shutdown()
+        if router is not None:
+            router.shutdown()
+        shutil.rmtree(work, ignore_errors=True)
+
+    rec = rec_box.get("rec")
+    kills = [e for e in ledger.data_view() if e["event"] == "killed"]
+    preempted_and_resumed = (rec is not None and rec.state == COMPLETED
+                             and rec.restarts >= 1 and len(kills) >= 1)
+    quarantined = list(health.get("quarantined_versions", []))
+    poison_quarantined = poison_version in quarantined
+    alive_versions = sorted({h["model_version"]
+                             for h in health["replicas"].values()
+                             if h["alive"]})
+    coherent = alive_versions == [snap["incumbent_version"]]
+    up_events = [e for e in asnap["events"]
+                 if e["action"] == "scale_up"]
+    up_fresh = sum(int(e.get("fresh_compiles") or 0) for e in up_events)
+    p99 = float(stats.get("p99_ms", 0.0))
+
+    passed = (preempted_and_resumed and baseline_ok
+              and snap["incumbent_version"] == final_version
+              and snap["rollback_count"] == 1 and poison_quarantined
+              and coherent and unresolved == 0 and not failures
+              and scaled_up and scaled_down and up_fresh == 0
+              and 0.0 < p99 <= p99_budget)
+    out = {
+        "metric": "continuous_loop_chaos",
+        "value": 1.0 if passed else 0.0,
+        "unit": "pass",
+        "vs_baseline": None,
+        "backend": backend,
+        "passed": passed,
+        "shape": {"replicas": n_rep, "max_replicas": max_rep,
+                  "saves": saves, "poison_save": poison,
+                  "size_range": [8, 40], "hidden": HIDDEN,
+                  "max_batch_size": 8},
+        "dtype": compute_dtype,
+        "closed_loop_gps": round(closed_gps, 2),
+        "trainer": {
+            "state": None if rec is None else rec.state,
+            "restarts": None if rec is None else rec.restarts,
+            "generations": None if rec is None else rec.generations,
+            "injected_kills_landed": len(kills),
+            "preempted_and_resumed": preempted_and_resumed,
+            "result": None if rec is None else rec.result,
+        },
+        "publish": {
+            "incumbent_version": snap["incumbent_version"],
+            "final_version_expected": final_version,
+            "publish_count": snap["publish_count"],
+            "promote_count": snap["promote_count"],
+            "rollback_count": snap["rollback_count"],
+            "skipped_uncommitted": snap["skipped_uncommitted"],
+            "poison_version": poison_version,
+            "poison_quarantined": poison_quarantined,
+            "history": snap["history"],
+        },
+        "fleet": {
+            "warmup_reports": warm_reports,
+            "alive_versions": alive_versions,
+            "coherent_final_version": coherent,
+            "quarantined_versions": quarantined,
+            "request_failures": len(failures),
+            "unresolved_futures": unresolved,
+            "no_lost_futures": unresolved == 0,
+            "swap_failures": stats.get("swap_failures", 0),
+            "redispatches": stats.get("redispatches", 0),
+        },
+        "autoscale": {
+            "scale_up_count": asnap["scale_up_count"],
+            "scale_down_count": asnap["scale_down_count"],
+            "skipped_canary": asnap["skipped_canary"],
+            "scaled_up_and_down": scaled_up and scaled_down,
+            "scale_up_fresh_compiles": up_fresh,
+            "events": asnap["events"],
+        },
+        "open_loop": {
+            "rate_rps": round(rate, 2),
+            "requests": len(all_futs),
+            "p50_ms": round(stats.get("p50_ms", 0.0), 3),
+            "p95_ms": round(stats.get("p95_ms", 0.0), 3),
+            "p99_ms": round(p99, 3),
+            "mean_ms": round(stats.get("mean_ms", 0.0), 3),
+            "p99_budget_ms": p99_budget,
+        },
+        "ledger_data": ledger.data_view(),
+        "elapsed_s": round(time.perf_counter() - t_start, 2),
+    }
+    out_path = os.environ.get("BENCH_CONTINUOUS_OUT", "").strip()
     if out_path:
         with open(out_path, "w") as f:
             json.dump(out, f, indent=1)
@@ -3908,10 +4420,16 @@ def _pin_cpu_host_threads():
 
 
 def main():
-    if os.environ.get("BENCH_SWEEP") == "1":
+    if os.environ.get("BENCH_CONT_CHILD") == "1":
+        # the BENCH_CONTINUOUS trainer child — dispatched before every
+        # other mode so the driver env it inherits cannot recurse
+        out = _continuous_trainer_main()
+    elif os.environ.get("BENCH_SWEEP") == "1":
         out = sweep()
     elif os.environ.get("BENCH_SERVE_FLEET") == "1":
         out = run_bench_serve_fleet()
+    elif os.environ.get("BENCH_CONTINUOUS") == "1":
+        out = run_bench_continuous()
     elif os.environ.get("BENCH_SERVE") == "1":
         out = run_bench_serve()
     elif os.environ.get("BENCH_FAULTS") == "1":

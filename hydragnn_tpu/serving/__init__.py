@@ -1,22 +1,32 @@
 """Batched inference serving: request micro-batching over a bucketed
 compile cache (docs/serving.md), with explicit failure semantics —
 bounded admission, per-request deadlines, dispatcher circuit breaker
-(docs/fault_tolerance.md) — and the fleet layer on top: a replica
-router with per-replica failure isolation, zero-downtime hot-swap, and
-a persistent AOT compile store (docs/serving.md "Fleet")."""
-from .config import (FleetConfig, ServingConfig, Structure, resolve_fleet,
-                     resolve_serving)
+(docs/fault_tolerance.md) — the fleet layer on top: a replica router
+with per-replica failure isolation, zero-downtime hot-swap, and a
+persistent AOT compile store (docs/serving.md "Fleet") — and the
+continuous-learning loop over both: a checkpoint publisher that
+canaries each new BEST save into the fleet with auto-rollback, plus a
+queue-depth autoscaler (docs/serving.md "Continuous loop")."""
+from .autoscale import QueueDepthAutoscaler
+from .config import (AutoscaleConfig, FleetConfig, PublishConfig,
+                     ServingConfig, Structure, resolve_autoscale,
+                     resolve_fleet, resolve_publish, resolve_serving)
 from .engine import (CircuitOpenError, DeadlineExceededError,
                      InferenceEngine, QueueFullError, ServingError,
                      StructureSession, bucket_ladder, select_bucket)
 from .fleet import FleetUnavailableError, ReplicaRouter, SwapFailedError
+from .publish import CheckpointPublisher, adjudicate_window, pair_rel_err
 
 __all__ = [
+    "AutoscaleConfig",
+    "CheckpointPublisher",
     "CircuitOpenError",
     "DeadlineExceededError",
     "FleetConfig",
     "FleetUnavailableError",
     "InferenceEngine",
+    "PublishConfig",
+    "QueueDepthAutoscaler",
     "QueueFullError",
     "ReplicaRouter",
     "ServingConfig",
@@ -24,8 +34,12 @@ __all__ = [
     "Structure",
     "StructureSession",
     "SwapFailedError",
+    "adjudicate_window",
     "bucket_ladder",
+    "pair_rel_err",
+    "resolve_autoscale",
     "resolve_fleet",
+    "resolve_publish",
     "resolve_serving",
     "select_bucket",
 ]

@@ -1,0 +1,215 @@
+"""Queue-depth-driven fleet autoscaling (docs/serving.md "Continuous
+loop").
+
+Fleet size was fixed at construction: diurnal open-loop load either
+over-provisions the trough or queues the peak. ``QueueDepthAutoscaler``
+closes that half of ROADMAP item 4: it watches the router's health
+snapshot and, on sustained pressure, grows or shrinks the fleet —
+
+* **signal** — MEAN queue depth over the routable replicas (depth is
+  the engine-side admission queue; it is what request latency actually
+  queues behind). Above ``high_depth`` with room under ``max_replicas``
+  -> scale up; below ``low_depth`` with slack above ``min_replicas`` ->
+  scale down; a ``cooldown_s`` gap separates consecutive actions so
+  opposing decisions cannot thrash.
+* **scale-up is disk-warm** — a previously retired slot is revived via
+  ``router.restart_replica`` (else ``router.add_replica`` appends a new
+  slot); either way the engine warms its bucket ladder from the shared
+  persistent CompileStore (0 fresh compiles, the PR 12 contract) and
+  joins rotation ON the fleet's published model version (the router's
+  ``record_published`` reconcile), so autoscaling can never spawn a
+  stale-version replica.
+* **scale-down goes through drain** — ``router.retire_replica`` takes
+  the HIGHEST-index live replica out of rotation, waits for its queue
+  to empty (zero lost futures), then shuts the engine down. A drain
+  that outlives its bound re-admits the replica and the autoscaler
+  simply retries on a later tick.
+* **a canary freezes scaling** — while the CheckpointPublisher owns a
+  replica mid-adjudication, every decision is skipped: resizing the
+  fleet under a roll would fight the publisher's drain/swap sequence
+  and skew its shadow-window latencies.
+
+Lock discipline (docs/static_analysis.md): counters/events are
+``# guarded-by: _lock``; router calls and the poll sleep run outside
+it. Knobs resolve via serving/config.resolve_autoscale at construction
+(the traced-env rule), never by env reads here.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from ..telemetry.registry import get_registry
+from .config import AutoscaleConfig
+
+
+class QueueDepthAutoscaler:
+    """Single-writer fleet scaler over a ReplicaRouter (module
+    docstring for the policy). Synchronous use: ``step()`` evaluates
+    one decision (returns the event dict, or None). Background use:
+    ``start()`` polls every ``cfg.poll_interval_s`` until ``stop()``.
+    One autoscaler per router — ``add_replica`` is documented
+    single-writer."""
+
+    def __init__(self, router, *,
+                 config: Optional[AutoscaleConfig] = None):
+        self.router = router
+        self.cfg = config if config is not None else AutoscaleConfig()
+        if self.cfg.min_replicas < 1:
+            raise ValueError(
+                f"min_replicas={self.cfg.min_replicas!r} must be >= 1 — "
+                "a fleet scaled to zero cannot serve")
+        if self.cfg.max_replicas < self.cfg.min_replicas:
+            raise ValueError(
+                f"max_replicas={self.cfg.max_replicas!r} < min_replicas="
+                f"{self.cfg.min_replicas!r}")
+        self._lock = threading.Lock()
+        self.scale_up_count = 0  # guarded-by: _lock
+        self.scale_down_count = 0  # guarded-by: _lock
+        self.skipped_canary = 0  # guarded-by: _lock — ticks skipped
+        #   because a publish adjudication owned a replica
+        self.events: List[dict] = []  # guarded-by: _lock — ordered
+        #   scale actions (BENCH_CONTINUOUS emits them)
+        self._last_action_t: Optional[float] = None  # guarded-by: _lock
+        self._t0 = time.monotonic()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def _run():
+            while not self._stop.is_set():
+                try:
+                    self.step()
+                except Exception:  # noqa: BLE001 — a transient router
+                    # error must not kill the scaling loop
+                    import logging
+                    logging.getLogger("hydragnn_tpu").warning(
+                        "autoscaler step failed", exc_info=True)
+                self._stop.wait(self.cfg.poll_interval_s)
+
+        self._thread = threading.Thread(target=_run, daemon=True,
+                                        name="fleet-autoscaler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=60)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"scale_up_count": self.scale_up_count,
+                    "scale_down_count": self.scale_down_count,
+                    "skipped_canary": self.skipped_canary,
+                    "events": [dict(e) for e in self.events]}
+
+    # -------------------------------------------------------------- decision
+
+    def step(self) -> Optional[dict]:
+        """Evaluate one scaling decision against the current health
+        snapshot. Returns the recorded event dict when an action was
+        taken, else None."""
+        cfg = self.cfg
+        health = self.router.health()
+        if health["state"] == "shutdown":
+            return None
+        reps = health["replicas"]
+        if any(h.get("canary") for h in reps.values()):
+            with self._lock:
+                self.skipped_canary += 1
+            return None
+        live = [h for h in reps.values() if h["alive"]]
+        n_live = len(live)
+        depths = [float(h["queue_depth"]) for h in live
+                  if h["dispatcher_alive"]]
+        avg_depth = sum(depths) / len(depths) if depths else 0.0
+        now = time.monotonic()
+        with self._lock:
+            cooling = (self._last_action_t is not None
+                       and now - self._last_action_t < cfg.cooldown_s)
+        if cooling:
+            return None
+        if avg_depth >= cfg.high_depth and n_live < cfg.max_replicas:
+            return self._scale_up(reps, avg_depth, n_live)
+        if avg_depth <= cfg.low_depth and n_live > cfg.min_replicas:
+            return self._scale_down(reps, avg_depth, n_live)
+        return None
+
+    def _scale_up(self, reps: dict, avg_depth: float,
+                  n_live: int) -> Optional[dict]:
+        # prefer reviving a retired slot (restart_replica) over growing
+        # the replica list — both are disk-warm, the former keeps
+        # indices dense
+        retired = sorted(int(i) for i, h in reps.items()
+                         if h.get("retired"))
+        try:
+            if retired:
+                report = self.router.restart_replica(retired[0])
+            else:
+                report = self.router.add_replica()
+        except (RuntimeError, ValueError) as exc:
+            import logging
+            logging.getLogger("hydragnn_tpu").warning(
+                "autoscale scale-up failed: %s", exc)
+            return None
+        event = {"action": "scale_up", "replica": report["replica"],
+                 "revived": bool(retired), "avg_depth": avg_depth,
+                 "replicas_before": n_live,
+                 "replicas_after": n_live + 1,
+                 "fresh_compiles": report.get("fresh", 0),
+                 "warmup_s": report.get("warmup_s", 0.0),
+                 "t_s": round(time.monotonic() - self._t0, 3)}
+        with self._lock:
+            self.scale_up_count += 1
+            self.events.append(event)
+            self._last_action_t = time.monotonic()
+        self._count("scale_up")
+        return event
+
+    def _scale_down(self, reps: dict, avg_depth: float,
+                    n_live: int) -> Optional[dict]:
+        # retire the HIGHEST-index live replica: lowest indices carry
+        # the `_pick` tie-break traffic, and dense-from-zero slots keep
+        # revival deterministic
+        victims = sorted((int(i) for i, h in reps.items()
+                          if h["alive"] and not h.get("canary")),
+                         reverse=True)
+        if not victims:
+            return None
+        victim = victims[0]
+        try:
+            self.router.retire_replica(
+                victim, timeout_s=self.cfg.drain_timeout_s)
+        except (TimeoutError, ValueError) as exc:
+            # drain outlived its bound (the replica was re-admitted) or
+            # state changed under us — retry on a later tick
+            import logging
+            logging.getLogger("hydragnn_tpu").warning(
+                "autoscale scale-down of replica %d skipped: %s",
+                victim, exc)
+            return None
+        event = {"action": "scale_down", "replica": victim,
+                 "avg_depth": avg_depth, "replicas_before": n_live,
+                 "replicas_after": n_live - 1,
+                 "t_s": round(time.monotonic() - self._t0, 3)}
+        with self._lock:
+            self.scale_down_count += 1
+            self.events.append(event)
+            self._last_action_t = time.monotonic()
+        self._count("scale_down")
+        return event
+
+    @staticmethod
+    def _count(action: str) -> None:
+        get_registry().counter_inc(
+            "serve.autoscale_total",
+            help="autoscaler actions by direction",
+            action=action)

@@ -34,6 +34,35 @@ Config schema (top-level block, alongside "Dataset"/"NeuralNetwork"):
             "cand_headroom": 0.5       # static candidate/degree capacity
                                        # headroom over the initial builds
         },
+        "publish": {               # continuous-learning publisher knobs
+                                   # (docs/serving.md "Continuous loop";
+                                   # serving/publish.py)
+            "poll_interval_s": 1.0,    # BEST-marker poll cadence
+            "mirror_every": 2,         # shadow slice: every k-th request
+            "window_pairs": 8,         # pairs to adjudicate per canary
+            "min_pairs": 3,            # fewer than this at timeout
+                                       # aborts the canary (no quarantine)
+            "window_timeout_s": 30.0,  # max canary window wall-clock
+            "max_rel_err": 0.25,       # candidate-vs-incumbent output
+                                       # drift bound (relative)
+            "latency_factor": 3.0,     # candidate p99 budget as a factor
+                                       # of max(incumbent p99, floor)
+            "latency_floor_ms": 50.0   # incumbent-p99 floor for the
+                                       # latency gate (noise guard)
+        },
+        "autoscale": {             # queue-depth autoscaler knobs
+                                   # (docs/serving.md "Continuous loop";
+                                   # serving/autoscale.py)
+            "min_replicas": 1,
+            "max_replicas": 4,
+            "high_depth": 4.0,         # avg routable queue depth that
+                                       # triggers scale-up
+            "low_depth": 0.5,          # avg depth that triggers
+                                       # scale-down
+            "cooldown_s": 5.0,         # min seconds between actions
+            "poll_interval_s": 1.0,
+            "drain_timeout_s": 30.0    # scale-down drain bound
+        },
         "fleet": {                 # replica-router knobs (docs/serving.md
                                    # "Fleet"; serving/fleet.py)
             "replicas": 1,             # engines behind the router
@@ -95,6 +124,25 @@ tier routing (docs/serving.md "Tiered fleets"; fleet.TierPolicy):
 or above that priority prefer the `tier_accurate` replicas, the rest
 prefer `tier_fast`, and `tier_quota` caps the accurate tier's dispatch
 share. 0 (the default) keeps the fleet tier-blind.
+
+`publish` (env: HYDRAGNN_PUBLISH_POLL_S / HYDRAGNN_PUBLISH_MIRROR_EVERY
+/ HYDRAGNN_PUBLISH_WINDOW_PAIRS / HYDRAGNN_PUBLISH_MIN_PAIRS /
+HYDRAGNN_PUBLISH_WINDOW_TIMEOUT_S / HYDRAGNN_PUBLISH_MAX_REL_ERR /
+HYDRAGNN_PUBLISH_LATENCY_FACTOR / HYDRAGNN_PUBLISH_LATENCY_FLOOR_MS,
+strict parsing) tunes the CheckpointPublisher's canary adjudication
+(docs/serving.md "Continuous loop"): `max_rel_err` is a DRIFT bound —
+candidate outputs are compared against the incumbent's on identical
+mirrored samples, so it must admit a legitimate training update's
+output change while rejecting a poisoned/torn candidate (NaN or
+blown-up outputs compare as infinite drift).
+
+`autoscale` (env: HYDRAGNN_AUTOSCALE_MIN / HYDRAGNN_AUTOSCALE_MAX /
+HYDRAGNN_AUTOSCALE_HIGH_DEPTH / HYDRAGNN_AUTOSCALE_LOW_DEPTH /
+HYDRAGNN_AUTOSCALE_COOLDOWN_S / HYDRAGNN_AUTOSCALE_POLL_S /
+HYDRAGNN_AUTOSCALE_DRAIN_TIMEOUT_S, strict parsing) sizes the
+QueueDepthAutoscaler: watermarks are AVERAGE queue depth over the
+routable replicas; the cooldown prevents thrash between opposing
+actions.
 
 `md_farm` (env: HYDRAGNN_MD_FARM_STEPS_PER_DISPATCH /
 HYDRAGNN_MD_FARM_CAND_HEADROOM, strict parsing) tunes the trajectory
@@ -213,6 +261,113 @@ def resolve_fleet(config: Optional[Dict[str, Any]] = None) -> FleetConfig:
         tier_fast=env_str("HYDRAGNN_FLEET_TIER_FAST", base.tier_fast),
         tier_accurate=env_str("HYDRAGNN_FLEET_TIER_ACCURATE",
                               base.tier_accurate),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class PublishConfig:
+    """CheckpointPublisher knobs (docs/serving.md "Continuous loop";
+    serving/publish.py). The canary CONTRACT — one replica, shadow
+    mirror, promote-or-quarantine, coherent-version rollback — is not
+    knobbed; these only size the adjudication window and its bounds."""
+    poll_interval_s: float = 1.0   # BEST-marker poll cadence
+    mirror_every: int = 2          # shadow slice: every k-th request
+    window_pairs: int = 8          # pairs to adjudicate per canary
+    min_pairs: int = 3             # fewer at timeout = aborted canary
+    window_timeout_s: float = 30.0
+    max_rel_err: float = 0.25      # candidate-vs-incumbent drift bound
+    latency_factor: float = 3.0    # candidate p99 <= factor *
+    # max(incumbent p99, latency_floor_ms)
+    latency_floor_ms: float = 50.0
+
+
+def resolve_publish(config: Optional[Dict[str, Any]] = None
+                    ) -> PublishConfig:
+    """Merge the `Serving.publish` block and the HYDRAGNN_PUBLISH_* env
+    knobs (strict parsing — a typo warns and keeps the default). Shared
+    by the publisher's callers and bench.py so precedence cannot
+    drift."""
+    from ..utils.envflags import env_strict_float, env_strict_int
+    block = ((config or {}).get("Serving", {}) or {}).get("publish",
+                                                          {}) or {}
+    base = PublishConfig(
+        poll_interval_s=float(block.get("poll_interval_s", 1.0) or 1.0),
+        mirror_every=int(block.get("mirror_every", 2) or 2),
+        window_pairs=int(block.get("window_pairs", 8) or 8),
+        min_pairs=int(block.get("min_pairs", 3) or 3),
+        window_timeout_s=float(block.get("window_timeout_s", 30.0)
+                               or 30.0),
+        max_rel_err=float(block.get("max_rel_err", 0.25) or 0.25),
+        latency_factor=float(block.get("latency_factor", 3.0) or 3.0),
+        latency_floor_ms=float(block.get("latency_floor_ms", 50.0)
+                               or 50.0),
+    )
+    return PublishConfig(
+        poll_interval_s=env_strict_float("HYDRAGNN_PUBLISH_POLL_S",
+                                         base.poll_interval_s),
+        mirror_every=env_strict_int("HYDRAGNN_PUBLISH_MIRROR_EVERY",
+                                    base.mirror_every),
+        window_pairs=env_strict_int("HYDRAGNN_PUBLISH_WINDOW_PAIRS",
+                                    base.window_pairs),
+        min_pairs=env_strict_int("HYDRAGNN_PUBLISH_MIN_PAIRS",
+                                 base.min_pairs),
+        window_timeout_s=env_strict_float(
+            "HYDRAGNN_PUBLISH_WINDOW_TIMEOUT_S", base.window_timeout_s),
+        max_rel_err=env_strict_float("HYDRAGNN_PUBLISH_MAX_REL_ERR",
+                                     base.max_rel_err),
+        latency_factor=env_strict_float("HYDRAGNN_PUBLISH_LATENCY_FACTOR",
+                                        base.latency_factor),
+        latency_floor_ms=env_strict_float(
+            "HYDRAGNN_PUBLISH_LATENCY_FLOOR_MS", base.latency_floor_ms),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleConfig:
+    """QueueDepthAutoscaler knobs (docs/serving.md "Continuous loop";
+    serving/autoscale.py). Scale-down always goes through drain and
+    scale-up always reconciles to the published version — only the
+    watermarks/bounds are knobbed."""
+    min_replicas: int = 1
+    max_replicas: int = 4
+    high_depth: float = 4.0   # avg routable queue depth -> scale up
+    low_depth: float = 0.5    # avg routable queue depth -> scale down
+    cooldown_s: float = 5.0   # min seconds between actions
+    poll_interval_s: float = 1.0
+    drain_timeout_s: float = 30.0
+
+
+def resolve_autoscale(config: Optional[Dict[str, Any]] = None
+                      ) -> AutoscaleConfig:
+    """Merge the `Serving.autoscale` block and the HYDRAGNN_AUTOSCALE_*
+    env knobs (strict parsing — a typo warns and keeps the default)."""
+    from ..utils.envflags import env_strict_float, env_strict_int
+    block = ((config or {}).get("Serving", {}) or {}).get("autoscale",
+                                                          {}) or {}
+    base = AutoscaleConfig(
+        min_replicas=int(block.get("min_replicas", 1) or 1),
+        max_replicas=int(block.get("max_replicas", 4) or 4),
+        high_depth=float(block.get("high_depth", 4.0) or 4.0),
+        low_depth=float(block.get("low_depth", 0.5) or 0.5),
+        cooldown_s=float(block.get("cooldown_s", 5.0) or 5.0),
+        poll_interval_s=float(block.get("poll_interval_s", 1.0) or 1.0),
+        drain_timeout_s=float(block.get("drain_timeout_s", 30.0) or 30.0),
+    )
+    return AutoscaleConfig(
+        min_replicas=env_strict_int("HYDRAGNN_AUTOSCALE_MIN",
+                                    base.min_replicas),
+        max_replicas=env_strict_int("HYDRAGNN_AUTOSCALE_MAX",
+                                    base.max_replicas),
+        high_depth=env_strict_float("HYDRAGNN_AUTOSCALE_HIGH_DEPTH",
+                                    base.high_depth),
+        low_depth=env_strict_float("HYDRAGNN_AUTOSCALE_LOW_DEPTH",
+                                   base.low_depth),
+        cooldown_s=env_strict_float("HYDRAGNN_AUTOSCALE_COOLDOWN_S",
+                                    base.cooldown_s),
+        poll_interval_s=env_strict_float("HYDRAGNN_AUTOSCALE_POLL_S",
+                                         base.poll_interval_s),
+        drain_timeout_s=env_strict_float(
+            "HYDRAGNN_AUTOSCALE_DRAIN_TIMEOUT_S", base.drain_timeout_s),
     )
 
 

@@ -61,6 +61,16 @@ survives replica death and model upgrades with zero lost futures
   ``restart_replica`` builds a replacement engine from the factory —
   which warms from the persistent compile store in seconds instead of
   recompiling the ladder (0 fresh compiles on a populated store).
+* The continuous-learning layer (docs/serving.md "Continuous loop")
+  composes on four router primitives added for it: ``set_canary`` /
+  ``swap_one`` / ``install_mirror`` give the CheckpointPublisher a
+  single out-of-rotation replica serving a deterministic shadow slice
+  of live traffic for candidate-vs-incumbent adjudication;
+  ``quarantine_version`` bans a rolled-back candidate fleet-wide; and
+  ``add_replica`` / ``retire_replica`` let the QueueDepthAutoscaler
+  grow/shrink the fleet (scale-up joins disk-warm ON the published
+  version via ``record_published`` reconciliation, scale-down drains
+  first so zero futures are lost).
 
 Lock discipline (docs/static_analysis.md): this file is in hydralint's
 lock-discipline scope — `# guarded-by: _lock` state is machine-checked,
@@ -160,7 +170,7 @@ class _Replica:
     state — the engine's own counters live behind its own lock)."""
 
     __slots__ = ("idx", "engine", "alive", "draining", "inflight",
-                 "dispatched")
+                 "dispatched", "canary", "retired")
 
     def __init__(self, idx: int, engine: InferenceEngine):
         self.idx = idx
@@ -169,6 +179,10 @@ class _Replica:
         self.draining = False
         self.inflight: Dict[_RouterRequest, Future] = {}
         self.dispatched = 0  # router-side dispatch count (health())
+        self.canary = False  # out of primary rotation; serves only the
+        # mirrored shadow slice during a publish adjudication window
+        self.retired = False  # scaled down through drain (autoscale);
+        # the slot stays and restart_replica revives it disk-warm
 
 
 class ReplicaRouter:
@@ -227,6 +241,25 @@ class ReplicaRouter:
         #   its dispatch quota
         self._tier_dispatches: Dict[str, int] = {}  # guarded-by: _lock —
         #   dispatch counts per engine tier tag (the quota denominator)
+        self.shadow_mirrored = 0  # guarded-by: _lock — requests copied
+        #   to the canary replica by the publish mirror
+        self.shadow_dropped = 0  # guarded-by: _lock — mirror copies the
+        #   canary could not accept (never fails the primary request)
+        self.retire_count = 0  # guarded-by: _lock — replicas scaled down
+        #   through drain (retire_replica)
+        self.add_count = 0  # guarded-by: _lock — replicas added to the
+        #   fleet after construction (add_replica)
+        self._quarantined: Dict[str, str] = {}  # guarded-by: _lock —
+        #   version -> reason; hot_swap/swap_one refuse these versions
+        self._mirror = None  # guarded-by: _lock — active shadow-mirror
+        #   hook: {"replica", "every", "on_pair"} while a canary window
+        #   is open, else None
+        self._mirror_seq = 0  # guarded-by: _lock — deterministic slice
+        #   counter: every `every`-th submit is mirrored
+        self._published = None  # guarded-by: _lock — (variables, version)
+        #   of the last fleet-wide publish; replicas added/restarted
+        #   later reconcile to it before joining rotation, so a scale-up
+        #   can never spawn a stale-version replica
         self._metrics_server = None
 
     # ------------------------------------------------------------ client API
@@ -244,7 +277,15 @@ class ReplicaRouter:
         prefers the accurate tier (subject to quota), below it the fast
         tier — with cross-tier fallback either way."""
         rr = _RouterRequest(sample, deadline_ms, priority=priority)
+        mirror = None
+        with self._lock:
+            if self._mirror is not None:
+                self._mirror_seq += 1
+                if self._mirror_seq % self._mirror["every"] == 0:
+                    mirror = dict(self._mirror)
         self._dispatch(rr)
+        if mirror is not None:
+            self._mirror_submit(mirror, rr)
         return rr.future
 
     def predict(self, samples: Sequence, timeout=None):
@@ -283,6 +324,8 @@ class ReplicaRouter:
             alive = {r.idx: r.alive for r in reps}
             draining = {r.idx: r.draining for r in reps}
             dispatched = {r.idx: r.dispatched for r in reps}
+            canary = {r.idx: r.canary for r in reps}
+            retired = {r.idx: r.retired for r in reps}
             counters = {
                 "requests_done": self.requests_done,
                 "redispatches": self.redispatch_count,
@@ -297,6 +340,11 @@ class ReplicaRouter:
                 "tier_dispatches": {
                     t: self._tier_dispatches[t]
                     for t in sorted(self._tier_dispatches)},
+                "shadow_mirrored": self.shadow_mirrored,
+                "shadow_dropped": self.shadow_dropped,
+                "retires": self.retire_count,
+                "adds": self.add_count,
+                "quarantined_versions": sorted(self._quarantined),
             }
         replicas = {}
         routable = 0
@@ -305,10 +353,14 @@ class ReplicaRouter:
             h["alive"] = alive[rep.idx]
             h["draining"] = draining[rep.idx]
             h["dispatched"] = dispatched[rep.idx]
+            h["canary"] = canary[rep.idx]
+            h["retired"] = retired[rep.idx]
             # routable mirrors _pick EXACTLY: a half_open replica is
-            # NOT routable (its probe owns the breaker) — /healthz must
-            # never say "serving" while every dispatch would fail
+            # NOT routable (its probe owns the breaker), and a canary
+            # serves only the shadow slice — /healthz must never say
+            # "serving" while every dispatch would fail
             if (alive[rep.idx] and not draining[rep.idx]
+                    and not canary[rep.idx]
                     and h["dispatcher_alive"]
                     and (h["state"] == "closed"
                          or h.get("breaker_probe_due"))):
@@ -341,6 +393,13 @@ class ReplicaRouter:
                 "tier_dispatches": {
                     t: self._tier_dispatches[t]
                     for t in sorted(self._tier_dispatches)},
+                "shadow_mirrored": self.shadow_mirrored,
+                "shadow_dropped": self.shadow_dropped,
+                "retires": self.retire_count,
+                "adds": self.add_count,
+                "quarantined_versions": sorted(self._quarantined),
+                "canary_replicas": sorted(r.idx for r in self._replicas
+                                          if r.canary),
             }
         latencies: List[float] = []
         per_replica = {}
@@ -452,6 +511,10 @@ class ReplicaRouter:
         resolutions are stale, so without the re-dispatch those callers
         would hang."""
         engine = self._factory(idx)
+        # join on the fleet's published version BEFORE entering rotation
+        # — a disk-warm scale-up or post-swap restart must not serve a
+        # stale factory version
+        self._reconcile_engine(engine)
         with self._lock:
             rep = self._replicas[idx]
             old_engine, was_alive = rep.engine, rep.alive
@@ -459,6 +522,8 @@ class ReplicaRouter:
             rep.engine = engine
             rep.alive = True
             rep.draining = False
+            rep.retired = False
+            rep.canary = False
             rep.inflight = {}
             self.restart_count += 1
         if was_alive:
@@ -513,6 +578,202 @@ class ReplicaRouter:
         with self._lock:
             self._replicas[idx].draining = False
 
+    # --------------------------------------------- canary / publish plumbing
+
+    def set_canary(self, idx: int, on: bool = True) -> None:
+        """Flag one replica as the canary: it leaves the primary
+        rotation (no `_pick` dispatches) but stays alive to serve the
+        mirrored shadow slice. The CheckpointPublisher owns the
+        transitions; flags are surfaced in health()/metrics."""
+        with self._lock:
+            self._replicas[idx].canary = bool(on)
+
+    def swap_one(self, idx: int, variables, version: str) -> dict:
+        """Drain exactly one replica, swap its variables atomically, and
+        re-admit it — the single-replica unit hot_swap composes, exposed
+        for the publisher's canary/promote/rollback steps. Raises
+        ValueError for a dead/retired replica or a quarantined target
+        version; swap failures (the ``swap-fail`` site, a mismatched
+        checkpoint) propagate after the replica is re-admitted on its
+        OLD version — a failed swap never costs capacity."""
+        with self._lock:
+            if str(version) in self._quarantined:
+                reason = self._quarantined[str(version)]
+                raise ValueError(
+                    f"version {version!r} is quarantined ({reason}) — "
+                    "clear it via quarantine_version bookkeeping before "
+                    "re-publishing")
+            rep = self._replicas[idx]
+            if not rep.alive or rep.retired:
+                raise ValueError(
+                    f"replica {idx} is "
+                    f"{'retired' if rep.retired else 'dead'} — cannot "
+                    "swap; restart_replica revives it first")
+            self.swap_attempts += 1
+        self.drain_replica(idx)
+        try:
+            old = rep.engine.swap_variables(variables, version)
+        except (InjectedFault, ValueError, TimeoutError,
+                RuntimeError):
+            with self._lock:
+                self.swap_failures += 1
+            raise
+        finally:
+            self.undrain_replica(idx)
+        return {"replica": idx, "from": old, "to": str(version)}
+
+    def install_mirror(self, idx: int, every: int,
+                       on_pair: Callable[[Future, Future], None]) -> None:
+        """Start mirroring a deterministic slice of traffic to the
+        canary: every `every`-th submit() is ALSO placed on replica
+        `idx`'s engine (shadow copy — its outcome never affects the
+        primary future), and `on_pair(primary_future, shadow_future)` is
+        called so the publisher can adjudicate candidate vs incumbent
+        on identical samples."""
+        if every < 1:
+            raise ValueError(f"mirror every={every!r} must be >= 1")
+        with self._lock:
+            self._mirror = {"replica": int(idx), "every": int(every),
+                            "on_pair": on_pair}
+            self._mirror_seq = 0
+
+    def remove_mirror(self) -> None:
+        with self._lock:
+            self._mirror = None
+
+    def _mirror_submit(self, mirror: dict, rr: _RouterRequest) -> None:
+        """Place the shadow copy on the canary engine (OUTSIDE the
+        router lock — engine calls never sit under it). A canary that
+        cannot accept (draining mid-swap, queue full, dead) drops the
+        copy and counts it; the primary request is never affected."""
+        with self._lock:
+            rep = self._replicas[mirror["replica"]]
+            ok = rep.alive and rep.canary and not rep.draining
+        if ok:
+            try:
+                shadow = rep.engine.submit(rr.sample,
+                                           deadline_ms=rr.deadline_ms)
+            except (ServingError, RuntimeError):
+                ok = False
+        if not ok:
+            with self._lock:
+                self.shadow_dropped += 1
+            return
+        with self._lock:
+            self.shadow_mirrored += 1
+        try:
+            mirror["on_pair"](rr.future, shadow)
+        except Exception:  # noqa: BLE001 — adjudication bookkeeping must
+            # never break the serving path
+            import logging
+            logging.getLogger("hydragnn_tpu").warning(
+                "shadow-mirror on_pair callback raised", exc_info=True)
+
+    def quarantine_version(self, version: str, reason: str = "") -> None:
+        """Ban a model version from the fleet: hot_swap/swap_one refuse
+        it and the publisher skips it on re-poll — a poisoned candidate
+        is rolled back ONCE, not once per poll."""
+        with self._lock:
+            self._quarantined[str(version)] = str(reason)
+        get_registry().counter_inc(
+            "serve.fleet_quarantines_total",
+            help="model versions quarantined after a failed canary")
+
+    def quarantined_versions(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._quarantined)
+
+    def record_published(self, variables, version: str) -> None:
+        """Record the fleet-wide published weights: replicas added or
+        restarted later reconcile to this version before joining
+        rotation (scale-up during/after a publish must not spawn a
+        stale-version replica). hot_swap records it automatically on a
+        fully-successful roll; the publisher records after a promote."""
+        with self._lock:
+            self._published = (variables, str(version))
+
+    def _reconcile_engine(self, engine) -> None:
+        """Swap a freshly built engine to the fleet's published version
+        before it joins rotation (no-op when none is recorded or the
+        factory already builds the current version)."""
+        with self._lock:
+            published = self._published
+        if published is None:
+            return
+        variables, version = published
+        if getattr(engine, "model_version", None) != version:
+            engine.swap_variables(variables, version)
+
+    # ----------------------------------------------------------- autoscaling
+
+    def add_replica(self, warmup: bool = True) -> dict:
+        """Grow the fleet by one replica built from the factory — the
+        autoscaler's scale-up. With a shared persistent compile store
+        the newcomer warms from disk (0 fresh compiles) and it joins
+        rotation on the fleet's published version. Returns the warmup
+        report (same shape as restart_replica's). Single-scaler
+        contract: concurrent add_replica calls are not supported (the
+        autoscaler is the one writer; a raced slot raises)."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("ReplicaRouter is shut down")
+            idx = len(self._replicas)
+        engine = self._factory(idx)
+        self._reconcile_engine(engine)
+        with self._lock:
+            if len(self._replicas) != idx:
+                raise RuntimeError(
+                    "concurrent add_replica detected — the autoscaler "
+                    "is the single scale writer")
+            self._replicas.append(_Replica(idx, engine))
+            self.add_count += 1
+        get_registry().counter_inc(
+            "serve.fleet_adds_total",
+            help="replicas added to the fleet by add_replica")
+        report = {"replica": idx, "compiled": 0, "store_hits": 0,
+                  "fresh": 0, "warmup_s": 0.0}
+        if warmup:
+            t0 = time.perf_counter()
+            engine.warmup()
+            st = engine.stats()
+            report.update(compiled=st["compile_count"],
+                          store_hits=st["compile_store_hits"],
+                          fresh=st["compile_fresh"],
+                          warmup_s=time.perf_counter() - t0)
+        return report
+
+    def retire_replica(self, idx: int,
+                       timeout_s: Optional[float] = None) -> dict:
+        """Scale one replica down THROUGH DRAIN — the autoscaler's
+        scale-down. The replica leaves rotation, its queue empties (so
+        zero futures are lost), then its engine shuts down; the slot is
+        flagged `retired` and restart_replica revives it disk-warm on
+        the next scale-up. Raises ValueError for a dead/retired/canary
+        replica and TimeoutError when the drain outlives `timeout_s`
+        (the replica is re-admitted — retry later)."""
+        with self._lock:
+            rep = self._replicas[idx]
+            if not rep.alive or rep.retired:
+                raise ValueError(f"replica {idx} is already "
+                                 f"{'retired' if rep.retired else 'dead'}")
+            if rep.canary:
+                raise ValueError(
+                    f"replica {idx} is the canary — a publish "
+                    "adjudication owns it; retire another replica")
+        self.drain_replica(idx, timeout_s)
+        # drain_replica returns with `draining` still set, so no new
+        # dispatch can land between the drain and the flags below
+        with self._lock:
+            rep.alive = False
+            rep.retired = True
+            rep.draining = False
+            self.retire_count += 1
+        rep.engine.shutdown(wait=False)
+        get_registry().counter_inc(
+            "serve.fleet_retires_total",
+            help="replicas scaled down through drain by retire_replica")
+        return {"replica": idx, "retired": True}
+
     def hot_swap(self, variables, version: str,
                  raise_on_failure: bool = True) -> dict:
         """Zero-downtime rolling model upgrade: for each live replica —
@@ -530,6 +791,11 @@ class ReplicaRouter:
         partial fleet on the new version plus an exception would be the
         worst of both)."""
         with self._lock:
+            if str(version) in self._quarantined:
+                reason = self._quarantined[str(version)]
+                raise ValueError(
+                    f"version {version!r} is quarantined ({reason}) — "
+                    "refusing to roll it out")
             self.swap_attempts += 1
             reps = [r for r in self._replicas if r.alive]
         report = {"version": str(version), "replicas": {}, "failed": []}
@@ -556,12 +822,23 @@ class ReplicaRouter:
         get_registry().counter_inc(
             "serve.fleet_swaps_total",
             help="hot-swap rolls attempted across the fleet")
-        if report["failed"] and raise_on_failure:
-            raise SwapFailedError(
+        if not report["failed"]:
+            self.record_published(variables, version)
+        elif raise_on_failure:
+            # the report names BOTH sides of the mixed-version fleet so
+            # an operator (or the publisher's rollback) knows exactly
+            # which replicas to re-swap
+            on_new = sorted(int(i) for i in report["replicas"])
+            on_old = sorted(f["replica"] for f in report["failed"])
+            exc = SwapFailedError(
                 f"hot-swap to {version!r} failed on "
                 f"{len(report['failed'])} replica(s): {report['failed']} "
-                "— they keep serving the old version; fix the checkpoint "
-                "and re-run hot_swap")
+                f"— MIXED-VERSION fleet: replicas {on_new} serve "
+                f"{version!r}, replicas {on_old} keep the old version; "
+                "fix the checkpoint and re-run hot_swap, or roll the "
+                f"{on_new or 'swapped'} replicas back via swap_one")
+            exc.report = report
+            raise exc
         return report
 
     def hot_swap_from_checkpoint(self, state_template, log_name: str,
@@ -574,15 +851,31 @@ class ReplicaRouter:
         architecture) and roll it out. The version tag defaults to
         "<which>:step_<n>" so /healthz and every future name the exact
         checkpoint serving."""
-        from ..utils.checkpoint import load_best_model, load_existing_model
-        if which == "best":
-            state = load_best_model(state_template, log_name, path=path)
-        elif which == "latest":
-            state = load_existing_model(state_template, log_name, path=path)
-        else:
+        from ..utils.checkpoint import (UncommittedCheckpointError,
+                                        load_best_model,
+                                        load_existing_model,
+                                        marker_target, verify_checkpoint)
+        if which not in ("best", "latest"):
             raise ValueError(
                 f"which={which!r} — hot_swap_from_checkpoint restores "
                 "'best' (the BEST marker) or 'latest' (the LATEST marker)")
+        # COMMITTED-only hardening: a marker can name a step dir whose
+        # writer died mid-save (or is still writing). Refuse it with an
+        # actionable error NAMING the dir instead of falling through to
+        # "no checkpoint found" — the states are operationally different
+        target = marker_target(log_name, path=path, which=which)
+        if target is not None and not verify_checkpoint(target):
+            raise UncommittedCheckpointError(
+                f"the {which.upper()} marker for run '{log_name}' names "
+                f"{target}, which has no COMMITTED marker (a writer died "
+                "mid-save or is still writing) — refusing to hot-swap a "
+                "torn state. Wait for the in-flight save "
+                "(utils.checkpoint.wait_for_checkpoints) or repoint/"
+                "delete the marker, then retry")
+        if which == "best":
+            state = load_best_model(state_template, log_name, path=path)
+        else:
+            state = load_existing_model(state_template, log_name, path=path)
         if state is None:
             raise FileNotFoundError(
                 f"no verified {which.upper()} checkpoint for run "
@@ -608,7 +901,7 @@ class ReplicaRouter:
         never turn a servable request into a FleetUnavailableError."""
         with self._lock:
             candidates = [r for r in self._replicas
-                          if r.alive and not r.draining]
+                          if r.alive and not r.draining and not r.canary]
         untried = [r for r in candidates if r.idx not in rr.tried]
         if untried:
             candidates = untried
@@ -784,7 +1077,8 @@ class ReplicaRouter:
             rr.wait_deadline = time.monotonic() + self.unavailable_wait_s
         while time.monotonic() < rr.wait_deadline:
             with self._lock:
-                alive = [r for r in self._replicas if r.alive]
+                alive = [r for r in self._replicas
+                         if r.alive and not r.canary]
                 transient = any(r.draining for r in alive)
             if not transient:
                 transient = any(
@@ -795,7 +1089,7 @@ class ReplicaRouter:
             time.sleep(0.002)
             with self._lock:
                 ready = [r for r in self._replicas
-                         if r.alive and not r.draining]
+                         if r.alive and not r.draining and not r.canary]
             if ready:
                 return True  # re-pick: it may now be closed/probe-due
         return False

@@ -1,0 +1,529 @@
+"""Continuous-learning checkpoint publisher: trainer -> fleet, canaried.
+
+The robustness arc (PRs 12-15) left one manual step in the loop: a
+human calls ``hot_swap_from_checkpoint`` when the trainer writes a
+better model. This module closes it (ROADMAP item 4; docs/serving.md
+"Continuous loop"): ``CheckpointPublisher`` watches the elastic
+trainer's BEST/COMMITTED checkpoint stream (the PR 4 contract) and
+rolls every new candidate into the live fleet via CANARY —
+
+1. swap exactly ONE replica (``router.set_canary`` +
+   ``router.swap_one``: drained, version-tagged, out of the primary
+   rotation) to the candidate weights;
+2. mirror a deterministic slice of live traffic to it
+   (``router.install_mirror``: every k-th request is ALSO placed on the
+   canary engine; the shadow copy never affects the primary future);
+3. adjudicate candidate vs incumbent over a configured window of
+   mirrored pairs — max relative output drift (a poisoned/torn
+   candidate shows up as huge or non-finite drift on identical
+   samples) and p99 latency (candidate p99 bounded by a factor of the
+   incumbent's);
+4. PROMOTE (roll the remaining replicas one by one — the canary
+   re-enters rotation first, so at least one replica always serves)
+   or ROLL BACK (swap the canary back to the incumbent while it is
+   still out of rotation, then quarantine the candidate version so a
+   re-poll cannot re-publish it).
+
+A promote that fails mid-roll (the ``swap-fail`` site, a checkpoint
+gone bad on disk) rolls every already-swapped replica BACK to the
+incumbent: the fleet always ends on ONE coherent version, and because
+every transition goes through drain, zero futures are lost — the
+tentpole invariant, adjudicated by BENCH_CONTINUOUS.
+
+Candidates are detected by polling the BEST marker (``marker_target``)
+and consumed only when COMMITTED-verified — a mid-write save is
+counted (``skipped_uncommitted``) and retried next poll, never served
+torn. Quarantined versions are skipped at detection time.
+
+Lock discipline (docs/static_analysis.md): this file is in hydralint's
+lock-discipline scope — counters/history are ``# guarded-by: _lock``
+and no blocking call (sleep, Future wait, thread join) sits under the
+lock; the canary window wait and every router/engine call run outside
+it. Knobs resolve via serving/config.resolve_publish at construction
+(the traced-env rule), never by env reads here.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+from ..telemetry.registry import get_registry
+from ..utils.checkpoint import (load_best_model, marker_target,
+                                verify_checkpoint)
+from .config import PublishConfig
+
+
+def pair_rel_err(incumbent_result, candidate_result) -> float:
+    """Max relative elementwise drift of a candidate output vs the
+    incumbent's on the SAME sample. Non-finite candidate values, shape
+    mismatches, and tree-structure mismatches all compare as ``inf`` —
+    a torn/poisoned candidate must never pass by accident."""
+    import jax
+    import numpy as np
+    inc = jax.tree_util.tree_leaves(incumbent_result)
+    cand = jax.tree_util.tree_leaves(candidate_result)
+    if len(inc) != len(cand):
+        return float("inf")
+    worst = 0.0
+    for x, y in zip(inc, cand):
+        x = np.asarray(x, dtype=np.float64)
+        y = np.asarray(y, dtype=np.float64)
+        if x.shape != y.shape:
+            return float("inf")
+        if not np.all(np.isfinite(y)):
+            return float("inf")
+        if x.size == 0:
+            continue
+        denom = np.maximum(np.abs(x), 1e-8)
+        worst = max(worst, float(np.max(np.abs(x - y) / denom)))
+    return worst
+
+
+def adjudicate_window(pairs: List[dict], shadow_failures: int,
+                      cfg: PublishConfig) -> dict:
+    """The canary verdict, a pure function of the collected window —
+    unit-testable without a fleet. `pairs` carry ``err`` (relative
+    drift), ``primary_ms`` and ``shadow_ms`` (paired latencies).
+
+    * ``enough``  — at least ``cfg.min_pairs`` pairs landed;
+    * ``error_ok`` — worst drift within ``cfg.max_rel_err`` AND no
+      shadow submission failed (a canary that errors on traffic the
+      incumbent serves is broken no matter what its outputs say);
+    * ``latency_ok`` — candidate p99 <= ``cfg.latency_factor`` *
+      max(incumbent p99, ``cfg.latency_floor_ms``) over the SAME
+      mirrored samples (the floor keeps micro-benchmark noise from
+      failing every candidate).
+    """
+    from ..utils.profiling import latency_percentiles
+    max_err = max((p["err"] for p in pairs), default=0.0)
+    # latency_percentiles takes SECONDS and reports *_ms keys
+    inc_p99 = latency_percentiles(
+        [p["primary_ms"] / 1000.0 for p in pairs]).get("p99_ms", 0.0)
+    cand_p99 = latency_percentiles(
+        [p["shadow_ms"] / 1000.0 for p in pairs]).get("p99_ms", 0.0)
+    budget_ms = cfg.latency_factor * max(inc_p99, cfg.latency_floor_ms)
+    enough = len(pairs) >= cfg.min_pairs
+    error_ok = max_err <= cfg.max_rel_err and shadow_failures == 0
+    latency_ok = cand_p99 <= budget_ms
+    return {"pairs": len(pairs), "shadow_failures": int(shadow_failures),
+            "max_rel_err": max_err, "incumbent_p99_ms": inc_p99,
+            "candidate_p99_ms": cand_p99, "latency_budget_ms": budget_ms,
+            "enough": enough, "error_ok": error_ok,
+            "latency_ok": latency_ok,
+            "promote": enough and error_ok and latency_ok}
+
+
+class _ShadowWindow:
+    """Collects mirrored (primary, shadow) result pairs via future
+    callbacks — the callbacks run on engine dispatcher threads, so all
+    state is behind a private lock and the drift math happens outside
+    it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._open: Dict[int, dict] = {}  # guarded-by: _lock — pair id
+        #   -> partial record until both futures resolve
+        self._next_id = 0  # guarded-by: _lock
+        self.pairs: List[dict] = []  # guarded-by: _lock — finalized
+        self.shadow_failures = 0  # guarded-by: _lock
+        self.primary_failures = 0  # guarded-by: _lock
+
+    def on_pair(self, primary: Future, shadow: Future) -> None:
+        with self._lock:
+            pid = self._next_id
+            self._next_id += 1
+            self._open[pid] = {"t0": time.monotonic()}
+        primary.add_done_callback(
+            lambda f, pid=pid: self._done(pid, "primary", f))
+        shadow.add_done_callback(
+            lambda f, pid=pid: self._done(pid, "shadow", f))
+
+    def _done(self, pid: int, side: str, fut: Future) -> None:
+        # result/exception read OUTSIDE the lock (the future is already
+        # resolved when a done-callback runs, but .result is a wait API)
+        exc = fut.exception()
+        value = None if exc is not None else fut.result()
+        now = time.monotonic()
+        ready = None
+        with self._lock:
+            rec = self._open.get(pid)
+            if rec is None:
+                return
+            rec[side] = (exc, value)
+            rec[f"{side}_ms"] = (now - rec["t0"]) * 1000.0
+            if "primary" in rec and "shadow" in rec:
+                ready = self._open.pop(pid)
+        if ready is None:
+            return
+        p_exc, p_val = ready["primary"]
+        s_exc, s_val = ready["shadow"]
+        if p_exc is not None:
+            # the incumbent itself failed this sample (deadline, fleet
+            # chaos): no verdict signal either way — don't let chaos on
+            # the primary path fail a good candidate
+            with self._lock:
+                self.primary_failures += 1
+            return
+        if s_exc is not None:
+            with self._lock:
+                self.shadow_failures += 1
+            return
+        err = pair_rel_err(p_val, s_val)
+        with self._lock:
+            self.pairs.append({"err": err,
+                               "primary_ms": ready["primary_ms"],
+                               "shadow_ms": ready["shadow_ms"]})
+
+    def snapshot(self):
+        with self._lock:
+            return list(self.pairs), self.shadow_failures
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self.pairs)
+
+
+class CheckpointPublisher:
+    """Watches a run's BEST/COMMITTED checkpoint stream and canaries
+    each new candidate into `router`'s fleet (module docstring for the
+    protocol). `state_template` is a TrainState matching the serving
+    architecture (the restore template); `incumbent_variables` /
+    `incumbent_version` seed the rollback target — after each promote
+    the promoted candidate becomes the incumbent.
+
+    Synchronous use: ``poll_once()`` detects-and-publishes one
+    candidate (returns its outcome dict, or None when there is nothing
+    new). Background use: ``start()`` polls every
+    ``cfg.poll_interval_s`` on a daemon thread until ``stop()``."""
+
+    def __init__(self, router, state_template, log_name: str,
+                 path: str = "./logs", *,
+                 incumbent_variables, incumbent_version: str = "v0",
+                 config: Optional[PublishConfig] = None):
+        self.router = router
+        self._template = state_template
+        self.log_name = str(log_name)
+        self.path = str(path)
+        self.cfg = config if config is not None else PublishConfig()
+        self._lock = threading.Lock()
+        self._incumbent = (incumbent_variables, str(incumbent_version))
+        #   guarded-by: _lock — (variables, version) rollbacks target
+        self.last_step = -1  # guarded-by: _lock — newest checkpoint
+        #   step already adjudicated (or skipped as quarantined)
+        self.publish_count = 0  # guarded-by: _lock — canaries started
+        self.promote_count = 0  # guarded-by: _lock
+        self.rollback_count = 0  # guarded-by: _lock
+        self.skipped_uncommitted = 0  # guarded-by: _lock — polls that
+        #   found the BEST marker naming an uncommitted (mid-write) dir
+        self.history: List[dict] = []  # guarded-by: _lock — ordered
+        #   publish events (the version history BENCH_CONTINUOUS emits)
+        self._t0 = time.monotonic()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def _run():
+            while not self._stop.is_set():
+                try:
+                    self.poll_once()
+                except Exception:  # noqa: BLE001 — the watch loop must
+                    # survive a transient filesystem/router error
+                    import logging
+                    logging.getLogger("hydragnn_tpu").warning(
+                        "checkpoint publisher poll failed", exc_info=True)
+                self._stop.wait(self.cfg.poll_interval_s)
+
+        self._thread = threading.Thread(target=_run, daemon=True,
+                                        name="ckpt-publisher")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=60)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"incumbent_version": self._incumbent[1],
+                    "last_step": self.last_step,
+                    "publish_count": self.publish_count,
+                    "promote_count": self.promote_count,
+                    "rollback_count": self.rollback_count,
+                    "skipped_uncommitted": self.skipped_uncommitted,
+                    "history": [dict(e) for e in self.history]}
+
+    # ------------------------------------------------------------- detection
+
+    def poll_once(self) -> Optional[dict]:
+        """One watch iteration: read the BEST marker, skip uncommitted /
+        already-seen / quarantined candidates, else restore and publish.
+        Returns the publish outcome dict, or None when nothing rolled."""
+        target = marker_target(self.log_name, path=self.path,
+                               which="best")
+        if target is None:
+            return None
+        if not verify_checkpoint(target):
+            # mid-write save: counted and retried next poll — last_step
+            # is NOT advanced, so the committed version of this save
+            # still publishes
+            with self._lock:
+                self.skipped_uncommitted += 1
+            return None
+        base = os.path.basename(target)
+        try:
+            step = int(base.split("_")[-1])
+        except ValueError:
+            return None
+        with self._lock:
+            if step <= self.last_step:
+                return None
+        version = f"best:step_{step}"
+        if version in self.router.quarantined_versions():
+            with self._lock:
+                self.last_step = max(self.last_step, step)
+            self._event("skipped_quarantined", version, step=step)
+            return None
+        state = load_best_model(self._template, self.log_name,
+                                path=self.path)
+        if state is None:
+            # vanished or failed the deep verify between the cheap check
+            # and the restore — treat like uncommitted: retry next poll
+            with self._lock:
+                self.skipped_uncommitted += 1
+            return None
+        with self._lock:
+            self.last_step = max(self.last_step, step)
+        variables = {"params": state.params,
+                     "batch_stats": state.batch_stats}
+        return self.publish(variables, version)
+
+    # ------------------------------------------------------------ publishing
+
+    def publish(self, variables, version: str) -> dict:
+        """Run one full canary adjudication for `variables`/`version`
+        against the current incumbent (module docstring for the
+        protocol). Blocking — call from the publisher thread or a test
+        driving traffic concurrently. Returns the outcome dict
+        (``action``: promoted | rolled_back | aborted)."""
+        version = str(version)
+        cfg = self.cfg
+        with self._lock:
+            incumbent_vars, incumbent_version = self._incumbent
+            self.publish_count += 1
+        health = self.router.health()
+        routable = sorted(
+            int(i) for i, h in health["replicas"].items()
+            if h["alive"] and not h["draining"] and not h["retired"]
+            and h["dispatcher_alive"])
+        if len(routable) < 2:
+            return self._publish_direct(variables, version,
+                                        incumbent_version)
+        # the HIGHEST-index routable replica canaries: index ties in
+        # `_pick` prefer low indices, so the highest carries the least
+        # primary traffic at the moment it leaves rotation
+        canary = routable[-1]
+        self._event("canary_start", version, replica=canary,
+                    incumbent=incumbent_version)
+        self.router.set_canary(canary, True)
+        try:
+            self.router.swap_one(canary, variables, version)
+        except Exception as exc:  # noqa: BLE001 — swap-fail site,
+            # mismatched shapes, drain timeout: the canary engine still
+            # serves the incumbent (swap_variables fails before
+            # mutation), so re-admitting it is safe
+            self.router.set_canary(canary, False)
+            self.router.quarantine_version(
+                version, f"canary swap failed: {type(exc).__name__}")
+            with self._lock:
+                self.rollback_count += 1
+            self._event("rolled_back", version, replica=canary,
+                        reason=f"canary swap failed: {exc}")
+            self._count("rolled_back")
+            return {"action": "rolled_back", "version": version,
+                    "reason": f"canary swap failed: {exc}"}
+        window = _ShadowWindow()
+        self.router.install_mirror(canary, cfg.mirror_every,
+                                   window.on_pair)
+        deadline = time.monotonic() + cfg.window_timeout_s
+        while time.monotonic() < deadline:
+            if window.count() >= cfg.window_pairs:
+                break
+            time.sleep(0.005)
+        self.router.remove_mirror()
+        pairs, shadow_failures = window.snapshot()
+        verdict = adjudicate_window(pairs, shadow_failures, cfg)
+        if verdict["promote"]:
+            return self._promote(canary, variables, version,
+                                 incumbent_vars, incumbent_version,
+                                 verdict)
+        return self._roll_back(canary, variables, version,
+                               incumbent_vars, incumbent_version,
+                               verdict)
+
+    def _publish_direct(self, variables, version: str,
+                        incumbent_version: str) -> dict:
+        """Single-routable-replica fleets cannot spare a canary: fall
+        back to a plain (still drained + version-tagged) hot_swap. A
+        failure quarantines the candidate — with no shadow window the
+        only signal is the swap itself."""
+        try:
+            self.router.hot_swap(variables, version)
+        except Exception as exc:  # noqa: BLE001
+            self.router.quarantine_version(
+                version, f"direct swap failed: {type(exc).__name__}")
+            with self._lock:
+                self.rollback_count += 1
+            self._event("rolled_back", version,
+                        reason=f"direct swap failed: {exc}")
+            self._count("rolled_back")
+            return {"action": "rolled_back", "version": version,
+                    "reason": f"direct swap failed: {exc}"}
+        with self._lock:
+            self._incumbent = (variables, version)
+            self.promote_count += 1
+        self._event("promoted", version, mode="direct",
+                    incumbent=incumbent_version)
+        self._count("promoted")
+        return {"action": "promoted", "version": version,
+                "mode": "direct"}
+
+    def _promote(self, canary: int, variables, version: str,
+                 incumbent_vars, incumbent_version: str,
+                 verdict: dict) -> dict:
+        # the adjudicated canary re-enters the PRIMARY rotation first:
+        # rolling the others drains them one at a time, and without the
+        # canary back in rotation a 2-replica fleet would have zero
+        # routable replicas mid-promote
+        self.router.set_canary(canary, False)
+        health = self.router.health()
+        failed = None
+        for idx in sorted(int(i) for i in health["replicas"]):
+            h = health["replicas"][str(idx)]
+            if idx == canary or not h["alive"] or h["retired"]:
+                continue
+            try:
+                self.router.swap_one(idx, variables, version)
+            except Exception as exc:  # noqa: BLE001
+                # a replica that died/retired mid-roll is not a swap
+                # failure — re-check before aborting the promote
+                now = self.router.health()["replicas"].get(str(idx))
+                if now is None or not now["alive"]:
+                    continue
+                failed = (idx, exc)
+                break
+        if failed is not None:
+            idx, exc = failed
+            self._restore_incumbent(incumbent_vars, incumbent_version,
+                                    version)
+            self.router.quarantine_version(
+                version, f"promote failed on replica {idx}: "
+                         f"{type(exc).__name__}")
+            with self._lock:
+                self.rollback_count += 1
+            self._event("rolled_back", version, replica=idx,
+                        reason=f"promote failed on replica {idx}: {exc}",
+                        verdict=verdict)
+            self._count("rolled_back")
+            return {"action": "rolled_back", "version": version,
+                    "reason": f"promote failed on replica {idx}: {exc}",
+                    "verdict": verdict}
+        self.router.record_published(variables, version)
+        with self._lock:
+            self._incumbent = (variables, version)
+            self.promote_count += 1
+        self._event("promoted", version, replica=canary,
+                    incumbent=incumbent_version, verdict=verdict)
+        self._count("promoted")
+        return {"action": "promoted", "version": version,
+                "verdict": verdict}
+
+    def _roll_back(self, canary: int, variables, version: str,
+                   incumbent_vars, incumbent_version: str,
+                   verdict: dict) -> dict:
+        """Failed (or starved) adjudication: swap the canary back to
+        the incumbent while it is STILL out of the primary rotation —
+        the candidate never serves a primary request — then re-admit.
+        A starved window (too few pairs) aborts WITHOUT quarantine: the
+        candidate wasn't proven bad, just unproven, and the next poll
+        may retry it under real traffic."""
+        starved = not verdict["enough"]
+        rollback_error = None
+        try:
+            self.router.swap_one(canary, incumbent_vars,
+                                 incumbent_version)
+        except Exception as exc:  # noqa: BLE001 — swap-back failed: the
+            # canary still holds the candidate; restarting the replica
+            # rebuilds it on the incumbent via the factory + reconcile
+            rollback_error = f"{type(exc).__name__}: {exc}"
+            self.router.restart_replica(canary)
+        self.router.set_canary(canary, False)
+        if starved:
+            with self._lock:
+                self.last_step = -1 if self.last_step < 0 \
+                    else self.last_step - 1  # allow a re-poll retry
+            self._event("aborted", version, replica=canary,
+                        verdict=verdict, rollback_error=rollback_error)
+            self._count("aborted")
+            return {"action": "aborted", "version": version,
+                    "verdict": verdict}
+        self.router.quarantine_version(
+            version,
+            f"canary adjudication failed: max_rel_err="
+            f"{verdict['max_rel_err']:.3g} (bound "
+            f"{self.cfg.max_rel_err:.3g}), candidate p99 "
+            f"{verdict['candidate_p99_ms']:.1f} ms (budget "
+            f"{verdict['latency_budget_ms']:.1f} ms), "
+            f"{verdict['shadow_failures']} shadow failures")
+        with self._lock:
+            self.rollback_count += 1
+        self._event("rolled_back", version, replica=canary,
+                    verdict=verdict, rollback_error=rollback_error)
+        self._count("rolled_back")
+        return {"action": "rolled_back", "version": version,
+                "verdict": verdict}
+
+    def _restore_incumbent(self, incumbent_vars, incumbent_version: str,
+                           candidate_version: str) -> None:
+        """Roll every replica currently serving the candidate back to
+        the incumbent — the coherent-version guarantee after a failed
+        promote. Best-effort per replica (a replica that fails the
+        swap-back is restarted from the factory + reconcile)."""
+        self.router.record_published(incumbent_vars, incumbent_version)
+        health = self.router.health()
+        for idx in sorted(int(i) for i in health["replicas"]):
+            h = health["replicas"][str(idx)]
+            if not h["alive"] or h["retired"]:
+                continue
+            if h.get("model_version") != candidate_version:
+                continue
+            try:
+                self.router.swap_one(idx, incumbent_vars,
+                                     incumbent_version)
+            except Exception:  # noqa: BLE001
+                self.router.restart_replica(idx)
+
+    # ---------------------------------------------------------- bookkeeping
+
+    def _event(self, kind: str, version: str, **extra: Any) -> None:
+        ev = {"event": kind, "version": version,
+              "t_s": round(time.monotonic() - self._t0, 3)}
+        ev.update(extra)
+        with self._lock:
+            self.history.append(ev)
+
+    @staticmethod
+    def _count(action: str) -> None:
+        get_registry().counter_inc(
+            "serve.publish_total",
+            help="checkpoint publish outcomes by action",
+            action=action)

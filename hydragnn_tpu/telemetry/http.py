@@ -210,6 +210,13 @@ def fleet_prometheus(router, registry: Optional[MetricsRegistry] = None
          "hot-swap rolls attempted"),
         ("serving_fleet_swap_failures_total", health["swap_failures"],
          "per-replica hot-swap failures (old version kept serving)"),
+        ("serving_fleet_shadow_mirrored_total",
+         health.get("shadow_mirrored", 0),
+         "requests copied to the canary replica by the publish mirror"),
+        ("serving_fleet_retires_total", health.get("retires", 0),
+         "replicas scaled down through drain (retire_replica)"),
+        ("serving_fleet_adds_total", health.get("adds", 0),
+         "replicas added after construction (add_replica)"),
     )
     for name, value, help_text in fleet_counters:
         scrape.counter_inc(name, float(value), help=help_text)
@@ -219,6 +226,16 @@ def fleet_prometheus(router, registry: Optional[MetricsRegistry] = None
     scrape.gauge_set("serving_fleet_routable_replicas",
                      float(health["routable_replicas"]),
                      help="replicas currently accepting dispatches")
+    quarantined = health.get("quarantined_versions", [])
+    scrape.gauge_set("serving_fleet_quarantined_versions",
+                     float(len(quarantined)),
+                     help="model versions currently quarantined after "
+                          "a failed canary")
+    for v in quarantined:
+        scrape.gauge_set("serving_fleet_quarantined_info", 1.0,
+                         help="info gauge: one series per quarantined "
+                              "model version",
+                         version=str(v))
     for q in ("p50_ms", "p95_ms", "p99_ms", "mean_ms"):
         scrape.gauge_set("serving_fleet_latency_ms",
                          float(stats.get(q, 0.0)),
@@ -260,6 +277,21 @@ def fleet_prometheus(router, registry: Optional[MetricsRegistry] = None
                          1.0, help="info gauge: the model version this "
                                    "replica is serving (hot-swap tag)",
                          replica=idx, version=str(h["model_version"]))
+        # continuous-loop surface (docs/serving.md "Continuous loop"):
+        # version info with the replica's canary/retire role attached,
+        # plus a one-hot canary-state gauge mirroring the breaker one
+        role = ("canary" if h.get("canary")
+                else "retired" if h.get("retired") else "primary")
+        scrape.gauge_set("serving_replica_version_info", 1.0,
+                         help="info gauge: model version + publish role "
+                              "per replica (canary rollout state)",
+                         replica=idx, version=str(h["model_version"]),
+                         state=role)
+        for s in ("primary", "canary", "retired"):
+            scrape.gauge_set("serving_replica_canary_state",
+                             1.0 if role == s else 0.0,
+                             help="one-hot publish role per replica",
+                             replica=idx, state=s)
     text = scrape.to_prometheus()
     reg = registry if registry is not None else get_registry()
     return text + reg.to_prometheus()

@@ -45,6 +45,38 @@ COMMIT_MARKER = "COMMITTED"
 RESUME_META = "resume.json"
 
 
+class UncommittedCheckpointError(RuntimeError):
+    """A BEST/LATEST marker names a step dir that is NOT committed — a
+    writer died mid-save (or is still writing). Consumers that must not
+    serve torn state (hot_swap_from_checkpoint, the CheckpointPublisher)
+    raise this instead of silently restoring; the message names the
+    uncommitted dir so the operator can wait for the in-flight save
+    (`wait_for_checkpoints`) or repoint/delete the marker."""
+
+
+def marker_target(log_name: str, path: str = "./logs",
+                  which: str = "best") -> Optional[str]:
+    """The step dir the BEST (or LATEST) marker currently names, WITHOUT
+    restoring it — the publisher's cheap change-detection probe. Returns
+    None when the marker (or checkpoint dir) doesn't exist; existence or
+    commit state of the named dir is NOT checked (pair with
+    `verify_checkpoint`)."""
+    if which not in ("best", "latest"):
+        raise ValueError(
+            f"which={which!r} — marker_target reads 'best' (the BEST "
+            "marker) or 'latest' (the LATEST marker)")
+    marker = os.path.join(_ckpt_dir(log_name, path), which.upper())
+    try:
+        with open(marker) as f:
+            # first line only: BEST's second line is its val loss
+            name = f.readline().strip()
+    except OSError:
+        return None
+    if not name:
+        return None
+    return os.path.join(_ckpt_dir(log_name, path), name)
+
+
 def _ckpt_dir(log_name: str, path: str = "./logs") -> str:
     return os.path.abspath(os.path.join(path, log_name, "checkpoint"))
 

@@ -49,6 +49,14 @@ SCOPE_FILES = (
     # mutates rank/generation state that shutdown()/snapshot() read from
     # other threads, and the ledger is single-writer under the same lock
     "hydragnn_tpu/elastic/supervisor.py",
+    # the continuous-learning loop (PR 19): the publisher's counters/
+    # history are mutated by its watch thread and read by snapshot()/
+    # bench adjudication, and its shadow-window pairs are appended from
+    # engine dispatcher threads; the autoscaler's event log is the same
+    # shape. Both drive router drains — a blocking call under their
+    # locks would stall the serving path.
+    "hydragnn_tpu/serving/publish.py",
+    "hydragnn_tpu/serving/autoscale.py",
 )
 
 _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")

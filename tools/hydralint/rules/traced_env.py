@@ -111,6 +111,14 @@ TRACED_FILES = (
     # .resolve_gfm at the call site; an env read here would fork the
     # one-compile mixture contract from a typo (docs/gfm.md)
     os.path.join("hydragnn_tpu", "train", "gfm.py"),
+    # the continuous-learning loop (PR 19) is host-side, but its knobs
+    # (shadow-window sizing, drift bound, autoscale watermarks) must
+    # resolve through serving/config.resolve_publish /
+    # resolve_autoscale at construction, never via direct env reads
+    # inside the subsystem — the PR 7/14 rule, applied to the publisher
+    # and autoscaler
+    os.path.join("hydragnn_tpu", "serving", "publish.py"),
+    os.path.join("hydragnn_tpu", "serving", "autoscale.py"),
 )
 
 MESSAGE = ("read inside a traced module — resolve it via utils/envflags.py "
