@@ -1,0 +1,169 @@
+"""Active-learning MD farm example: explore -> flag -> label ->
+retrain -> hot-swap (docs/active_learning.md, ROADMAP item 5).
+
+The closed loop this driver runs:
+
+    farm (vmapped velocity-Verlet, T trajectories) ----------------+
+        | device-fused ensemble uncertainty per structure          |
+        | rising-edge harvest at tau (deterministic, on-grid)      |
+        v                                                          |
+    CandidatePool (content-addressed, dedup'd)                     |
+        | LJ oracle labels (energy + forces)                       |
+        v                                                          |
+    fine-tune from BEST variables (TrialSupervisor-managed)        |
+        | probe error improved?                                    |
+        +--- hot-swap engine + farm (swap_variables, zero ---------+
+             recompiles) and run the next round from the
+             trajectories' final state
+
+The model starts UNTRAINED (random init), so the farm immediately
+wanders into high-error territory: each round the trajectories carry
+on from where they stopped, harvest the structures where the ensemble
+disagrees, and the probe error against the Lennard-Jones oracle drops
+round over round — the BENCH_ACTIVE adjudication, interactive.
+
+Usage:
+
+    python examples/active_learning/active_learning.py \
+        [--traj 16] [--steps 64] [--rounds 3] [--tau 0.0] [--cpu]
+
+Prints one JSON report per round, then a summary line.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(__file__).rsplit("/examples", 1)[0])
+
+
+def build_fixture(args):
+    """Engine + scored farm + pool + learner on the LJ MD fixture (the
+    same shapes examples/md_loop and BENCH_ACTIVE use)."""
+    from examples.LennardJones.lj_data import lj_energy_forces
+    from examples.md_loop.md_loop import (init_lattice, lj_md_config,
+                                          maxwell_velocities, md_buckets)
+    from hydragnn_tpu.config import build_model_config, update_config
+    from hydragnn_tpu.graphs.batch import collate
+    from hydragnn_tpu.md.active import (ActiveLearner, CandidatePool,
+                                        EnsembleScorer)
+    from hydragnn_tpu.models.create import create_model, init_params
+    from hydragnn_tpu.preprocess.transforms import build_graph_sample
+    from hydragnn_tpu.serving.engine import InferenceEngine
+
+    cfg = lj_md_config(radius=args.radius, max_neighbours=6,
+                       hidden_dim=args.hidden, num_conv_layers=1,
+                       num_gaussians=8)
+    pos0, cell = init_lattice(args.atoms_per_dim, args.lattice,
+                              jitter=0.03, seed=1)
+    n = pos0.shape[0]
+    node_features = np.ones((n, 1), np.float32)
+    frame0 = build_graph_sample(node_features, pos0, cfg, cell=cell,
+                                with_targets=False)
+    ucfg = update_config(cfg, [frame0])
+    mcfg = build_model_config(ucfg)
+    model = create_model(mcfg)
+    variables = init_params(model, collate([frame0]))
+    engine = InferenceEngine(
+        model, variables, mcfg, buckets=md_buckets(n, frame0.num_edges),
+        proto_sample=frame0, max_batch_size=1, max_wait_ms=0.0,
+        structure_config=ucfg, md_skin=args.skin, ef_forward=True)
+    engine.warmup()
+
+    def oracle_fn(pos, c):
+        e, f, _ = lj_energy_forces(np.asarray(pos, np.float64), c,
+                                   args.radius)
+        return e, f
+
+    scorer = EnsembleScorer(model, mcfg, engine._variables,
+                            members=args.members, eps=args.eps,
+                            tau=args.tau, harvest_cap=args.cap)
+    farm = engine.trajectory_farm(dt=args.dt, skin=args.skin,
+                                  scorer=scorer)
+    probe = [(init_lattice(args.atoms_per_dim, args.lattice,
+                           jitter=0.05, seed=900 + i)[0],
+              node_features, cell) for i in range(args.probe)]
+    learner = ActiveLearner(engine, farm,
+                            CandidatePool(args.pool, ucfg), oracle_fn,
+                            probe=probe,
+                            finetune_steps=args.finetune_steps,
+                            finetune_lr=args.lr)
+    pos_t = np.stack([init_lattice(args.atoms_per_dim, args.lattice,
+                                   jitter=0.03, seed=100 + t)[0]
+                      for t in range(args.traj)])
+    vel_t = np.stack([maxwell_velocities(n, args.temp, seed=200 + t)
+                      for t in range(args.traj)])
+    return engine, learner, pos_t, vel_t, node_features, cell
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--traj", type=int, default=16)
+    p.add_argument("--steps", type=int, default=64)
+    p.add_argument("--rounds", type=int, default=3)
+    p.add_argument("--members", type=int, default=4)
+    p.add_argument("--eps", type=float, default=0.05)
+    p.add_argument("--tau", type=float, default=0.0)
+    p.add_argument("--cap", type=int, default=8)
+    p.add_argument("--finetune_steps", type=int, default=80)
+    p.add_argument("--lr", type=float, default=2e-3)
+    p.add_argument("--probe", type=int, default=6)
+    p.add_argument("--atoms_per_dim", type=int, default=2)
+    p.add_argument("--lattice", type=float, default=1.0)
+    p.add_argument("--radius", type=float, default=1.2)
+    p.add_argument("--hidden", type=int, default=4)
+    p.add_argument("--skin", type=float, default=0.3)
+    p.add_argument("--dt", type=float, default=0.004)
+    p.add_argument("--temp", type=float, default=0.3)
+    p.add_argument("--pool", default="",
+                   help="candidate-pool directory (default: a temp dir "
+                        "removed on exit; pass a path to keep the pool)")
+    p.add_argument("--cpu", action="store_true",
+                   help="force JAX_PLATFORMS=cpu")
+    args = p.parse_args()
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    # the farm's grid integrator carries f64 state — set before jax
+    # initializes (docs/serving.md "MD farm")
+    os.environ["JAX_ENABLE_X64"] = "1"
+
+    tmp = None
+    if not args.pool:
+        tmp = tempfile.mkdtemp(prefix="active-pool-")
+        args.pool = tmp
+    engine = None
+    try:
+        engine, learner, pos_t, vel_t, nf, cell = build_fixture(args)
+        print(json.dumps({"initial_probe_error":
+                          round(learner.best_error, 6)}))
+        for _ in range(args.rounds):
+            report = learner.run_round(pos_t, vel_t, args.steps,
+                                       node_features=nf, cell=cell)
+            print(json.dumps(report))
+            # next round continues from where the trajectories stopped
+            pos_t, vel_t = learner.last_state
+        errors = ([learner.rounds[0]["error_before"]]
+                  + [r["error_after"] for r in learner.rounds])
+        print(json.dumps({
+            "rounds": args.rounds,
+            "errors_by_round": [round(e, 6) for e in errors],
+            "error_strictly_decreasing":
+                all(b < a for a, b in zip(errors, errors[1:])),
+            "pool_size": len(learner.pool),
+            "swaps": learner.swaps,
+        }))
+    finally:
+        if engine is not None:
+            engine.shutdown()
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,8 +1,13 @@
 """Massively-batched on-device MD: the trajectory farm (ROADMAP item 3,
-FlashSchNet) and the association-proof grid integrator it shares with the
-single-session serving loop (examples/md_loop). See docs/serving.md
-"MD farm" and docs/preprocessing.md for the determinism contracts."""
+FlashSchNet), the association-proof grid integrator it shares with the
+single-session serving loop (examples/md_loop), and the active-learning
+loop that closes over them (ROADMAP item 5 — device-fused uncertainty
+scoring, deterministic harvest, self-retraining hot-swap). See
+docs/serving.md "MD farm", docs/active_learning.md, and
+docs/preprocessing.md for the determinism contracts."""
+from .active import ActiveLearner, CandidatePool, EnsembleScorer
 from .farm import TrajectoryFarm
 from . import integrator
 
-__all__ = ["TrajectoryFarm", "integrator"]
+__all__ = ["ActiveLearner", "CandidatePool", "EnsembleScorer",
+           "TrajectoryFarm", "integrator"]

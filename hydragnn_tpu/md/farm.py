@@ -196,13 +196,24 @@ class TrajectoryFarm:
     integrates every trajectory ``steps`` velocity-Verlet steps and
     returns final state + farm statistics. Initial conditions are
     snapped to the integrator grid exactly as `run_md` snaps its own.
+
+    With ``scorer`` (an `md.active.EnsembleScorer`) the SAME jitted
+    dispatch additionally scores each structure's ensemble uncertainty
+    and applies the deterministic harvest rule: a trajectory harvests
+    the structure at which its uncertainty RISES through ``scorer.tau``
+    (``cross = advanced & (unc >= tau) & ~was_above`` — a pure function
+    of grid state, so twin runs harvest bitwise-identical pools) into
+    per-trajectory device buffers (``scorer.harvest_cap`` slots, part of
+    the donated scan carry), drained once per run into
+    ``result["harvest"]``. Without a scorer the program is byte-for-byte
+    the PR 11 farm — every bitwise contract above is untouched.
     """
 
     def __init__(self, model, variables, mcfg, structure_config, *,
                  bucket, dt: float, skin: float = 0.3, mass: float = 1.0,
                  force_scale: float = 1.0, steps_per_dispatch: int = 8,
                  cand_headroom: float = 0.5,
-                 compute_dtype: Optional[str] = None):
+                 compute_dtype: Optional[str] = None, scorer=None):
         from ..train.loss import energy_forces_from_node_head
         from ..train.train_step import make_forward_fn
 
@@ -246,29 +257,73 @@ class TrajectoryFarm:
             raise ValueError("cand_headroom must be >= 0, got "
                              f"{cand_headroom}")
         self.bucket = bucket
+        self._model = model
+        self.mcfg = mcfg
+        self.compute_dtype = compute_dtype
         self._variables = {"params": variables["params"],
                            "batch_stats": variables.get("batch_stats", {})}
-        forward = make_forward_fn(model, mcfg, compute_dtype)
+        self.scorer = scorer
+        if scorer is not None:
+            # the scored forward replaces the EF forward INSIDE the same
+            # vmapped/scanned program: one conv stack, M perturbed head
+            # replays on its sown final embedding, f32 std — see
+            # md/active.py for the math and docs/active_learning.md for
+            # the contract
+            self._head_forward = scorer.make_head_forward()
+        else:
+            forward = make_forward_fn(model, mcfg, compute_dtype)
 
-        def head_forward(variables, batch):
-            # identical composition to the engine's ef_forward path: the
-            # served quantity IS the trained quantity, and the vmapped
-            # farm forward stays the same expression the session serves
-            def apply_fn(v, b, train):
-                return forward(v, b, train=train), None
+            def head_forward(variables, batch):
+                # identical composition to the engine's ef_forward path:
+                # the served quantity IS the trained quantity, and the
+                # vmapped farm forward stays the same expression the
+                # session serves
+                def apply_fn(v, b, train):
+                    return forward(v, b, train=train), None
 
-            graph_e, forces, _ = energy_forces_from_node_head(
-                apply_fn, variables, batch, train=False)
-            return graph_e, forces
+                graph_e, forces, _ = energy_forces_from_node_head(
+                    apply_fn, variables, batch, train=False)
+                return graph_e, forces
 
-        self._head_forward = head_forward
+            self._head_forward = head_forward
         # compiled K-step dispatch executables, keyed by the shape
         # tuple that determines every aval — repeat run() calls on the
         # same farm are compile-free (the engine's warmup-once
         # convention)
         self._exec_cache: Dict = {}
+        self.fresh_compiles = 0  # lifetime exec-cache misses (the
+        # BENCH_ACTIVE zero-added-compiles pin reads the per-run delta)
         self._jswap = None
         self._jresume = None
+        self.version = "farm-init"
+
+    def swap_variables(self, variables, version: str) -> str:
+        """Hot-swap the farm's model variables (the PR 12-13 engine
+        contract, mirrored): the replacement tree must match the current
+        one leaf-for-leaf in shape and dtype — the compiled dispatch
+        takes variables as a runtime argument, so a shape-compatible
+        swap costs ZERO recompiles and the next dispatch serves the new
+        model. Returns the previous version tag."""
+        import jax
+        new = {"params": variables["params"],
+               "batch_stats": variables.get("batch_stats", {})}
+
+        def _check(old_leaf, new_leaf):
+            o, nl = np.shape(old_leaf), np.shape(new_leaf)
+            od = np.asarray(old_leaf).dtype
+            nd = np.asarray(new_leaf).dtype
+            if o != nl or od != nd:
+                raise ValueError(
+                    f"swap rejected: leaf {nl}/{nd} != current {o}/{od} "
+                    "— farms only hot-swap shape/dtype-compatible "
+                    "variables (rebuild the farm for a new architecture)")
+            return new_leaf
+
+        jax.tree_util.tree_map(_check, self._variables, new)
+        old_version = self.version
+        self._variables = new
+        self.version = str(version)
+        return old_version
 
     # ------------------------------------------------------------- packing
 
@@ -292,6 +347,11 @@ class TrajectoryFarm:
         refilter = make_batched_refilter(n, self.radius,
                                          self.max_neighbours, w_cap)
         head_forward = self._head_forward
+        scored = self.scorer is not None
+        if scored:
+            tau = float(self.scorer.tau)      # trace constants — part of
+            H = int(self.scorer.harvest_cap)  # the compiled program, like
+            # every other farm knob (a new threshold is a new farm)
 
         def one_compact(pos, keep, send, recv, shift):
             # `shift` is None on the open-boundary trace (no cartesian
@@ -351,8 +411,13 @@ class TrajectoryFarm:
                 caches.get("shift"))
             over = act & (~viol) & (cnt > e_cap)
             adv = act & (~viol) & (~over)
-            graph_e, forces = vfwd(variables, b_template, posf, senders,
-                                   receivers, eshift, emask)
+            if scored:
+                graph_e, forces, unc = vfwd(variables, b_template, posf,
+                                            senders, receivers, eshift,
+                                            emask)
+            else:
+                graph_e, forces = vfwd(variables, b_template, posf,
+                                       senders, receivers, eshift, emask)
             acc_new = mdi.accel_term(forces[:, :n, :], s_hi, s_lo, xp=jnp)
             vd_new = mdi.kick(st["vd"], st["ad2"], acc_new, xp=jnp)
             m3 = adv[:, None, None]
@@ -361,7 +426,7 @@ class TrajectoryFarm:
             e = graph_e[:, 0, 0].astype(jnp.float64)
             first = adv & (~st["has_acc"])
             stepped = adv & st["has_acc"]
-            return {
+            new = {
                 "pos": p_new,
                 "vd": jnp.where(stepped[:, None, None], vd_new, st["vd"]),
                 "ad2": jnp.where(m3, acc_new, st["ad2"]),
@@ -375,13 +440,50 @@ class TrajectoryFarm:
                 "energy_first": jnp.where(first, e, st["energy_first"]),
                 "energy_last": jnp.where(adv, e, st["energy_last"]),
             }
+            if not scored:
+                return new, None
+            # deterministic harvest (docs/active_learning.md): the rule
+            # is a pure function of (adv, unc, previous level state) —
+            # booleans and an f32 std of exact-input energies — so twin
+            # runs make identical decisions at every step. Rising-edge:
+            # harvest the structure at which unc CROSSES tau upward,
+            # not every structure sitting above it.
+            above = unc >= tau
+            cross = adv & above & (~st["unc_above"])
+            slot = st["harvest_count"]  # next free buffer slot (or >= H:
+            # pool full, crossing counted but structure dropped)
+            write = cross & (slot < H)
+            slot_w = jnp.where(write, slot, H)  # H = out of bounds,
+            rows = jnp.arange(slot.shape[0])    # dropped by mode="drop"
+            step_val = new["steps_done"]
+            new.update({
+                "unc_above": jnp.where(adv, above, st["unc_above"]),
+                "harvest_count": slot + cross.astype(jnp.int32),
+                "harvest_pos": st["harvest_pos"].at[rows, slot_w].set(
+                    p_new, mode="drop", unique_indices=True),
+                "harvest_step": st["harvest_step"].at[rows, slot_w].set(
+                    step_val, mode="drop", unique_indices=True),
+                "harvest_unc": st["harvest_unc"].at[rows, slot_w].set(
+                    unc, mode="drop", unique_indices=True),
+                "unc_max": jnp.maximum(
+                    st["unc_max"],
+                    jnp.max(jnp.where(adv, unc,
+                                      jnp.float32(-jnp.inf)))),
+            })
+            # per-step traces for host-side adjudication (the
+            # threshold-straddle tests recompute the harvest rule from
+            # these and pin equality) — small [T] rows, stacked by scan
+            ys = {"unc": unc, "adv": adv, "steps_done": step_val}
+            return new, ys
 
         def dispatch(state, caches, variables, steps_target, b_template):
             def scan_body(st, _):
                 return body(st, caches, variables, steps_target,
-                            b_template), None
+                            b_template)
 
-            out, _ = jax.lax.scan(scan_body, state, None, length=K)
+            out, ys = jax.lax.scan(scan_body, state, None, length=K)
+            if scored:
+                return out, ys
             return out
 
         return jax.jit(dispatch, donate_argnums=(0,))
@@ -470,6 +572,9 @@ class TrajectoryFarm:
         reg = get_registry()
         swaps = 0
         dispatches = 0
+        scored = self.scorer is not None
+        fresh_compiles_before = self.fresh_compiles
+        traces: List[Dict[str, np.ndarray]] = []
         with enable_x64():
             b_template = jax.tree_util.tree_map(jnp.asarray, b0)
             packed = [self._pack_traj(nls[t], c_cap, w_cap, n)
@@ -488,6 +593,16 @@ class TrajectoryFarm:
                 "energy_first": jnp.zeros(T, jnp.float64),
                 "energy_last": jnp.zeros(T, jnp.float64),
             }
+            if scored:
+                H = int(self.scorer.harvest_cap)
+                state.update({
+                    "unc_above": jnp.zeros(T, bool),
+                    "harvest_count": jnp.zeros(T, jnp.int32),
+                    "harvest_pos": jnp.zeros((T, H, n, 3), jnp.float64),
+                    "harvest_step": jnp.full((T, H), -1, jnp.int32),
+                    "harvest_unc": jnp.zeros((T, H), jnp.float32),
+                    "unc_max": jnp.asarray(-jnp.inf, jnp.float32),
+                })
             steps_target = jnp.asarray(steps, jnp.int32)
             if self._jswap is None:
                 def swap_one(caches, t, new):
@@ -517,13 +632,20 @@ class TrajectoryFarm:
                                           steps_target,
                                           b_template).compile()
                 self._exec_cache[exec_key] = compiled
+                self.fresh_compiles += 1
 
             t_start = time.perf_counter()
             last_done = -1
             while True:
                 t0 = _spans.now()
-                state = compiled(state, caches, self._variables,
-                                 steps_target, b_template)
+                if scored:
+                    state, ys = compiled(state, caches, self._variables,
+                                         steps_target, b_template)
+                    traces.append({key: np.asarray(val)
+                                   for key, val in ys.items()})
+                else:
+                    state = compiled(state, caches, self._variables,
+                                     steps_target, b_template)
                 dispatches += 1
                 frozen = np.asarray(state["frozen"])
                 done = int(np.asarray(state["steps_done"]).sum())
@@ -573,6 +695,23 @@ class TrajectoryFarm:
             final_vd = np.asarray(state["vd"])
             e_first = np.asarray(state["energy_first"])
             e_last = np.asarray(state["energy_last"])
+            harvest = None
+            max_unc = None
+            if scored:
+                h_cnt = np.asarray(state["harvest_count"])
+                filled = np.minimum(h_cnt, self.scorer.harvest_cap)
+                harvest = {
+                    "pos": np.asarray(state["harvest_pos"]),
+                    "step": np.asarray(state["harvest_step"]),
+                    "unc": np.asarray(state["harvest_unc"]),
+                    "count": h_cnt,
+                    "filled": filled,
+                    "dropped": int(np.maximum(
+                        h_cnt - self.scorer.harvest_cap, 0).sum()),
+                    "tau": float(self.scorer.tau),
+                }
+                um = float(np.asarray(state["unc_max"]))
+                max_unc = um if np.isfinite(um) else None
 
         total_steps = steps * T
         reg.counter_inc("md.farm_steps_total", float(total_steps),
@@ -588,12 +727,24 @@ class TrajectoryFarm:
                       help="completed steps per device dispatch "
                            "(aggregate over trajectories) of the last "
                            "farm run")
+        if scored:
+            reg.counter_inc(
+                "md.harvest_total", float(harvest["filled"].sum()),
+                help="structures harvested into candidate pools by "
+                     "scored trajectory farms")
+            reg.gauge_set(
+                "md.uncertainty",
+                max_unc if max_unc is not None else 0.0,
+                help="maximum ensemble uncertainty observed over the "
+                     "last scored farm run (model energy units)")
         reg.log_event(
             "md", "farm_run",
             data={"trajectories": T, "atoms": n, "steps": steps,
                   "rebuild_swaps": swaps, "dispatches": dispatches,
                   "steps_per_dispatch": self.steps_per_dispatch,
-                  "cand_capacity": c_cap},
+                  "cand_capacity": c_cap,
+                  "harvested": (int(harvest["filled"].sum())
+                                if scored else None)},
             timing={"wall_s": wall,
                     "aggregate_steps_per_s": (total_steps / wall
                                               if wall > 0 else None)})
@@ -620,4 +771,15 @@ class TrajectoryFarm:
             "per_traj_rebuilds": [nl.rebuilds - 1 for nl in nls],
             "cand_capacity": c_cap,
             "max_degree_capacity": w_cap,
+            "fresh_compiles_run": self.fresh_compiles
+            - fresh_compiles_before,
+            "harvest": harvest,
+            "max_uncertainty": max_unc,
+            "unc_trace": (np.concatenate([tr["unc"] for tr in traces])
+                          if traces else None),
+            "adv_trace": (np.concatenate([tr["adv"] for tr in traces])
+                          if traces else None),
+            "step_trace": (np.concatenate([tr["steps_done"]
+                                           for tr in traces])
+                           if traces else None),
         }

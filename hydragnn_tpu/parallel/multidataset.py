@@ -229,6 +229,15 @@ class GfmMixtureLoader(GraphDataLoader):
     HYDRAGNN_GFM_MIXTURE); members absent from the spec default to
     weight 1.0, unknown names raise (typo protection). Without a spec
     the epoch draws every sample exactly once (size-proportional).
+    ``weight_schedule`` is an optional sequence of such mappings, one
+    per epoch (curriculum over epochs, ROADMAP item 2 headroom): epoch
+    e draws under ``weight_schedule[min(e, len-1)]`` — clamped at the
+    last entry — re-planned through the SAME (epoch, seed)-pure
+    `mixture_order`, so the schedule stays world-size-invariant and
+    elastically resumable. A constant schedule is BITWISE the
+    unscheduled plan (pinned in tests/test_gfm.py), and the plan
+    fingerprint folds the schedule so scheduled and unscheduled runs
+    can never masquerade as the same plan.
     ``pack_budget`` pins the shared union budget externally — pass the
     full-menu budget to train a sub-mixture under the same compiled
     shapes (the adding-a-dataset-adds-zero-compiles contract BENCH_GFM
@@ -237,6 +246,8 @@ class GfmMixtureLoader(GraphDataLoader):
 
     def __init__(self, datasets, batch_size: int, *, cfg=None,
                  weights: Optional[Mapping[str, float]] = None,
+                 weight_schedule: Optional[
+                     Sequence[Mapping[str, float]]] = None,
                  seed: int = 0, num_shards: int = 1,
                  epoch_quota: Optional[int] = None,
                  pack_budget=None, pack_lookahead: Optional[int] = None,
@@ -249,18 +260,34 @@ class GfmMixtureLoader(GraphDataLoader):
                                   per_dataset_heads=True)
         self.member_names = names
         self.member_sizes = [len(m) for m in members]
-        if weights:
-            unknown = sorted(set(weights) - set(names))
-            if unknown:
-                raise ValueError(
-                    f"mixture weights name unknown dataset(s) "
-                    f"{unknown}; members are {sorted(names)}")
-            self.member_weights = tuple(
-                float(weights.get(n, 1.0)) for n in names)
-        else:
+
+        def _resolve_weights(spec):
+            if spec:
+                unknown = sorted(set(spec) - set(names))
+                if unknown:
+                    raise ValueError(
+                        f"mixture weights name unknown dataset(s) "
+                        f"{unknown}; members are {sorted(names)}")
+                return tuple(float(spec.get(n, 1.0)) for n in names)
             # size-proportional default: every sample exactly once
-            self.member_weights = tuple(
-                float(s) for s in self.member_sizes)
+            return tuple(float(s) for s in self.member_sizes)
+
+        if weight_schedule is not None and weights is not None:
+            raise ValueError(
+                "pass weights OR weight_schedule, not both — a schedule "
+                "IS the per-epoch weights")
+        if weight_schedule is not None and not len(weight_schedule):
+            raise ValueError("weight_schedule must have >= 1 entry")
+        self.member_weights = _resolve_weights(
+            weight_schedule[0] if weight_schedule is not None
+            else weights)
+        # resolved per-epoch weight tuples (None = no schedule); every
+        # entry validates NOW so a typo'd epoch-7 name cannot detonate
+        # mid-training
+        self._weight_schedule = (
+            None if weight_schedule is None
+            else tuple(_resolve_weights(s) for s in weight_schedule))
+        self._epoch_quota = epoch_quota
         self._quotas = mixture_quotas(self.member_sizes,
                                       self.member_weights, epoch_quota)
         self._ds_of = np.repeat(
@@ -276,10 +303,28 @@ class GfmMixtureLoader(GraphDataLoader):
             pack_rank=pack_rank, pack_nproc=pack_nproc,
             async_workers=async_workers, cache_mb=cache_mb)
 
+    def _epoch_weights(self, epoch: int) -> Tuple[float, ...]:
+        """This epoch's weight tuple: schedule entry min(epoch, last)
+        when a schedule is set (clamped — training past the schedule
+        holds the final mixture), else the constant weights."""
+        if self._weight_schedule is None:
+            return self.member_weights
+        return self._weight_schedule[
+            min(int(epoch), len(self._weight_schedule) - 1)]
+
+    def _epoch_quotas(self, epoch: int) -> List[int]:
+        if self._weight_schedule is None:
+            return self._quotas  # the constructor's quotas, bitwise the
+            # pre-schedule behaviour
+        return mixture_quotas(self.member_sizes,
+                              self._epoch_weights(epoch),
+                              self._epoch_quota)
+
     def _order(self) -> np.ndarray:
         # the GLOBAL mixture interleave — pure in (seed, epoch) + spec;
         # the inherited _plan() packs it and slices per (rank, nproc)
-        return mixture_order(self.member_sizes, self._quotas,
+        return mixture_order(self.member_sizes,
+                             self._epoch_quotas(self.epoch),
                              self.seed, self.epoch)
 
     def _postprocess_shard(self, batch: GraphBatch,
@@ -290,12 +335,14 @@ class GfmMixtureLoader(GraphDataLoader):
         return batch.replace(dataset_id=ids)
 
     def mixture_fractions(self) -> "dict[str, float]":
-        """name -> fraction of the epoch's global plan drawn from that
-        member (deterministic — quota-derived, not measured), the
-        ``gfm_mixture_frac_<dataset>`` telemetry value."""
-        total = max(sum(self._quotas), 1)
+        """name -> fraction of the CURRENT epoch's global plan drawn
+        from that member (deterministic — quota-derived, not measured),
+        the ``gfm_mixture_frac_<dataset>`` telemetry value. Under a
+        weight schedule this tracks the epoch's entry."""
+        quotas = self._epoch_quotas(self.epoch)
+        total = max(sum(quotas), 1)
         return {n: q / total
-                for n, q in zip(self.member_names, self._quotas)}
+                for n, q in zip(self.member_names, quotas)}
 
     def global_plan_fingerprint(self) -> str:
         """The packing fingerprint (docs/packing.md) with the mixture
@@ -307,6 +354,10 @@ class GfmMixtureLoader(GraphDataLoader):
         base = super().global_plan_fingerprint()
         payload = repr((base, self.member_names, self.member_weights,
                         tuple(self._quotas)))
+        if self._weight_schedule is not None:
+            # folded ONLY when set, so every pre-schedule fingerprint
+            # (checkpoints, elastic ledgers) stays byte-stable
+            payload = repr((payload, self._weight_schedule))
         return hashlib.sha256(payload.encode()).hexdigest()[:16]
 
 

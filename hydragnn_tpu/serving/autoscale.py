@@ -11,7 +11,12 @@ snapshot and, on sustained pressure, grows or shrinks the fleet —
   queues behind). Above ``high_depth`` with room under ``max_replicas``
   -> scale up; below ``low_depth`` with slack above ``min_replicas`` ->
   scale down; a ``cooldown_s`` gap separates consecutive actions so
-  opposing decisions cannot thrash.
+  opposing decisions cannot thrash. With ``signal="p99_latency"``
+  (HYDRAGNN_AUTOSCALE_SIGNAL, strict-parsed) the watermarks key off the
+  fleet-wide p99 latency already in ``router.stats()`` instead —
+  scaling directly on the SLO the fleet is held to (``high_p99_ms`` /
+  ``low_p99_ms``); a stats window with zero resolved requests takes no
+  action (an idle fleet is not a fast fleet).
 * **scale-up is disk-warm** — a previously retired slot is revived via
   ``router.restart_replica`` (else ``router.add_replica`` appends a new
   slot); either way the engine warms its bucket ladder from the shared
@@ -128,22 +133,31 @@ class QueueDepthAutoscaler:
             return None
         live = [h for h in reps.values() if h["alive"]]
         n_live = len(live)
-        depths = [float(h["queue_depth"]) for h in live
-                  if h["dispatcher_alive"]]
-        avg_depth = sum(depths) / len(depths) if depths else 0.0
+        if cfg.signal == "p99_latency":
+            stats = self.router.stats()
+            if not stats.get("count"):
+                return None  # no resolved requests in the window —
+                # p99 is the zeroed placeholder, not a fast fleet
+            signal = float(stats["p99_ms"])
+            high, low = cfg.high_p99_ms, cfg.low_p99_ms
+        else:
+            depths = [float(h["queue_depth"]) for h in live
+                      if h["dispatcher_alive"]]
+            signal = sum(depths) / len(depths) if depths else 0.0
+            high, low = cfg.high_depth, cfg.low_depth
         now = time.monotonic()
         with self._lock:
             cooling = (self._last_action_t is not None
                        and now - self._last_action_t < cfg.cooldown_s)
         if cooling:
             return None
-        if avg_depth >= cfg.high_depth and n_live < cfg.max_replicas:
-            return self._scale_up(reps, avg_depth, n_live)
-        if avg_depth <= cfg.low_depth and n_live > cfg.min_replicas:
-            return self._scale_down(reps, avg_depth, n_live)
+        if signal >= high and n_live < cfg.max_replicas:
+            return self._scale_up(reps, signal, n_live)
+        if signal <= low and n_live > cfg.min_replicas:
+            return self._scale_down(reps, signal, n_live)
         return None
 
-    def _scale_up(self, reps: dict, avg_depth: float,
+    def _scale_up(self, reps: dict, signal_val: float,
                   n_live: int) -> Optional[dict]:
         # prefer reviving a retired slot (restart_replica) over growing
         # the replica list — both are disk-warm, the former keeps
@@ -161,7 +175,9 @@ class QueueDepthAutoscaler:
                 "autoscale scale-up failed: %s", exc)
             return None
         event = {"action": "scale_up", "replica": report["replica"],
-                 "revived": bool(retired), "avg_depth": avg_depth,
+                 "revived": bool(retired), "signal": self.cfg.signal,
+                 "avg_depth": signal_val,  # historical key: the signal
+                 # value (mean depth, or p99 ms in p99_latency mode)
                  "replicas_before": n_live,
                  "replicas_after": n_live + 1,
                  "fresh_compiles": report.get("fresh", 0),
@@ -174,7 +190,7 @@ class QueueDepthAutoscaler:
         self._count("scale_up")
         return event
 
-    def _scale_down(self, reps: dict, avg_depth: float,
+    def _scale_down(self, reps: dict, signal_val: float,
                     n_live: int) -> Optional[dict]:
         # retire the HIGHEST-index live replica: lowest indices carry
         # the `_pick` tie-break traffic, and dense-from-zero slots keep
@@ -197,7 +213,8 @@ class QueueDepthAutoscaler:
                 victim, exc)
             return None
         event = {"action": "scale_down", "replica": victim,
-                 "avg_depth": avg_depth, "replicas_before": n_live,
+                 "signal": self.cfg.signal, "avg_depth": signal_val,
+                 "replicas_before": n_live,
                  "replicas_after": n_live - 1,
                  "t_s": round(time.monotonic() - self._t0, 3)}
         with self._lock:

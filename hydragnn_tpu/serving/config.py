@@ -210,6 +210,56 @@ def resolve_md_farm(config: Optional[Dict[str, Any]] = None) -> MdFarm:
 
 
 @dataclasses.dataclass(frozen=True)
+class ActiveConfig:
+    """Active-learning farm knobs (docs/active_learning.md; md/active.py).
+    The harvest CONTRACT — rising-edge threshold crossing on the exact
+    integrator grid, content-addressed dedup — is not knobbed; these only
+    size the ensemble, the threshold, and the fine-tune leg."""
+    members: int = 4          # ensemble size M (member 0 unperturbed)
+    eps: float = 0.02         # multiplicative head-weight perturbation
+    tau: float = 0.1          # uncertainty threshold (model energy units)
+    harvest_cap: int = 16     # per-trajectory harvest buffer slots
+    seed: int = 0             # ensemble perturbation seed
+    finetune_steps: int = 60  # optimizer steps per fine-tune round
+    finetune_lr: float = 1e-3
+
+
+def resolve_active(config: Optional[Dict[str, Any]] = None) -> ActiveConfig:
+    """Merge the `Serving.md_active` block and the HYDRAGNN_MD_ACTIVE_*
+    env knobs (strict parsing — a typo warns and keeps the default).
+    `EnsembleScorer.from_config` is the consumer — deployments size the
+    ensemble through config/env without code changes. bench.py's
+    BENCH_ACTIVE and the examples driver carry their own bench-shape
+    knobs (BENCH_ACTIVE_* / argparse) with deliberately hotter defaults
+    (tau 0.0, eps 0.05) sized to DEMONSTRATE learning on the toy LJ
+    fixture in a few rounds."""
+    from ..utils.envflags import env_strict_float, env_strict_int
+    block = ((config or {}).get("Serving", {}) or {}).get("md_active",
+                                                          {}) or {}
+    base = ActiveConfig(
+        members=int(block.get("members", 4) or 4),
+        eps=float(block.get("eps", 0.02) or 0.02),
+        tau=float(block.get("tau", 0.1) or 0.1),
+        harvest_cap=int(block.get("harvest_cap", 16) or 16),
+        seed=int(block.get("seed", 0) or 0),
+        finetune_steps=int(block.get("finetune_steps", 60) or 60),
+        finetune_lr=float(block.get("finetune_lr", 1e-3) or 1e-3),
+    )
+    return ActiveConfig(
+        members=env_strict_int("HYDRAGNN_MD_ACTIVE_MEMBERS", base.members),
+        eps=env_strict_float("HYDRAGNN_MD_ACTIVE_EPS", base.eps),
+        tau=env_strict_float("HYDRAGNN_MD_ACTIVE_TAU", base.tau),
+        harvest_cap=env_strict_int("HYDRAGNN_MD_ACTIVE_HARVEST_CAP",
+                                   base.harvest_cap),
+        seed=env_strict_int("HYDRAGNN_MD_ACTIVE_SEED", base.seed),
+        finetune_steps=env_strict_int("HYDRAGNN_MD_ACTIVE_FINETUNE_STEPS",
+                                      base.finetune_steps),
+        finetune_lr=env_strict_float("HYDRAGNN_MD_ACTIVE_FINETUNE_LR",
+                                     base.finetune_lr),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
 class FleetConfig:
     """Replica-router knobs (docs/serving.md "Fleet"; serving/fleet.py).
     The routing/isolation CONTRACT (least-queue-depth, exactly-once
@@ -335,13 +385,19 @@ class AutoscaleConfig:
     cooldown_s: float = 5.0   # min seconds between actions
     poll_interval_s: float = 1.0
     drain_timeout_s: float = 30.0
+    signal: str = "queue_depth"  # "queue_depth" | "p99_latency" — the
+    # pressure signal the watermarks compare against (p99_latency keys
+    # off the fleet-wide p99 already in `router.stats()`: the SLO mode)
+    high_p99_ms: float = 500.0   # p99 latency -> scale up (SLO mode)
+    low_p99_ms: float = 50.0     # p99 latency -> scale down (SLO mode)
 
 
 def resolve_autoscale(config: Optional[Dict[str, Any]] = None
                       ) -> AutoscaleConfig:
     """Merge the `Serving.autoscale` block and the HYDRAGNN_AUTOSCALE_*
     env knobs (strict parsing — a typo warns and keeps the default)."""
-    from ..utils.envflags import env_strict_float, env_strict_int
+    from ..utils.envflags import (env_strict_choice, env_strict_float,
+                                  env_strict_int)
     block = ((config or {}).get("Serving", {}) or {}).get("autoscale",
                                                           {}) or {}
     base = AutoscaleConfig(
@@ -352,6 +408,9 @@ def resolve_autoscale(config: Optional[Dict[str, Any]] = None
         cooldown_s=float(block.get("cooldown_s", 5.0) or 5.0),
         poll_interval_s=float(block.get("poll_interval_s", 1.0) or 1.0),
         drain_timeout_s=float(block.get("drain_timeout_s", 30.0) or 30.0),
+        signal=str(block.get("signal", "queue_depth") or "queue_depth"),
+        high_p99_ms=float(block.get("high_p99_ms", 500.0) or 500.0),
+        low_p99_ms=float(block.get("low_p99_ms", 50.0) or 50.0),
     )
     return AutoscaleConfig(
         min_replicas=env_strict_int("HYDRAGNN_AUTOSCALE_MIN",
@@ -368,6 +427,14 @@ def resolve_autoscale(config: Optional[Dict[str, Any]] = None
                                          base.poll_interval_s),
         drain_timeout_s=env_strict_float(
             "HYDRAGNN_AUTOSCALE_DRAIN_TIMEOUT_S", base.drain_timeout_s),
+        signal=env_strict_choice(
+            "HYDRAGNN_AUTOSCALE_SIGNAL",
+            {"queue_depth": "queue_depth", "p99_latency": "p99_latency"},
+            base.signal),
+        high_p99_ms=env_strict_float("HYDRAGNN_AUTOSCALE_HIGH_P99_MS",
+                                     base.high_p99_ms),
+        low_p99_ms=env_strict_float("HYDRAGNN_AUTOSCALE_LOW_P99_MS",
+                                    base.low_p99_ms),
     )
 
 

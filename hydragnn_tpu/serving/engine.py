@@ -643,7 +643,8 @@ class InferenceEngine:
     def trajectory_farm(self, *, dt: float, skin: Optional[float] = None,
                         mass: float = 1.0, force_scale: float = 1.0,
                         steps_per_dispatch: Optional[int] = None,
-                        cand_headroom: Optional[float] = None):
+                        cand_headroom: Optional[float] = None,
+                        scorer=None):
         """A massively-batched device-resident MD farm over this engine's
         model (docs/serving.md "MD farm"): vmapped velocity-Verlet +
         Verlet-skin re-filter with K steps per dispatch, each trajectory
@@ -652,7 +653,12 @@ class InferenceEngine:
         ``ef_forward`` configuration and a single-bucket ladder (the
         farm serves every step on ONE compiled shape, the same shape the
         session adjudication reference runs on). Knobs default to
-        `serving.config.resolve_md_farm` (HYDRAGNN_MD_FARM_*)."""
+        `serving.config.resolve_md_farm` (HYDRAGNN_MD_FARM_*).
+
+        ``scorer`` (an `md.active.EnsembleScorer`) turns the farm into an
+        active-learning producer: uncertainty scored inside the same
+        jitted dispatch, deterministic threshold harvest into
+        ``result["harvest"]`` (docs/active_learning.md)."""
         self._require_structure()
         if not self.ef_forward:
             raise ValueError(
@@ -691,7 +697,7 @@ class InferenceEngine:
                                 else int(steps_per_dispatch)),
             cand_headroom=(knobs.cand_headroom if cand_headroom is None
                            else float(cand_headroom)),
-            compute_dtype=self.compute_dtype)
+            compute_dtype=self.compute_dtype, scorer=scorer)
 
     def submit_structure(self, positions, node_features=None, cell=None,
                          graph_feats=None,

@@ -274,22 +274,8 @@ def print_timers(path: Optional[str] = None):
 
 
 # device-side trace brackets live in telemetry/spans.py now — ONE timing
-# facility; these names remain as the historical entry points
+# facility; this name remains as the historical entry point. The
+# epoch-targeted `Profiler` shim that used to live beside it is GONE
+# (deprecated in PR 7, removed after aging out) — use
+# `hydragnn_tpu.telemetry.EpochDeviceTrace`.
 device_profile = _spans.device_trace
-
-
-class Profiler(_spans.EpochDeviceTrace):
-    """DEPRECATED shim — the epoch-targeted device profiler merged into
-    the telemetry layer as `telemetry.EpochDeviceTrace` (PR 7: one timing
-    facility, not two half-wired ones). Same constructor/`setup`/
-    `set_current_epoch`/context-manager surface; new code should import
-    `hydragnn_tpu.telemetry.EpochDeviceTrace`."""
-
-    def __init__(self, prefix: str = "", enable: bool = False,
-                 target_epoch: int = 0):
-        import warnings
-        warnings.warn(
-            "utils.profiling.Profiler is deprecated; use "
-            "hydragnn_tpu.telemetry.EpochDeviceTrace",
-            DeprecationWarning, stacklevel=2)
-        super().__init__(prefix, enable=enable, target_epoch=target_epoch)

@@ -197,6 +197,61 @@ def test_dataset_id_attached():
     assert seen == {0, 1, 2}
 
 
+def test_mixture_weight_schedule():
+    """Epoch-indexed mixture weights (curriculum): re-planned per epoch
+    through the SAME (epoch, seed)-pure plan. Contracts: (1) a CONSTANT
+    schedule is bitwise the unscheduled plan at every epoch; (2) a real
+    schedule changes the epoch's draw and clamps at its last entry;
+    (3) the plan fingerprint folds the schedule (scheduled != constant
+    unscheduled identity) while no-schedule fingerprints stay
+    byte-stable; (4) entry validation is up front."""
+    members = _members()
+    w = {"alpha": 1.0, "beta": 1.0, "gamma": 2.0}
+
+    plain = GfmMixtureLoader(members, 6, seed=7, weights=w)
+    const = GfmMixtureLoader(members, 6, seed=7, weight_schedule=[w])
+    for epoch in (0, 1, 3):
+        plain.set_epoch(epoch), const.set_epoch(epoch)
+        assert plain._selections() == const._selections()
+        np.testing.assert_array_equal(plain._order(), const._order())
+        assert plain.mixture_fractions() == const.mixture_fractions()
+    # the schedule is part of the plan identity
+    assert (const.global_plan_fingerprint()
+            != plain.global_plan_fingerprint())
+
+    sched = GfmMixtureLoader(
+        members, 6, seed=7,
+        weight_schedule=[w, {"alpha": 1.0, "beta": 1.0, "gamma": 8.0}])
+    sched.set_epoch(0), plain.set_epoch(0)
+    np.testing.assert_array_equal(sched._order(), plain._order())
+    sched.set_epoch(1)
+    g1 = sched.mixture_fractions()["gamma"]
+    assert g1 > const.mixture_fractions()["gamma"]
+    order1 = sched._order()
+    sched.set_epoch(5)  # clamped at the last entry: same weights,
+    # still the (epoch, seed)-pure shuffle — a DIFFERENT epoch order
+    assert sched.mixture_fractions()["gamma"] == g1
+    assert not np.array_equal(sched._order(), order1)
+    # world-size invariance carries over to scheduled epochs
+    r0 = GfmMixtureLoader(members, 6, seed=7, pack_rank=0, pack_nproc=2,
+                          weight_schedule=[w, {"gamma": 8.0}])
+    r1 = GfmMixtureLoader(members, 6, seed=7, pack_rank=1, pack_nproc=2,
+                          weight_schedule=[w, {"gamma": 8.0}])
+    r0.set_epoch(1), r1.set_epoch(1)
+    assert (r0.global_plan_fingerprint()
+            == r1.global_plan_fingerprint())
+    s0, s1 = set(r0._selections()), set(r1._selections())
+    assert s0.isdisjoint(s1) and (s0 or s1)
+    # validation: every entry checked up front; exclusive with weights
+    with pytest.raises(ValueError, match="unknown dataset"):
+        GfmMixtureLoader(members, 6,
+                         weight_schedule=[w, {"delta": 2.0}])
+    with pytest.raises(ValueError, match="not both"):
+        GfmMixtureLoader(members, 6, weights=w, weight_schedule=[w])
+    with pytest.raises(ValueError, match=">= 1 entry"):
+        GfmMixtureLoader(members, 6, weight_schedule=[])
+
+
 def test_mixture_fractions_weighted():
     members = _members()
     frac = GfmMixtureLoader(members, 6, seed=0,

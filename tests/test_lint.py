@@ -63,6 +63,8 @@ def test_traced_env_rule_scope():
     assert rule.applies("hydragnn_tpu/telemetry/registry.py")
     assert rule.applies("hydragnn_tpu/train/precision.py")
     assert rule.applies("hydragnn_tpu/md/farm.py")  # PR 11 farm scan body
+    assert rule.applies("hydragnn_tpu/md/active.py")  # scored dispatch:
+    # the uncertainty head runs inside the farm's traced scan body
     # PR 14: the HPO supervision layer resolves its knobs via
     # envflags.resolve_hpo_supervisor; process.py is the documented
     # child-env-construction exclusion
@@ -191,6 +193,9 @@ def test_determinism_rule_scope_covers_md_farm():
     rule = r_det.NondeterministicOrderRule()
     assert rule.applies("hydragnn_tpu/md/farm.py")
     assert rule.applies("hydragnn_tpu/md/integrator.py")
+    # active.py: the deterministic harvest contract (twin-run bitwise
+    # pool equality) makes its ensemble/pool ordering load-bearing
+    assert rule.applies("hydragnn_tpu/md/active.py")
     assert "hydragnn_tpu/md/" in r_det.SCOPE_DIRS
 
 

@@ -402,6 +402,18 @@ class _FakeRouter:
         self.depth.pop(idx, None)
         return {"replica": idx, "retired": True}
 
+    def stats(self):
+        # fleet-wide latency stats (ReplicaRouter.stats shape): zeroed
+        # placeholder when no requests resolved, count disambiguates
+        lat = getattr(self, "latencies_ms", [])
+        if not lat:
+            return {"count": 0, "p50_ms": 0.0, "p95_ms": 0.0,
+                    "p99_ms": 0.0, "mean_ms": 0.0}
+        arr = sorted(float(x) for x in lat)
+        return {"count": len(arr), "p50_ms": arr[len(arr) // 2],
+                "p95_ms": arr[-1], "p99_ms": arr[-1],
+                "mean_ms": sum(arr) / len(arr)}
+
 
 def _as_cfg(**kw):
     kw.setdefault("cooldown_s", 0.0)
@@ -432,6 +444,32 @@ def test_autoscaler_watermarks_and_clamps():
     fr = _FakeRouter([2.0, 2.0])
     a = QueueDepthAutoscaler(fr, config=_as_cfg(max_replicas=4))
     assert a.step() is None
+
+
+def test_autoscaler_p99_latency_signal():
+    """signal="p99_latency": watermarks key off the fleet-wide p99 in
+    router.stats() instead of queue depth — breach scales up, a calm
+    tail scales down, and an EMPTY stats window (count == 0, the zeroed
+    placeholder) takes no action even when queue depths would have."""
+    cfg = _as_cfg(signal="p99_latency", high_p99_ms=100.0,
+                  low_p99_ms=10.0, max_replicas=4)
+    # p99 breach -> scale up, even though depths sit below high_depth
+    fr = _FakeRouter([0.0, 0.0])
+    fr.latencies_ms = [5.0, 8.0, 250.0]
+    a = QueueDepthAutoscaler(fr, config=cfg)
+    ev = a.step()
+    assert ev["action"] == "scale_up" and ev["signal"] == "p99_latency"
+    assert ev["avg_depth"] == 250.0  # historical key carries the signal
+    # calm tail -> scale down despite deep queues (the SLO is met)
+    fr = _FakeRouter([9.0, 9.0, 9.0])
+    fr.latencies_ms = [1.0, 2.0, 3.0]
+    a = QueueDepthAutoscaler(fr, config=cfg)
+    ev = a.step()
+    assert ev["action"] == "scale_down"
+    # zero resolved requests -> no action (idle != fast)
+    fr = _FakeRouter([9.0, 9.0, 9.0])
+    a = QueueDepthAutoscaler(fr, config=cfg)
+    assert a.step() is None and fr.calls == []
 
 
 def test_autoscaler_revives_retired_slot_first():
@@ -579,6 +617,23 @@ def test_resolve_autoscale_precedence(monkeypatch, caplog):
         a = resolve_autoscale(cfg)
     assert a.max_replicas == 8
     assert "HYDRAGNN_AUTOSCALE_MAX" in caplog.text
+    # the latency-SLO knobs follow the same precedence + strict parsing
+    monkeypatch.delenv("HYDRAGNN_AUTOSCALE_MAX")
+    assert resolve_autoscale(cfg).signal == "queue_depth"  # default
+    monkeypatch.setenv("HYDRAGNN_AUTOSCALE_SIGNAL", "p99_latency")
+    monkeypatch.setenv("HYDRAGNN_AUTOSCALE_HIGH_P99_MS", "150")
+    a = resolve_autoscale(cfg)
+    assert a.signal == "p99_latency" and a.high_p99_ms == 150.0
+    monkeypatch.setenv("HYDRAGNN_AUTOSCALE_SIGNAL", "p99")  # typo
+    with caplog.at_level("WARNING", logger="hydragnn_tpu"):
+        a = resolve_autoscale(cfg)
+    assert a.signal == "queue_depth"  # fell back, never half-applied
+    assert "HYDRAGNN_AUTOSCALE_SIGNAL" in caplog.text
+    cfg2 = {"Serving": {"autoscale": {"signal": "p99_latency",
+                                      "low_p99_ms": 5.0}}}
+    monkeypatch.delenv("HYDRAGNN_AUTOSCALE_SIGNAL")
+    a = resolve_autoscale(cfg2)
+    assert a.signal == "p99_latency" and a.low_p99_ms == 5.0
 
 
 # ------------------------------------------------------------ slow lane

@@ -139,16 +139,15 @@ def test_jit_cache_total_edge_cases():
     assert isinstance(total, int) and total >= 1
 
 
-def test_profiler_shim_deprecated():
-    import warnings
-
+def test_profiler_shim_removed():
+    """The PR 7 deprecation shim aged out: `utils.profiling.Profiler`
+    is GONE (pinned, so it cannot quietly come back), the
+    `device_profile` entry point survives, and the merged facility —
+    `telemetry.EpochDeviceTrace` — carries the whole former surface."""
     from hydragnn_tpu.utils import profiling
-    with warnings.catch_warnings(record=True) as caught:
-        warnings.simplefilter("always")
-        p = profiling.Profiler("/tmp/x", enable=False)
-    assert any(issubclass(w.category, DeprecationWarning) for w in caught)
-    # the shim IS the merged facility — same class, same surface
-    assert isinstance(p, telemetry.EpochDeviceTrace)
+    assert not hasattr(profiling, "Profiler")
+    assert profiling.device_profile is tspans.device_trace
+    p = telemetry.EpochDeviceTrace("/tmp/x", enable=False)
     p.setup({"enable": 0, "target_epoch": 3})
     assert p.target_epoch == 3 and p.enable is False
     with p:  # disabled: enter/exit are no-ops
